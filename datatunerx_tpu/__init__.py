@@ -1,0 +1,14 @@
+"""datatunerx-tpu: a TPU-native rebuild of DataTunerX (reference: /root/reference).
+
+Capability surface (SURVEY.md §0): dataset registration → hyperparameter groups →
+distributed LoRA/full SFT → checkpoint capture → serving → automatic scoring →
+best-model selection across batch experiments.
+
+Mechanism replacements (SURVEY.md §7.1): the reference's Ray Train/torch-DDP/NCCL
+GPU path (reference cmd/tuning/train.py) becomes a single-program JAX/GSPMD trainer
+over a `jax.sharding.Mesh`; bitsandbytes CUDA kernels become Pallas int8/int4
+kernels; the Go/KubeRay control plane becomes a Python reconciler framework with
+pluggable cluster backends.
+"""
+
+__version__ = "0.1.0"

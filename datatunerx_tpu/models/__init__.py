@@ -1,0 +1,15 @@
+from datatunerx_tpu.models.config import ModelConfig, PRESETS, get_config
+from datatunerx_tpu.models.llama import (
+    init_params,
+    forward,
+    num_params,
+)
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "get_config",
+    "init_params",
+    "forward",
+    "num_params",
+]

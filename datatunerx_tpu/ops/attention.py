@@ -1,0 +1,79 @@
+"""Attention ops: XLA reference path + dispatch to Pallas flash / ring attention.
+
+The reference delegates attention entirely to HF transformers CUDA kernels
+(optionally flash-attn, reference cmd/tuning/parser.py:66-69). TPU-native design:
+a plain einsum+softmax path that XLA fuses well (default), a Pallas flash kernel
+for long sequences, and ring attention over a mesh axis for sequence parallelism
+(SURVEY.md §5.7 stretch goal).
+
+Shapes: q [B, T, H, d]; k, v [B, S, KV, d] with H = KV * G (GQA).
+Bias is additive, broadcastable to [B, 1|H, T, S]; softmax runs in f32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_causal_bias(
+    q_positions: jnp.ndarray,  # [B, T] absolute positions of queries
+    kv_positions: jnp.ndarray,  # [B, S] absolute positions of keys
+    kv_valid: jnp.ndarray | None = None,  # [B, S] bool — False for padding
+    *,
+    sliding_window: int | None = None,
+    q_segment_ids: jnp.ndarray | None = None,  # [B, T] for packed sequences
+    kv_segment_ids: jnp.ndarray | None = None,  # [B, S]
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Additive bias [B, 1, T, S]: 0 where attendable, -inf-ish otherwise."""
+    ok = kv_positions[:, None, :] <= q_positions[:, :, None]  # causal
+    if sliding_window is not None:
+        ok &= kv_positions[:, None, :] > q_positions[:, :, None] - sliding_window
+    if kv_valid is not None:
+        ok &= kv_valid[:, None, :]
+    if q_segment_ids is not None and kv_segment_ids is not None:
+        ok &= q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+    neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
+    return jnp.where(ok, jnp.zeros((), dtype), neg)[:, None, :, :]
+
+
+def xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray,
+) -> jnp.ndarray:
+    """Reference attention: f32 softmax, GQA via reshape. Returns [B, T, H, d]."""
+    B, T, H, d = q.shape
+    S, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    q = q.reshape(B, T, KV, G, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("btkgd,bskd->bkgts", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    bias4 = bias.astype(jnp.float32)  # [B, 1|H, T, S]
+    if bias4.shape[1] == 1:
+        logits = logits + bias4[:, :, None, :, :]
+    else:
+        logits = logits + bias4.reshape(B, KV, G, T, S)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
+    return out.reshape(B, T, H, d)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    impl: str = "xla",
+) -> jnp.ndarray:
+    if impl == "xla":
+        return xla_attention(q, k, v, bias)
+    if impl == "flash":
+        from datatunerx_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, bias)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,28 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before tests touch JAX.
+
+SURVEY.md §4.3: multi-host sharding is tested without hardware via a virtual
+multi-device CPU platform — the same pjit/GSPMD programs that run on a TPU slice
+run unchanged over 8 local CPU devices.
+
+Note: the harness's sitecustomize registers the tunneled TPU ("axon") backend at
+interpreter start, so env vars are too late here; ``jax.config.update`` still
+switches the platform before any computation runs.
+"""
+
+import os
+
+os.environ.setdefault("TF_ENABLE_ONEDNN_OPTS", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
