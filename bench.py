@@ -1,0 +1,95 @@
+"""Headline benchmark: LoRA SFT tokens/sec/chip (BASELINE.md north-star #1).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Runs on whatever backend JAX selects (the driver provides one real TPU chip).
+The model is tinyllama-1.1b (real llama-family config that fits one v5e chip in
+bf16 with LoRA); batch geometry mirrors the reference's operating point
+(block_size 1024, reference cmd/tuning/train.py:50-51).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+denominator is this project's own round-1 recorded measurement — values > 1.0
+mean speedup over round 1.
+"""
+
+import json
+import sys
+import time
+
+# Round-1 recorded tokens/sec/chip on TPU v5e-1 (see BASELINE.md); update only
+# alongside BASELINE.md.
+ROUND1_BASELINE_TOKS_PER_SEC = 12996.0  # TPU v5e-1, tinyllama-1.1b LoRA B8xT1024
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.models import get_config, init_params
+    from datatunerx_tpu.training import TrainConfig, Trainer
+    from datatunerx_tpu.training.loss import IGNORE_INDEX
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, B, T, steps = "tinyllama-1.1b", 8, 1024, 20
+    else:  # CPU smoke fallback so bench never hard-fails
+        model, B, T, steps = "debug", 8, 128, 5
+
+    cfg = get_config(model, remat="dots")
+    tr = Trainer(
+        cfg,
+        TrainConfig(
+            finetuning_type="lora", lora_rank=8, lora_alpha=32.0,
+            lora_dropout=0.05, lora_targets=("q_proj", "v_proj"),
+            learning_rate=2e-4, scheduler="cosine", optimizer="adamw",
+            total_steps=1000, compute_dtype=jnp.bfloat16,
+        ),
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    state = tr.init_state(params, jax.random.PRNGKey(1))
+
+    rng = jax.random.PRNGKey(2)
+    toks = jax.random.randint(rng, (B, T), 0, cfg.vocab_size, jnp.int32)
+    labels = jnp.where(
+        jnp.arange(T)[None, :] < T // 8, IGNORE_INDEX, toks
+    )  # prompt-masked SFT batch shape
+    batch = {"input_ids": toks, "labels": labels}
+
+    # warmup / compile. NOTE: sync via host value fetch, not block_until_ready —
+    # the tunneled TPU backend's block_until_ready can return before remote
+    # execution finishes, which inflates throughput by ~5000x.
+    state, m = tr.train_step(state, batch)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = tr.train_step(state, batch)
+    float(m["loss"])  # device-to-host fetch = true pipeline drain
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = B * T * steps / dt
+    vs = (
+        toks_per_sec / ROUND1_BASELINE_TOKS_PER_SEC
+        if (ROUND1_BASELINE_TOKS_PER_SEC and on_tpu)
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"lora_sft_tokens_per_sec_per_chip[{model},B{B}xT{T}]",
+                "value": round(toks_per_sec, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never emit more than the one JSON line on stdout
+        print(json.dumps({"metric": "bench_error", "value": 0, "unit": str(e)[:200],
+                          "vs_baseline": 0.0}))
+        sys.exit(1)

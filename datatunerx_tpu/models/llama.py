@@ -80,15 +80,23 @@ def num_params(params: Params) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))
 
 
-def _proj(h, p, lora_p, lora_scale):
-    """Dense projection with optional LoRA delta: h W + (h A) B * scale."""
+def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0):
+    """Dense projection with optional LoRA delta: h W + drop(h) A B * scale.
+
+    LoRA dropout applies to the adapter branch input only, matching peft's
+    ``lora_dropout`` (reference cmd/tuning/parser.py:146-149, default 0.1).
+    """
     out = h @ p["kernel"].astype(h.dtype)
     if "bias" in p:
         out = out + p["bias"].astype(h.dtype)
     if lora_p is not None:
         a = lora_p["a"].astype(h.dtype)
         b = lora_p["b"].astype(h.dtype)
-        out = out + ((h @ a) @ b) * jnp.asarray(lora_scale, h.dtype)
+        hl = h
+        if drop_key is not None and drop_rate > 0.0:
+            keep = jax.random.bernoulli(drop_key, 1.0 - drop_rate, h.shape)
+            hl = jnp.where(keep, h / (1.0 - drop_rate), 0.0).astype(h.dtype)
+        out = out + ((hl @ a) @ b) * jnp.asarray(lora_scale, h.dtype)
     return out
 
 
@@ -113,6 +121,9 @@ def forward(
     cache: Optional[dict] = None,
     lora: Optional[tuple[Params, float]] = None,
     compute_dtype=None,
+    lora_dropout: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    neftune_alpha: float = 0.0,
 ):
     """Returns (logits [B, T, V] float32, new_cache | None)."""
     B, T = tokens.shape
@@ -122,6 +133,14 @@ def forward(
     x = params["embed_tokens"]["embedding"][tokens]
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
+    if neftune_alpha > 0.0 and dropout_rng is not None:
+        # NEFTune (reference cmd/tuning/parser.py:190-193): uniform noise on the
+        # embedding output, magnitude alpha / sqrt(T * D), training only.
+        mag = neftune_alpha / jnp.sqrt(jnp.asarray(T * x.shape[-1], jnp.float32))
+        noise = jax.random.uniform(
+            jax.random.fold_in(dropout_rng, 0x4EF), x.shape, jnp.float32, -1.0, 1.0
+        )
+        x = x + (noise * mag).astype(x.dtype)
 
     seq_len = T if cache is None else cache["k"].shape[2]
     cos, sin = rope_cos_sin(
@@ -157,14 +176,21 @@ def forward(
         lora_params, lora_scale = lora
         lora_layers = lora_params.get("layers", lora_params)
 
+    drop = lora_dropout if (dropout_rng is not None and lora is not None) else 0.0
+
     def block(x, scanned):
-        lp, ll, ck, cv = scanned
+        lp, ll, ck, cv, layer_idx = scanned
         lget = (lambda name: ll.get(name)) if ll else (lambda name: None)
+        if drop > 0.0:
+            lkey = jax.random.fold_in(dropout_rng, layer_idx)
+            kget = lambda j: jax.random.fold_in(lkey, j)  # noqa: E731
+        else:
+            kget = lambda j: None  # noqa: E731
 
         h = rms_norm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        q = _proj(h, lp["q_proj"], lget("q_proj"), lora_scale)
-        k = _proj(h, lp["k_proj"], lget("k_proj"), lora_scale)
-        v = _proj(h, lp["v_proj"], lget("v_proj"), lora_scale)
+        q = _proj(h, lp["q_proj"], lget("q_proj"), lora_scale, kget(0), drop)
+        k = _proj(h, lp["k_proj"], lget("k_proj"), lora_scale, kget(1), drop)
+        v = _proj(h, lp["v_proj"], lget("v_proj"), lora_scale, kget(2), drop)
         q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -185,12 +211,15 @@ def forward(
 
         attn = attention(q, k_att, v_att, bias, impl=cfg.attention_impl)
         attn = attn.reshape(B, T, cfg.q_dim)
-        x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale)
+        x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3), drop)
 
         h = rms_norm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
-        gate = _proj(h, lp["gate_proj"], lget("gate_proj"), lora_scale)
-        up = _proj(h, lp["up_proj"], lget("up_proj"), lora_scale)
-        mlp = _proj(jax.nn.silu(gate) * up, lp["down_proj"], lget("down_proj"), lora_scale)
+        gate = _proj(h, lp["gate_proj"], lget("gate_proj"), lora_scale, kget(4), drop)
+        up = _proj(h, lp["up_proj"], lget("up_proj"), lora_scale, kget(5), drop)
+        mlp = _proj(
+            jax.nn.silu(gate) * up, lp["down_proj"], lget("down_proj"),
+            lora_scale, kget(6), drop,
+        )
         x = x + mlp
         return x, (ck, cv)
 
@@ -206,6 +235,7 @@ def forward(
         lora_layers,
         cache["k"] if cache is not None else None,
         cache["v"] if cache is not None else None,
+        jnp.arange(cfg.num_layers, dtype=jnp.int32),
     )
     x, (new_k, new_v) = jax.lax.scan(block, x, xs)
 

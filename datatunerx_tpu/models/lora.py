@@ -1,0 +1,99 @@
+"""LoRA: low-rank adapters as a separate param collection.
+
+Replaces the reference's PEFT `get_peft_model` wrapping (reference
+cmd/tuning/train.py:266-280). TPU-native design: adapters live in their own
+pytree mirroring `params["layers"]` with stacked [L, ...] leaves, so
+
+- the optimizer state covers ONLY adapter params (the base stays frozen with no
+  Adam moments — the memory win that makes LoRA cheap),
+- `forward(..., lora=(lora_params, scaling))` applies h·W + (h·A)·B·scale inside
+  each projection (fusable by XLA; Pallas fused kernel in ops/lora_matmul.py),
+- `merge_lora` folds adapters into base kernels for export/serving, matching
+  PEFT's `merge_and_unload` semantics.
+
+Init matches PEFT (reference peft 0.5.0): A ~ kaiming-uniform, B = 0, so the
+delta starts at zero. Scaling = lora_alpha / lora_rank. Defaults mirror the
+reference CLI: rank 8, alpha 32, dropout 0.1 (reference cmd/tuning/parser.py:138-149);
+the controller always passes ``--lora_target q_proj,v_proj`` (reference
+internal/controller/finetune/finetune_controller.go:482).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models.config import ModelConfig
+
+# Valid llama-family targets (reference cmd/tuning/parser.py:150-160).
+LORA_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+)
+DEFAULT_TARGETS = ("q_proj", "v_proj")
+
+
+def target_dims(cfg: ModelConfig, name: str) -> tuple[int, int]:
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    return {
+        "q_proj": (D, cfg.q_dim),
+        "k_proj": (D, cfg.kv_dim),
+        "v_proj": (D, cfg.kv_dim),
+        "o_proj": (cfg.q_dim, D),
+        "gate_proj": (D, F),
+        "up_proj": (D, F),
+        "down_proj": (F, D),
+    }[name]
+
+
+def lora_scaling(alpha: float, rank: int) -> float:
+    return float(alpha) / float(rank)
+
+
+def init_lora_params(
+    cfg: ModelConfig,
+    key: jax.Array,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    dtype=jnp.float32,
+):
+    for t in targets:
+        if t not in LORA_TARGETS:
+            raise ValueError(f"invalid lora target {t!r}; choices: {LORA_TARGETS}")
+    L = cfg.num_layers
+    layers = {}
+    for i, t in enumerate(sorted(set(targets))):
+        d_in, d_out = target_dims(cfg, t)
+        # kaiming-uniform(a=sqrt(5)) over fan_in, like torch Linear / peft LoRA A:
+        # bound = sqrt(6 / ((1 + a^2) * fan_in)) = 1 / sqrt(fan_in)
+        bound = 1.0 / math.sqrt(d_in)
+        a = jax.random.uniform(
+            jax.random.fold_in(key, i), (L, d_in, rank), jnp.float32, -bound, bound
+        ).astype(dtype)
+        layers[t] = {"a": a, "b": jnp.zeros((L, rank, d_out), dtype)}
+    return {"layers": layers}
+
+
+def num_lora_params(lora_params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora_params))
+
+
+def merge_lora(params, lora_params, scaling: float):
+    """Fold adapters into base kernels: W' = W + A·B·scaling (per layer)."""
+    layers = dict(params["layers"])
+    for t, ab in lora_params["layers"].items():
+        delta = jnp.einsum(
+            "lir,lro->lio",
+            ab["a"].astype(jnp.float32),
+            ab["b"].astype(jnp.float32),
+        ) * scaling
+        proj = dict(layers[t])
+        proj["kernel"] = (proj["kernel"].astype(jnp.float32) + delta).astype(
+            layers[t]["kernel"].dtype
+        )
+        layers[t] = proj
+    out = dict(params)
+    out["layers"] = layers
+    return out

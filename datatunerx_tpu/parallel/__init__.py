@@ -1,0 +1,17 @@
+from datatunerx_tpu.parallel.mesh import MESH_AXES, make_mesh, mesh_shape_for
+from datatunerx_tpu.parallel.sharding import (
+    batch_pspec,
+    param_pspecs,
+    shard_tree,
+    tree_shardings,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "make_mesh",
+    "mesh_shape_for",
+    "batch_pspec",
+    "param_pspecs",
+    "shard_tree",
+    "tree_shardings",
+]

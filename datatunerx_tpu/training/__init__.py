@@ -1,0 +1,13 @@
+from datatunerx_tpu.training.loss import causal_lm_loss, IGNORE_INDEX
+from datatunerx_tpu.training.optimizer import make_optimizer, make_schedule
+from datatunerx_tpu.training.train_lib import TrainState, Trainer, TrainConfig
+
+__all__ = [
+    "causal_lm_loss",
+    "IGNORE_INDEX",
+    "make_optimizer",
+    "make_schedule",
+    "TrainState",
+    "Trainer",
+    "TrainConfig",
+]

@@ -1,0 +1,30 @@
+"""Causal-LM loss with prompt masking.
+
+Matches HF Trainer semantics the reference relies on (reference
+cmd/tuning/train.py:73-117 builds labels with IGNORE_INDEX over the prompt;
+HF shifts internally): loss at position t predicts token t+1, ignoring -100.
+Perplexity = exp(eval_loss) (reference cmd/tuning/trainer.py:324-327).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,  # [B, T, V] float32
+    labels: jnp.ndarray,  # [B, T] int32 with IGNORE_INDEX at masked positions
+):
+    """Returns (sum_loss, n_valid_tokens). Mean = sum/n; callers combine across
+    microbatches/devices by summing both (so gradient accumulation is exact)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    labels = labels[:, 1:]
+    valid = labels != IGNORE_INDEX
+    safe = jnp.where(valid, labels, 0)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    tok = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, logz - tok, 0.0)
+    return nll.sum(), valid.sum()

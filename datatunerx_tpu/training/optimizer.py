@@ -1,0 +1,90 @@
+"""Optimizer / LR-schedule factories keyed by the Hyperparameter CR enums.
+
+The reference plumbs `Parameters.Optimizer` and `Parameters.Scheduler` strings
+from the Hyperparameter CRD through the trainer CLI into HF TrainingArguments
+(reference internal/controller/finetune/finetune_controller.go:478-479,
+cmd/tuning/parser.py → Seq2SeqTrainingArguments). We accept the same names
+(HF `lr_scheduler_type` / `optim` vocabularies) and map to optax.
+"""
+
+from __future__ import annotations
+
+import optax
+
+SCHEDULERS = (
+    "linear", "cosine", "cosine_with_restarts", "polynomial",
+    "constant", "constant_with_warmup",
+)
+
+
+def make_schedule(
+    name: str,
+    learning_rate: float,
+    total_steps: int,
+    warmup_ratio: float = 0.0,
+    warmup_steps: int | None = None,
+):
+    name = (name or "linear").lower()
+    if warmup_steps is None:
+        warmup_steps = int(total_steps * warmup_ratio)
+    decay_steps = max(total_steps - warmup_steps, 1)
+
+    if name == "constant" and warmup_steps == 0:
+        return optax.constant_schedule(learning_rate)
+    if name in ("constant", "constant_with_warmup"):
+        body = optax.constant_schedule(learning_rate)
+    elif name == "linear":
+        body = optax.linear_schedule(learning_rate, 0.0, decay_steps)
+    elif name == "cosine":
+        body = optax.cosine_decay_schedule(learning_rate, decay_steps)
+    elif name == "cosine_with_restarts":
+        # HF uses num_cycles=1 by default — equivalent to plain cosine; keep a
+        # 2-cycle sawtooth to honor the "restarts" intent.
+        cycle = max(decay_steps // 2, 1)
+        body = optax.join_schedules(
+            [optax.cosine_decay_schedule(learning_rate, cycle),
+             optax.cosine_decay_schedule(learning_rate, cycle)],
+            [cycle],
+        )
+    elif name == "polynomial":
+        body = optax.polynomial_schedule(learning_rate, 0.0, power=1.0,
+                                         transition_steps=decay_steps)
+    else:
+        raise ValueError(f"unknown scheduler {name!r}; choices {SCHEDULERS}")
+
+    if warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+        return optax.join_schedules([warmup, body], [warmup_steps])
+    return body
+
+
+OPTIMIZERS = ("adamw", "adamw_torch", "adamw_hf", "adam", "sgd", "adafactor", "lion")
+
+
+def make_optimizer(
+    name: str,
+    schedule,
+    weight_decay: float = 0.0,
+    max_grad_norm: float = 1.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    name = (name or "adamw").lower()
+    if name in ("adamw", "adamw_torch", "adamw_hf"):
+        core = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    elif name == "adam":
+        core = optax.adam(schedule, b1=b1, b2=b2, eps=eps)
+    elif name == "sgd":
+        core = optax.sgd(schedule, momentum=0.9)
+    elif name == "adafactor":
+        core = optax.adafactor(schedule)
+    elif name == "lion":
+        core = optax.lion(schedule, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}; choices {OPTIMIZERS}")
+    chain = []
+    if max_grad_norm and max_grad_norm > 0:
+        chain.append(optax.clip_by_global_norm(max_grad_norm))
+    chain.append(core)
+    return optax.chain(*chain)

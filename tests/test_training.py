@@ -1,0 +1,206 @@
+"""Trainer tests: LoRA math, grad-accum exactness, freeze masking, GSPMD parity.
+
+SURVEY.md §4: the reference has zero tests; these cover the semantics its stack
+delegated to peft/HF/DeepSpeed — adapter init, masked loss, accumulation — plus
+the multi-device sharding the reference never tested at all.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models.config import ModelConfig
+from datatunerx_tpu.models.llama import forward, init_params
+from datatunerx_tpu.models.lora import init_lora_params, lora_scaling, merge_lora
+from datatunerx_tpu.parallel.mesh import make_mesh
+from datatunerx_tpu.training.loss import IGNORE_INDEX, causal_lm_loss
+from datatunerx_tpu.training.train_lib import TrainConfig, Trainer
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=64, remat="none",
+)
+
+
+def _batch(rng, B=4, T=16, accum=None):
+    toks = rng.integers(4, 128, size=(B, T)).astype(np.int32)
+    labels = toks.copy()
+    labels[:, : T // 4] = IGNORE_INDEX  # mask a "prompt" prefix
+    b = {"input_ids": jnp.asarray(toks), "labels": jnp.asarray(labels)}
+    if accum:
+        b = {k: v.reshape(accum, B // accum, T) for k, v in b.items()}
+    return b
+
+
+def test_lora_init_is_identity():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    lora = init_lora_params(CFG, jax.random.PRNGKey(1), rank=4)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 8), np.int32))
+    base, _ = forward(params, toks, CFG)
+    with_lora, _ = forward(params, toks, CFG, lora=(lora, lora_scaling(32, 4)))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora), atol=1e-6)
+
+
+def test_lora_merge_matches_adapter_forward():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    lora = init_lora_params(CFG, jax.random.PRNGKey(1), rank=4,
+                            targets=("q_proj", "v_proj", "down_proj"))
+    # make B nonzero so the delta is real
+    lora = jax.tree_util.tree_map(
+        lambda x: x + 0.01 * jax.random.normal(jax.random.PRNGKey(2), x.shape), lora
+    )
+    s = lora_scaling(32, 4)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 8), np.int32))
+    adapter, _ = forward(params, toks, CFG, lora=(lora, s))
+    merged, _ = forward(merge_lora(params, lora, s), toks, CFG)
+    np.testing.assert_allclose(np.asarray(adapter), np.asarray(merged), atol=1e-4)
+
+
+def test_loss_ignores_masked_tokens():
+    logits = jnp.zeros((1, 5, 16), jnp.float32)
+    labels = jnp.asarray([[IGNORE_INDEX, 1, IGNORE_INDEX, 2, 3]])
+    s, n = causal_lm_loss(logits, labels)
+    assert int(n) == 3  # labels[1:] -> [1, IGNORE, 2, 3]
+    np.testing.assert_allclose(float(s) / int(n), np.log(16), rtol=1e-5)
+
+
+def _make_trainer(**kw):
+    defaults = dict(
+        finetuning_type="lora", lora_rank=4, lora_dropout=0.0,
+        learning_rate=1e-2, scheduler="constant", optimizer="adamw",
+        total_steps=50, compute_dtype=None,
+    )
+    defaults.update(kw)
+    return Trainer(CFG, TrainConfig(**defaults))
+
+
+@pytest.mark.parametrize("ftype", ["lora", "full"])
+def test_loss_decreases(ftype):
+    lr = 3e-2 if ftype == "lora" else 5e-3  # rank-4 q/v adapters need a hot lr
+    tr = _make_trainer(finetuning_type=ftype, learning_rate=lr,
+                       lora_targets=("q_proj", "v_proj", "gate_proj", "down_proj"))
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    state = tr.init_state(params, jax.random.PRNGKey(42))
+    batch = _batch(np.random.default_rng(0))
+    losses = []
+    for _ in range(30):
+        state, m = tr.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_grad_accum_matches_full_batch():
+    tr1 = _make_trainer(grad_accum=1)
+    tr2 = _make_trainer(grad_accum=2)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    import jax.numpy as _jnp
+    s1 = tr1.init_state(jax.tree_util.tree_map(_jnp.copy, params), jax.random.PRNGKey(7))
+    s2 = tr2.init_state(jax.tree_util.tree_map(_jnp.copy, params), jax.random.PRNGKey(7))
+    rng = np.random.default_rng(3)
+    full = _batch(rng, B=4, T=16)
+    micro = {k: v.reshape(2, 2, 16) for k, v in full.items()}
+    s1, m1 = tr1.train_step(s1, full)
+    s2, m2 = tr2.train_step(s2, micro)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.lora), jax.tree_util.tree_leaves(s2.lora)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_freeze_only_updates_selected_layers():
+    tr = _make_trainer(finetuning_type="freeze", num_layer_trainable=1,
+                       name_module_trainable="mlp", learning_rate=1e-2)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    state = tr.init_state(params, jax.random.PRNGKey(9))
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    state, _ = tr.train_step(state, _batch(np.random.default_rng(1)))
+    after = jax.tree_util.tree_map(np.asarray, state.params)
+
+    # embed unchanged
+    np.testing.assert_array_equal(
+        before["embed_tokens"]["embedding"], after["embed_tokens"]["embedding"]
+    )
+    gate_b, gate_a = before["layers"]["gate_proj"]["kernel"], after["layers"]["gate_proj"]["kernel"]
+    # layer 0 frozen, layer 1 (last) trained
+    np.testing.assert_array_equal(gate_b[0], gate_a[0])
+    assert np.abs(gate_b[1] - gate_a[1]).max() > 0
+    # attention untouched in mlp mode
+    np.testing.assert_array_equal(
+        before["layers"]["q_proj"]["kernel"], after["layers"]["q_proj"]["kernel"]
+    )
+
+
+@pytest.mark.parametrize("shape", [(4, 1, 2, 1), (1, 4, 2, 1), (2, 2, 2, 1)])
+def test_sharded_training_matches_single_device(shape, devices8):
+    batch = _batch(np.random.default_rng(5), B=8, T=16)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    ref_tr = _make_trainer()
+    ref_state = ref_tr.init_state(jax.tree_util.tree_map(jnp.copy, params), jax.random.PRNGKey(11))
+    ref_state, ref_m = ref_tr.train_step(ref_state, batch)
+    ref_state, ref_m2 = ref_tr.train_step(ref_state, batch)
+
+    mesh = make_mesh(shape)
+    tr = _make_trainer()
+    tr.mesh = mesh
+    state = tr.init_state(jax.tree_util.tree_map(jnp.copy, params), jax.random.PRNGKey(11))
+    state, m = tr.train_step(state, batch)
+    state, m2 = tr.train_step(state, batch)
+
+    np.testing.assert_allclose(float(ref_m["loss"]), float(m["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(ref_m2["loss"]), float(m2["loss"]), rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.lora), jax.tree_util.tree_leaves(state.lora)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_full_param_fsdp_sharding(devices8):
+    """Full-param training with params+opt state sharded (ZeRO-3 equivalent)."""
+    mesh = make_mesh((1, 8, 1, 1))
+    tr = _make_trainer(finetuning_type="full", learning_rate=1e-3)
+    tr.mesh = mesh
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    state = tr.init_state(params, jax.random.PRNGKey(3))
+    batch = _batch(np.random.default_rng(2), B=8, T=16)
+    losses = []
+    for _ in range(6):
+        state, m = tr.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    # params actually sharded over fsdp axis
+    kern = state.params["layers"]["q_proj"]["kernel"]
+    assert kern.sharding.spec[1] == "fsdp", kern.sharding.spec
+
+
+def test_sharded_grad_accum(devices8):
+    """Regression: accumulation axis must NOT be sharded over data axes."""
+    mesh = make_mesh((2, 2, 2, 1))
+    full = _batch(np.random.default_rng(8), B=8, T=16)
+    micro = {k: v.reshape(2, 4, 16) for k, v in full.items()}
+
+    ref = _make_trainer(grad_accum=2)
+    s_ref = ref.init_state(init_params(CFG, jax.random.PRNGKey(0)), jax.random.PRNGKey(13))
+    s_ref, m_ref = ref.train_step(s_ref, micro)
+
+    tr = _make_trainer(grad_accum=2)
+    tr.mesh = mesh
+    s = tr.init_state(init_params(CFG, jax.random.PRNGKey(0)), jax.random.PRNGKey(13))
+    s, m = tr.train_step(s, micro)
+
+    np.testing.assert_allclose(float(m_ref["loss"]), float(m["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.lora), jax.tree_util.tree_leaves(s.lora)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_freeze_opt_state_skips_frozen_leaves():
+    """Frozen leaves (embed, norms, attn in mlp mode) get no AdamW moments."""
+    tr = _make_trainer(finetuning_type="freeze", name_module_trainable="mlp")
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    state = tr.init_state(params, jax.random.PRNGKey(1))
+    n_params = len(jax.tree_util.tree_leaves(params))
+    n_opt = len(jax.tree_util.tree_leaves(state.opt_state))
+    # moments only for gate/up/down kernels (3 leaves x mu+nu + counts) — far
+    # fewer arrays than 2x all params
+    assert n_opt < n_params, (n_opt, n_params)
