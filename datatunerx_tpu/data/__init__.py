@@ -1,0 +1,18 @@
+from datatunerx_tpu.data.templates import Template, get_template, list_templates
+from datatunerx_tpu.data.preprocess import (
+    encode_supervised_example,
+    pad_to_block,
+    preprocess_records,
+)
+from datatunerx_tpu.data.loader import CsvDataset, BatchIterator
+
+__all__ = [
+    "Template",
+    "get_template",
+    "list_templates",
+    "encode_supervised_example",
+    "pad_to_block",
+    "preprocess_records",
+    "CsvDataset",
+    "BatchIterator",
+]

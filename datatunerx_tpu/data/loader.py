@@ -1,0 +1,174 @@
+"""Dataset ingest + deterministic batch iteration.
+
+Replaces Ray Data CSV ingest (reference cmd/tuning/train.py:329-351: read_csv +
+rename_columns + streaming split across workers). TPU-native: a plain CSV/JSONL
+reader plus a deterministic, seedable iterator that shards *batches* across
+data-parallel hosts — in the GSPMD model every host feeds its addressable slice
+of the same global batch, rather than Ray pushing dataset shards to actors.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from datatunerx_tpu.data.preprocess import (
+    pack_to_block,
+    pad_to_block,
+    preprocess_records,
+)
+from datatunerx_tpu.data.templates import Template, get_template
+from datatunerx_tpu.training.loss import IGNORE_INDEX
+
+
+class CsvDataset:
+    """Loads instruction/response records from .csv or .jsonl files.
+
+    `columns` maps source column names → canonical names (`instruction`,
+    `response`, optional `query`/`history`/`system`) — the Dataset CR feature
+    mapping contract (SURVEY.md §2.3 Dataset).
+    """
+
+    def __init__(self, path: str, columns: Optional[Dict[str, str]] = None):
+        self.path = path
+        self.columns = columns
+        self.records = self._load(path)
+
+    @staticmethod
+    def _load(path: str) -> List[Dict[str, Any]]:
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        records: List[Dict[str, Any]] = []
+        if path.endswith(".jsonl") or path.endswith(".json"):
+            with open(path) as f:
+                text = f.read().strip()
+            if text.startswith("["):
+                records = json.loads(text)
+            else:
+                records = [json.loads(line) for line in text.splitlines() if line.strip()]
+        else:
+            with open(path, newline="") as f:
+                records = list(csv.DictReader(f))
+        return records
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def encode(
+        self,
+        template: Template | str,
+        tokenizer,
+        cutoff_len: int = 1024,
+    ) -> List[Dict[str, List[int]]]:
+        if isinstance(template, str):
+            template = get_template(template, tokenizer)
+        return preprocess_records(
+            self.records, template, tokenizer, cutoff_len=cutoff_len,
+            columns=self.columns,
+        )
+
+
+class BatchIterator:
+    """Deterministic shuffled epochs over encoded examples → fixed-shape batches.
+
+    - `global_batch` examples per step, padded (or packed) to `block_size`.
+    - `grad_accum` reshapes to [A, mb, T].
+    - `host_id`/`num_hosts` slice the global batch for multi-host feeding
+      (every host computes the same permutation from the seed).
+    - Drops the trailing partial batch (static shapes; the reference's dynamic
+      collator has no such constraint but TPU recompilation would cost more
+      than the dropped tail).
+    """
+
+    def __init__(
+        self,
+        examples: Sequence[Dict[str, List[int]]],
+        *,
+        global_batch: int,
+        block_size: int,
+        pad_id: int = 0,
+        grad_accum: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        pack: bool = False,
+        host_id: int = 0,
+        num_hosts: int = 1,
+        drop_remainder: bool = True,
+    ):
+        self.drop_remainder = drop_remainder
+        if global_batch % max(grad_accum, 1) != 0:
+            raise ValueError("global_batch must be divisible by grad_accum")
+        if (global_batch // max(grad_accum, 1)) % num_hosts != 0:
+            raise ValueError("per-step batch must be divisible by num_hosts")
+        self.examples = list(examples)
+        self.global_batch = global_batch
+        self.block_size = block_size
+        self.pad_id = pad_id
+        self.grad_accum = max(grad_accum, 1)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.pack = pack
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        if pack:
+            # Pack the whole dataset once; epochs then shuffle packed rows so
+            # every step keeps a static [global_batch, block_size] shape.
+            packed = pack_to_block(self.examples, block_size, pad_id)
+            self._rows = packed
+            self._n_rows = packed["input_ids"].shape[0]
+        else:
+            self._rows = None
+            self._n_rows = len(self.examples)
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self._n_rows // self.global_batch
+        return -(-self._n_rows // self.global_batch)
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(self._n_rows)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(order)
+        for s in range(self.steps_per_epoch()):
+            idx = order[s * self.global_batch : (s + 1) * self.global_batch]
+            if self.pack:
+                batch = {k: v[idx] for k, v in self._rows.items()}
+                if len(idx) < self.global_batch:
+                    batch = _pad_rows(batch, self.global_batch)
+            else:
+                exs = [self.examples[i] for i in idx]
+                # pad the final partial batch with empty rows (labels all
+                # IGNORE -> zero loss/token contribution, shapes stay static)
+                exs += [{"input_ids": [], "labels": []}] * (self.global_batch - len(exs))
+                batch = pad_to_block(exs, self.block_size, self.pad_id)
+            batch = self._host_slice(batch)
+            if self.grad_accum > 1:
+                batch = {
+                    k: v.reshape(self.grad_accum, -1, *v.shape[1:])
+                    for k, v in batch.items()
+                }
+            yield batch
+
+    def _host_slice(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        if self.num_hosts == 1:
+            return batch
+        B = next(iter(batch.values())).shape[0]
+        per = B // self.num_hosts
+        lo, hi = self.host_id * per, (self.host_id + 1) * per
+        return {k: v[lo:hi] for k, v in batch.items()}
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def _pad_rows(batch: Dict[str, np.ndarray], target_rows: int) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in batch.items():
+        pad_val = IGNORE_INDEX if k == "labels" else 0
+        extra = np.full((target_rows - v.shape[0],) + v.shape[1:], pad_val, v.dtype)
+        out[k] = np.concatenate([v, extra], axis=0)
+    return out

@@ -1,0 +1,171 @@
+"""Supervised SFT preprocessing: encode + prompt-mask + fixed-shape padding.
+
+Behavior-parity with the reference preprocessor (reference
+cmd/tuning/train.py:58-135):
+
+- column-mapped records with `instruction`/`response` (+ optional `query`
+  appended to instruction with a newline, `history`, `system`);
+- skip records where either field is empty/non-string;
+- per-turn proportional truncation to cutoff_len, prompt masked to
+  IGNORE_INDEX; efficient_eos turns carry eos as first label token of the
+  *source* span; final eos appended for efficient_eos templates;
+- final truncation to cutoff_len.
+
+TPU-first deltas: batches are padded to a static block_size (XLA needs static
+shapes; the reference pads dynamically per batch, train.py:282-286), and an
+optional greedy packer concatenates short examples with segment_ids — our
+attention masks cross-segment pairs, which dynamic-padding stacks can't do.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from datatunerx_tpu.data.templates import Template
+from datatunerx_tpu.training.loss import IGNORE_INDEX
+
+
+def map_columns(record: Dict[str, Any], columns: Optional[Dict[str, str]]) -> Dict[str, Any]:
+    """Rename record keys per the Dataset CR feature map (reference
+    cmd/tuning/train.py:54-56; mapping built by the controller from
+    DatasetInfo.Features[].{Name,MapTo},
+    internal/controller/finetune/finetune_controller.go:655-680)."""
+    if not columns:
+        return record
+    return {columns.get(k, k): v for k, v in record.items()}
+
+
+def encode_supervised_example(
+    template: Template,
+    tokenizer,
+    query: str,
+    response: str,
+    history: Optional[List[Tuple[str, str]]] = None,
+    system: Optional[str] = None,
+    cutoff_len: int = 1024,
+) -> Tuple[List[int], List[int]]:
+    """Returns (input_ids, labels); None-equivalent empties are the caller's
+    filter responsibility."""
+    input_ids: List[int] = []
+    labels: List[int] = []
+    for turn_idx, (source_ids, target_ids) in enumerate(
+        template.encode_turns(tokenizer, query, response, history, system)
+    ):
+        total = len(source_ids) + len(target_ids)
+        max_src = int(cutoff_len * (len(source_ids) / total)) if total else 0
+        max_tgt = int(cutoff_len * (len(target_ids) / total)) if total else 0
+        if len(source_ids) > max_src:
+            source_ids = source_ids[:max_src]
+        if len(target_ids) > max_tgt:
+            target_ids = target_ids[:max_tgt]
+
+        if turn_idx != 0 and template.efficient_eos:
+            source_mask = [tokenizer.eos_token_id] + [IGNORE_INDEX] * (len(source_ids) - 1)
+        else:
+            source_mask = [IGNORE_INDEX] * len(source_ids)
+
+        input_ids += source_ids + target_ids
+        labels += source_mask + target_ids
+
+    if template.efficient_eos:
+        input_ids += [tokenizer.eos_token_id]
+        labels += [tokenizer.eos_token_id]
+
+    return input_ids[:cutoff_len], labels[:cutoff_len]
+
+
+def preprocess_records(
+    records: Iterable[Dict[str, Any]],
+    template: Template,
+    tokenizer,
+    cutoff_len: int = 1024,
+    columns: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, List[int]]]:
+    out = []
+    for rec in records:
+        rec = map_columns(rec, columns)
+        query, response = rec.get("instruction"), rec.get("response")
+        if not (isinstance(query, str) and isinstance(response, str)
+                and query != "" and response != ""):
+            continue
+        if rec.get("query"):
+            query = query + "\n" + rec["query"]
+        ids, labels = encode_supervised_example(
+            template, tokenizer, query, response,
+            history=rec.get("history"), system=rec.get("system"),
+            cutoff_len=cutoff_len,
+        )
+        out.append({"input_ids": ids, "labels": labels,
+                    "attention_mask": [1] * len(ids)})
+    return out
+
+
+def pad_to_block(
+    examples: Sequence[Dict[str, List[int]]],
+    block_size: int,
+    pad_id: int = 0,
+) -> Dict[str, np.ndarray]:
+    """Right-pad each example to the static block_size."""
+    B = len(examples)
+    input_ids = np.full((B, block_size), pad_id, np.int32)
+    labels = np.full((B, block_size), IGNORE_INDEX, np.int32)
+    attn = np.zeros((B, block_size), np.int32)
+    for i, ex in enumerate(examples):
+        n = min(len(ex["input_ids"]), block_size)
+        input_ids[i, :n] = ex["input_ids"][:n]
+        labels[i, :n] = ex["labels"][:n]
+        attn[i, :n] = 1
+    return {"input_ids": input_ids, "labels": labels, "attention_mask": attn}
+
+
+def pack_to_block(
+    examples: Sequence[Dict[str, List[int]]],
+    block_size: int,
+    pad_id: int = 0,
+) -> Dict[str, np.ndarray]:
+    """Greedy first-fit packing of short examples into block_size rows with
+    segment_ids; cross-segment attention is masked by the model. Raises the
+    useful-token density vs plain padding (TPU static shapes make padding
+    waste real FLOPs)."""
+    rows: List[List[Dict[str, List[int]]]] = []
+    used: List[int] = []
+    for ex in sorted(examples, key=lambda e: -len(e["input_ids"])):
+        n = min(len(ex["input_ids"]), block_size)
+        for i, u in enumerate(used):
+            if u + n <= block_size:
+                rows[i].append(ex)
+                used[i] += n
+                break
+        else:
+            rows.append([ex])
+            used.append(n)
+
+    B = len(rows)
+    input_ids = np.full((B, block_size), pad_id, np.int32)
+    labels = np.full((B, block_size), IGNORE_INDEX, np.int32)
+    attn = np.zeros((B, block_size), np.int32)
+    segs = np.zeros((B, block_size), np.int32)
+    positions = np.zeros((B, block_size), np.int32)
+    for i, row in enumerate(rows):
+        off = 0
+        for j, ex in enumerate(row, start=1):
+            n = min(len(ex["input_ids"]), block_size - off)
+            input_ids[i, off : off + n] = ex["input_ids"][:n]
+            labels[i, off : off + n] = ex["labels"][:n]
+            # the shifted CE loss reads labels[t+1] from position t; the first
+            # token of a segment must never be trained from the previous
+            # segment's last token
+            labels[i, off] = IGNORE_INDEX
+            attn[i, off : off + n] = 1
+            segs[i, off : off + n] = j
+            positions[i, off : off + n] = np.arange(n)
+            off += n
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "attention_mask": attn,
+        "segment_ids": segs,
+        "positions": positions,
+    }

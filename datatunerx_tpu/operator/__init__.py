@@ -1,0 +1,34 @@
+"""Control plane: the CRD-driven orchestration layer.
+
+Rebuilds the reference's Go operator (reference internal/controller/finetune/,
+SURVEY.md §2.1 G1-G13) as a Python reconciler framework with the same
+capability surface: 8 CR kinds in 3 API groups, three nested state-machine
+controllers (Finetune → FinetuneJob → FinetuneExperiment), resource
+generation, validation webhooks, finalizers, owner references, and
+requeue-with-backoff error policy.
+
+Mechanism replacement (SURVEY.md §7.1): KubeRay RayJob/RayService become a
+pluggable ClusterBackend — LocalProcessBackend executes training/serving as
+host processes (CI/e2e), ManifestBackend renders GKE JobSet/Deployment specs
+for TPU node pools.
+"""
+
+from datatunerx_tpu.operator.api import (
+    Dataset,
+    Finetune,
+    FinetuneExperiment,
+    FinetuneJob,
+    Hyperparameter,
+    LLM,
+    LLMCheckpoint,
+    ObjectMeta,
+    Scoring,
+)
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.operator.reconciler import Manager, Result
+
+__all__ = [
+    "Dataset", "Finetune", "FinetuneExperiment", "FinetuneJob",
+    "Hyperparameter", "LLM", "LLMCheckpoint", "ObjectMeta", "Scoring",
+    "ObjectStore", "Manager", "Result",
+]

@@ -1,0 +1,93 @@
+"""Operator environment config (reference pkg/config/config.go:7-87, viper env
+bindings). Same keys, TPU-flavored defaults; S3 creds become object-store
+endpoints (GCS or S3-compatible)."""
+
+from __future__ import annotations
+
+import os
+
+
+def _get(key: str, default: str = "") -> str:
+    return os.environ.get(key, default)
+
+
+def get_s3_endpoint() -> str:
+    return _get("S3_ENDPOINT")
+
+
+def get_s3_access_key() -> str:
+    return _get("S3_ACCESSKEYID")
+
+
+def get_s3_secret_key() -> str:
+    return _get("S3_SECRETACCESSKEY")
+
+
+def get_s3_bucket() -> str:
+    return _get("S3_BUCKET")
+
+
+def get_s3_secure() -> bool:
+    return _get("S3_SECURE", "false").lower() in ("true", "1")
+
+
+def get_registry_url() -> str:
+    return _get("REGISTRY_URL")
+
+
+def get_registry_repo() -> str:
+    return _get("REGISTRY_REPOSITORY_NAME")
+
+
+def get_registry_user() -> str:
+    return _get("REGISTRY_USERNAME")
+
+
+def get_registry_password() -> str:
+    return _get("REGISTRY_PASSWORD")
+
+
+def get_mount_path() -> str:
+    return _get("MOUNT_PATH", "/data")
+
+
+def get_base_image() -> str:
+    # trainer image for TPU-host pods (reference default is the ray GPU image,
+    # config.go / generate.go:46-51)
+    return _get("BASE_IMAGE", "datatunerx-tpu/trainer:latest")
+
+
+def get_default_model_path() -> str:
+    return _get("LLM_URL", "/models/llama2-7b")
+
+
+def get_metrics_export_address() -> str:
+    return _get("METRICS_EXPORT_ADDRESS")
+
+
+def get_storage_path() -> str:
+    return _get("STORAGE_PATH", "/storage")
+
+
+def get_log_level() -> str:
+    return _get("LOG_LEVEL", "info")
+
+
+def get_operator_namespace() -> str:
+    """Reference pkg/util/util.go:32-42: serviceaccount namespace file with
+    datatunerx-dev fallback."""
+    path = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return _get("OPERATOR_NAMESPACE", "datatunerx-dev")
+
+
+def get_tpu_topology() -> str:
+    """TPU addition: default slice topology for training jobs (e.g. 2x4)."""
+    return _get("TPU_TOPOLOGY", "")
+
+
+def get_tpu_accelerator() -> str:
+    return _get("TPU_ACCELERATOR", "tpu-v5-lite-podslice")

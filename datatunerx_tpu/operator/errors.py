@@ -1,0 +1,26 @@
+"""Sentinel errors + requeue policy (reference pkg/domain/valueobject/err.go,
+pkg/util/handlererr/handler.go)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class ErrRecalibrate(Exception):
+    """'waiting for dependent resources' — requeue quietly
+    (reference valueobject/err.go:5-7)."""
+
+
+RECALIBRATE_REQUEUE_S = 10.0  # reference handlererr/handler.go:13
+ERROR_REQUEUE_S = 30.0  # reference handlererr/handler.go:16
+
+
+def handle_err(err: Optional[BaseException]) -> Tuple[Optional[float], Optional[BaseException]]:
+    """(requeue_after_seconds, error_to_surface) — reference
+    handlererr/handler.go:11-19 semantics: ErrRecalibrate → 10s silent requeue;
+    any other error → 30s requeue + surfaced error."""
+    if err is None:
+        return None, None
+    if isinstance(err, ErrRecalibrate):
+        return RECALIBRATE_REQUEUE_S, None
+    return ERROR_REQUEUE_S, err

@@ -1,0 +1,46 @@
+"""Multi-host bootstrap: `jax.distributed.initialize` from pod environment.
+
+Replaces the reference's Ray control plane (ray.init + Ray Train worker
+placement, reference cmd/tuning/train.py:310,353-377). In the TPU-native design
+(SURVEY.md §5.8) a JobSet/StatefulSet of TPU-host pods runs ONE identical
+program; pod 0 is the coordinator and GSPMD handles all cross-host collectives,
+so "distributed setup" reduces to this single call.
+
+Env contract (set by the operator's job generator, operator/generate.py):
+  DTX_COORDINATOR_ADDRESS  host:port of pod 0 (default port 8476)
+  DTX_NUM_PROCESSES        total host count
+  DTX_PROCESS_ID           this host's index
+Falls back to JAX's own autodetection (GKE JobSet / TPU metadata) when unset.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def maybe_initialize_distributed(num_workers: int = 1) -> dict:
+    """Initialize jax.distributed when running multi-host; no-op otherwise.
+
+    Returns a summary dict {initialized, process_id, num_processes}.
+    """
+    if num_workers <= 1 and "DTX_COORDINATOR_ADDRESS" not in os.environ:
+        return {"initialized": False, "process_id": 0, "num_processes": 1}
+
+    coord: Optional[str] = os.environ.get("DTX_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("DTX_NUM_PROCESSES", num_workers))
+    pid = int(os.environ.get("DTX_PROCESS_ID", 0))
+    if nproc <= 1:
+        return {"initialized": False, "process_id": 0, "num_processes": 1}
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+    )
+    return {
+        "initialized": True,
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+    }

@@ -1,0 +1,142 @@
+"""Checkpointing: periodic Orbax saves, resume, and the completion manifest.
+
+The reference only captures a final checkpoint (save_strategy="no",
+reference cmd/tuning/train.py:199,300-305) and plumbs its path back by writing
+``/home/ray/checkpoint_path`` on the head pod, which the Go controller scrapes
+via pod-exec ``cat`` (reference internal/controller/finetune/
+finetune_controller.go:278-305). SURVEY.md §5.4 calls for better:
+
+- periodic Orbax saves every N steps + resume-on-restart (elasticity the
+  reference lacks),
+- a **completion manifest** JSON written to a deterministic key under
+  ``storage_path`` (checkpoint URI + final metrics) that the controller reads
+  from object storage — no pod-exec,
+- a local ``checkpoint_path`` file kept for drop-in compatibility with the
+  reference's contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+import jax
+
+MANIFEST_NAME = "manifest.json"
+LEGACY_PATH_FILE = "checkpoint_path"  # reference train.py:383-389 contract
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(
+        self,
+        directory: str,
+        save_interval_steps: int = 0,
+        max_to_keep: int = 3,
+    ):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.save_interval_steps = save_interval_steps
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def maybe_save(self, state, step: int, force: bool = False) -> bool:
+        due = force or (
+            self.save_interval_steps > 0 and step > 0
+            and step % self.save_interval_steps == 0
+        )
+        if not due:
+            return False
+        import orbax.checkpoint as ocp
+
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        self._mngr.wait_until_finished()
+        return True
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state_template, step: Optional[int] = None):
+        """Restore into the structure/shardings of `state_template`."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None, None
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape")
+            else x,
+            state_template,
+        )
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return restored, step
+
+    def close(self):
+        self._mngr.close()
+
+
+def write_manifest(
+    storage_path: str,
+    run_name: str,
+    checkpoint_uri: str,
+    metrics: Optional[dict] = None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Write the completion manifest at the deterministic key
+    ``<storage_path>/<run_name>/manifest.json`` and the legacy path file."""
+    run_dir = os.path.join(storage_path, run_name)
+    os.makedirs(run_dir, exist_ok=True)
+    manifest = {
+        "run": run_name,
+        "checkpoint": checkpoint_uri,
+        "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metrics": metrics or {},
+    }
+    if extra:
+        manifest.update(extra)
+    path = os.path.join(run_dir, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    with open(os.path.join(run_dir, LEGACY_PATH_FILE), "w") as f:
+        f.write(checkpoint_uri)
+    return path
+
+
+def read_manifest(storage_path: str, run_name: str) -> Optional[dict]:
+    path = os.path.join(storage_path, run_name, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def export_merged_model(params, cfg, export_dir: str, lora=None, scaling: float = 1.0) -> str:
+    """Export (optionally LoRA-merged) weights as an HF-layout .npz plus config
+    (reference ``--export_dir``, cmd/tuning/parser.py:88-91)."""
+    import numpy as np
+
+    from datatunerx_tpu.models.lora import merge_lora
+    from datatunerx_tpu.utils.hf_convert import export_hf_state_dict
+
+    if lora is not None:
+        params = merge_lora(params, lora, scaling)
+    os.makedirs(export_dir, exist_ok=True)
+    sd = export_hf_state_dict(params, cfg)
+    out = os.path.join(export_dir, "model.npz")
+    np.savez(out, **sd)
+    import dataclasses
+
+    with open(os.path.join(export_dir, "config.json"), "w") as f:
+        json.dump(dataclasses.asdict(cfg), f, indent=1, default=str)
+    return out

@@ -1,0 +1,183 @@
+"""Training metrics: jsonl logs + Prometheus remote-write.
+
+Replaces the reference LogCallback + exporter (reference cmd/tuning/callback.py,
+cmd/tuning/prometheus/metrics.py). Wire format kept: snappy-compressed protobuf
+WriteRequest POSTed to ``{addr}/api/v1/write`` with the run UID as a label
+(reference metrics.py:21-39), and jsonl mirrors under ``watch/`` (reference
+callback.py:144-155).
+
+Fixed reference bug (SURVEY.md §7.5): the reference encodes metric *values as
+labels* with constant sample value 1 (metrics.py:60-74), which breaks PromQL
+math. Here each metric is a real timeseries ``dtx_train_<name>{uid=...} value``.
+
+Dependency-free wire encoding: a minimal protobuf writer and a literal-only
+snappy block encoding (the snappy format allows all-literal streams; any
+compliant decompressor accepts it).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import struct
+import time
+import urllib.request
+from typing import Dict, Optional
+
+# ------------------------------------------------------------------ protobuf
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(tag: int, wire: int) -> bytes:
+    return _varint((tag << 3) | wire)
+
+
+def _len_delim(tag: int, payload: bytes) -> bytes:
+    return _field(tag, 2) + _varint(len(payload)) + payload
+
+
+def _label(name: str, value: str) -> bytes:
+    return _len_delim(1, name.encode()) + _len_delim(2, value.encode())
+
+
+def _sample(value: float, ts_ms: int) -> bytes:
+    out = _field(1, 1) + struct.pack("<d", value)
+    # sint64? Prometheus Sample.timestamp is int64 (not zigzag)
+    out += _field(2, 0) + _varint(ts_ms & 0xFFFFFFFFFFFFFFFF)
+    return out
+
+
+def encode_write_request(
+    metrics: Dict[str, float], labels: Dict[str, str], ts_ms: Optional[int] = None
+) -> bytes:
+    """Prometheus WriteRequest: one TimeSeries per metric."""
+    ts_ms = ts_ms if ts_ms is not None else int(time.time() * 1000)
+    body = b""
+    for name, value in metrics.items():
+        if value is None or (isinstance(value, float) and math.isnan(value)):
+            continue
+        series = _len_delim(1, _label("__name__", name))
+        for k, v in sorted(labels.items()):
+            series += _len_delim(1, _label(k, str(v)))
+        series += _len_delim(2, _sample(float(value), ts_ms))
+        body += _len_delim(1, series)
+    return body
+
+
+def snappy_compress_literal(data: bytes) -> bytes:
+    """Snappy block format with literal-only elements (spec-valid, uncompacted)."""
+    out = bytearray(_varint(len(data)))
+    i = 0
+    while i < len(data):
+        chunk = data[i : i + 60]  # literal length <= 60 fits the tag byte
+        out.append((len(chunk) - 1) << 2)  # tag 00 = literal
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def push_remote_write(
+    address: str,
+    metrics: Dict[str, float],
+    labels: Dict[str, str],
+    timeout: float = 5.0,
+) -> bool:
+    """POST to {address}/api/v1/write (headers per reference metrics.py:29-34)."""
+    payload = snappy_compress_literal(encode_write_request(metrics, labels))
+    req = urllib.request.Request(
+        address.rstrip("/") + "/api/v1/write",
+        data=payload,
+        headers={
+            "Content-Encoding": "snappy",
+            "Content-Type": "application/x-protobuf",
+            "X-Prometheus-Remote-Write-Version": "0.1.0",
+            "User-Agent": "datatunerx-tpu/0.1",
+        },
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception:
+        return False  # metrics export must never kill training
+
+
+# ------------------------------------------------------------------ callback
+
+class MetricsLogger:
+    """Per-step logging: stdout + watch/*.jsonl + optional remote-write.
+
+    jsonl field names mirror the reference (callback.py:103-138): loss, lr,
+    epoch, percentage, current_steps, total_steps, elapsed_time, eta;
+    eval: eval_loss, perplexity (+ generative rouge/bleu when scored).
+    """
+
+    def __init__(
+        self,
+        output_dir: str,
+        total_steps: int,
+        metrics_export_address: Optional[str] = None,
+        uid: Optional[str] = None,
+    ):
+        self.output_dir = output_dir
+        self.total_steps = max(total_steps, 1)
+        self.address = metrics_export_address
+        self.uid = uid
+        self.start = time.time()
+        self.watch_dir = os.path.join(output_dir, "watch")
+        os.makedirs(self.watch_dir, exist_ok=True)
+
+    def _common(self, step: int) -> Dict:
+        elapsed = time.time() - self.start
+        rate = elapsed / max(step, 1)
+        return {
+            "current_steps": step,
+            "total_steps": self.total_steps,
+            "percentage": round(step / self.total_steps * 100, 2),
+            "elapsed_time": round(elapsed, 3),
+            "eta": round(rate * max(self.total_steps - step, 0), 3),
+        }
+
+    def _write(self, filename: str, record: Dict):
+        with open(os.path.join(self.watch_dir, filename), "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def log_train(self, step: int, metrics: Dict[str, float]):
+        rec = {**self._common(step), **{k: _f(v) for k, v in metrics.items()}}
+        self._write("trainer_log.jsonl", rec)
+        print(f"[train] {json.dumps(rec)}", flush=True)
+        if self.address:
+            push_remote_write(
+                self.address,
+                {f"dtx_train_{k}": _f(v) for k, v in metrics.items()},
+                {"uid": self.uid or "", "phase": "train"},
+            )
+
+    def log_eval(self, step: int, metrics: Dict[str, float]):
+        rec = {**self._common(step), **{k: _f(v) for k, v in metrics.items()}}
+        self._write("eval_log.jsonl", rec)
+        print(f"[eval] {json.dumps(rec)}", flush=True)
+        if self.address:
+            push_remote_write(
+                self.address,
+                {f"dtx_eval_{k}": _f(v) for k, v in metrics.items()},
+                {"uid": self.uid or "", "phase": "eval"},
+            )
+
+
+def _f(v) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")

@@ -145,8 +145,18 @@ class Trainer:
         else:
             with self.mesh or _nullcontext():
                 opt_state = jax.jit(self.optimizer.init)(trainable)
+        step = jnp.zeros((), jnp.int32)
+        if self.mesh is not None:
+            # replicate scalars/keys on the mesh so checkpoint-restore templates
+            # carry complete shardings (place_state then exists only for
+            # cross-topology restores)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            step = jax.device_put(step, repl)
+            rng = jax.device_put(rng, repl)
         return TrainState(
-            step=jnp.zeros((), jnp.int32),
+            step=step,
             params=params,
             lora=lora,
             opt_state=opt_state,
@@ -155,6 +165,22 @@ class Trainer:
 
     def _trainable(self, params, lora):
         return lora if self.cfg.finetuning_type == "lora" else params
+
+    def place_state(self, state: TrainState) -> TrainState:
+        """Re-place a (restored) state onto this trainer's mesh shardings."""
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        put = lambda t: None if t is None else shard_tree(t, self.mesh)  # noqa: E731
+        return TrainState(
+            step=jax.device_put(state.step, repl),
+            params=put(state.params),
+            lora=put(state.lora),
+            opt_state=put(state.opt_state),
+            rng=jax.device_put(state.rng, repl),
+        )
 
     def _freeze_mask(self, params):
         """Per-leaf multiplicative masks for freeze tuning."""

@@ -1,0 +1,166 @@
+"""Trainer CLI: the Go↔Python contract surface.
+
+Mirrors the flag set the controller emits (reference
+internal/controller/finetune/finetune_controller.go:457-514) plus the trainer's
+own schema (reference cmd/tuning/parser.py — ModelArguments /
+FinetuningArguments / DataArguments / training args), with TPU additions
+(--mesh, --attention, --template, --save_steps).
+
+Contract-compat notes (reference bugs we tolerate, SURVEY.md §7.5):
+- the controller sends ``--lora_r`` but the reference parser only defines
+  ``--lora_rank`` — we accept both;
+- ``--per_device_train_batch_size `` is emitted with a trailing space in the
+  flag name — shell splitting makes that harmless, no action needed;
+- ``--deepspeed`` is accepted and ignored (sharding comes from --mesh);
+- ``--columns`` may arrive Go-strconv.Quote()d — we unquote.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainArgs:
+    # model (reference cmd/tuning/parser.py:12-109)
+    model_name_or_path: str
+    quantization: Optional[str] = None  # int4 | int8
+    quantization_type: str = "nf4"  # fp4 | nf4
+    double_quantization: bool = True
+    rope_scaling: Optional[str] = None  # linear | dynamic
+    rope_scaling_factor: float = 2.0
+    flash_attn: bool = False
+    shift_attn: bool = False
+    checkpoint_dir: Optional[str] = None  # resume/merge adapters
+    export_dir: Optional[str] = None
+    # finetuning (reference cmd/tuning/parser.py:112-221)
+    stage: str = "sft"  # pt | sft (rm/ppo/dpo reserved)
+    finetuning_type: str = "lora"  # lora | freeze | full | none
+    num_layer_trainable: int = 3
+    name_module_trainable: str = "mlp"
+    lora_rank: int = 8
+    lora_alpha: float = 32.0
+    lora_dropout: float = 0.1
+    lora_target: str = "q_proj,v_proj"
+    neft_alpha: float = 0.0
+    num_workers: int = 1
+    storage_path: Optional[str] = None
+    metrics_export_address: Optional[str] = None
+    uid: Optional[str] = None
+    # data (reference cmd/tuning/parser.py:224-247)
+    train_path: Optional[str] = None
+    evaluation_path: Optional[str] = None
+    columns: Optional[str] = None
+    block_size: int = 1024
+    template: str = "llama2"  # reference hardcodes llama2 (train.py:63)
+    pack_sequences: bool = False
+    # training loop (HF Seq2SeqTrainingArguments subset the pipeline uses)
+    output_dir: str = "result"
+    per_device_train_batch_size: int = 4
+    per_device_eval_batch_size: int = 4
+    gradient_accumulation_steps: int = 1
+    learning_rate: float = 2e-4
+    num_train_epochs: float = 1.0
+    max_steps: int = -1
+    lr_scheduler_type: str = "cosine"
+    optim: str = "adamw"
+    warmup_ratio: float = 0.0
+    weight_decay: float = 0.0
+    max_grad_norm: float = 1.0
+    logging_steps: int = 10
+    save_steps: int = 0  # 0 = final only (reference behavior)
+    eval_steps: int = 0  # 0 = once per epoch when eval set present
+    seed: int = 42
+    fp16: bool = False  # accepted for contract; bf16 is the TPU dtype
+    bf16: bool = True
+    # TPU additions
+    mesh: Optional[str] = None  # e.g. "dp=4,fsdp=2,tp=1,sp=1"
+    attention: str = "xla"  # xla | flash | ring
+    remat: str = "dots"  # none | dots | full
+    deepspeed: Optional[str] = None  # accepted, ignored
+    resume: bool = True  # auto-resume from latest checkpoint
+
+    def __post_init__(self):
+        if self.stage not in ("pt", "sft", "rm", "ppo", "dpo"):
+            raise ValueError(f"invalid --stage {self.stage}")
+        if self.stage not in ("pt", "sft"):
+            raise NotImplementedError(
+                f"stage {self.stage!r} is reserved (reference implements sft only; "
+                "cmd/tuning/train.py has no rm/ppo/dpo path either)"
+            )
+        if self.finetuning_type not in ("lora", "freeze", "full", "none"):
+            raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
+        if self.quantization not in (None, "int4", "int8"):
+            raise ValueError("We only accept int4 or int8 quantization.")
+        if self.rope_scaling not in (None, "linear", "dynamic"):
+            raise ValueError(f"invalid --rope_scaling {self.rope_scaling}")
+        if self.train_path is None and self.export_dir is None:
+            raise ValueError("--train_path must be specified")
+        if self.storage_path is None:
+            raise ValueError("--storage_path must be specified")
+
+    @property
+    def lora_targets(self) -> tuple:
+        return tuple(t.strip() for t in self.lora_target.split(",") if t.strip())
+
+    @property
+    def columns_map(self) -> Optional[dict]:
+        if not self.columns:
+            return None
+        text = self.columns
+        if text.startswith('"') and text.endswith('"'):  # Go strconv.Quote
+            text = json.loads(text)
+        return json.loads(text)
+
+    @property
+    def mesh_dims(self) -> Optional[dict]:
+        if not self.mesh:
+            return None
+        dims = {}
+        for part in self.mesh.split(","):
+            k, _, v = part.partition("=")
+            dims[k.strip()] = int(v)
+        return dims
+
+
+_BOOLS = {"fp16", "bf16", "flash_attn", "shift_attn", "double_quantization",
+          "pack_sequences", "resume"}
+_ALIASES = {"lora_r": "lora_rank"}  # controller emits --lora_r
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="datatunerx-tpu-train", allow_abbrev=False)
+    for f in dataclasses.fields(TrainArgs):
+        name = "--" + f.name
+        if f.name in _BOOLS:
+            # accept "--flag true/false" (Go emits values) and bare "--flag"
+            p.add_argument(name, nargs="?", const="true",
+                           default=None if f.default is None else str(f.default))
+        elif f.default is dataclasses.MISSING:
+            p.add_argument(name, required=True)
+        else:
+            p.add_argument(name, default=f.default)
+    for alias, target in _ALIASES.items():
+        p.add_argument("--" + alias, dest=target, default=argparse.SUPPRESS)
+    return p
+
+
+def parse_train_args(argv=None) -> TrainArgs:
+    ns = vars(build_argparser().parse_args(argv))
+    kwargs = {}
+    for f in dataclasses.fields(TrainArgs):
+        if f.name not in ns or ns[f.name] is None:
+            continue
+        raw = ns[f.name]
+        if f.name in _BOOLS:
+            kwargs[f.name] = str(raw).lower() in ("true", "1", "yes")
+        elif f.type in ("int", int):
+            kwargs[f.name] = int(raw)
+        elif f.type in ("float", float):
+            kwargs[f.name] = float(raw)
+        else:
+            kwargs[f.name] = raw
+    return TrainArgs(**kwargs)

@@ -1,0 +1,240 @@
+"""Trainer entrypoint: ``python -m datatunerx_tpu.tuning.train --model_name_or_path … --train_path …``
+
+The TPU-native replacement for the reference's Ray Train driver (reference
+cmd/tuning/train.py): one identical program per TPU host — no Ray, no
+per-worker init function; `jax.distributed` + GSPMD replace TorchTrainer/DDP
+(SURVEY.md §7.1). Pipeline:
+
+  parse → distributed init → load model+tokenizer → template/encode/pack →
+  mesh → Trainer → (resume?) → epoch loop [train_step, log, eval, save] →
+  final checkpoint + completion manifest (+ optional merged export)
+
+Reference bug fixed here (SURVEY.md §7.5): eval loads evaluation_path, not
+train_path (reference train.py:346-348 loads the train file twice).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datatunerx_tpu.data import BatchIterator, CsvDataset, get_template
+from datatunerx_tpu.models.config import ModelConfig
+from datatunerx_tpu.parallel.distributed import maybe_initialize_distributed
+from datatunerx_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from datatunerx_tpu.training import TrainConfig, Trainer
+from datatunerx_tpu.training.checkpoint import (
+    CheckpointManager,
+    export_merged_model,
+    write_manifest,
+)
+from datatunerx_tpu.training.metrics_log import MetricsLogger
+from datatunerx_tpu.tuning.parser import TrainArgs, parse_train_args
+from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
+
+
+def run(args: TrainArgs) -> dict:
+    dist = maybe_initialize_distributed(args.num_workers)
+    is_main = dist["process_id"] == 0
+
+    # ----- model -------------------------------------------------------
+    overrides = dict(
+        remat=args.remat,
+        attention_impl=args.attention,
+    )
+    if args.rope_scaling:
+        overrides.update(
+            rope_scaling_type=args.rope_scaling,
+            rope_scaling_factor=args.rope_scaling_factor,
+        )
+    dtype = jnp.bfloat16 if args.bf16 else np.float32
+    cfg, params, tokenizer = load_model_and_tokenizer(
+        args.model_name_or_path, dtype=dtype, seed=args.seed,
+        config_overrides=overrides,
+    )
+
+    # ----- data --------------------------------------------------------
+    template = get_template(args.template, tokenizer)
+    pad_id = tokenizer.pad_token_id or 0
+    train_ds = CsvDataset(args.train_path, columns=args.columns_map)
+    train_examples = train_ds.encode(template, tokenizer, cutoff_len=args.block_size)
+    if not train_examples:
+        raise RuntimeError("Empty dataset!")
+    eval_examples = None
+    if args.evaluation_path:
+        eval_examples = CsvDataset(
+            args.evaluation_path, columns=args.columns_map
+        ).encode(template, tokenizer, cutoff_len=args.block_size)
+
+    # ----- mesh --------------------------------------------------------
+    n_dev = len(jax.devices())
+    dims = args.mesh_dims or {}
+    shape = mesh_shape_for(
+        n_dev,
+        dp=dims.get("dp"),
+        fsdp=dims.get("fsdp", 1 if "dp" in dims else None),
+        tp=dims.get("tp", 1),
+        sp=dims.get("sp", 1),
+    )
+    mesh = make_mesh(shape)
+    data_par = shape[0] * shape[1]
+
+    global_batch = args.per_device_train_batch_size * data_par * args.gradient_accumulation_steps
+    it = BatchIterator(
+        train_examples,
+        global_batch=global_batch,
+        block_size=args.block_size,
+        pad_id=pad_id,
+        grad_accum=args.gradient_accumulation_steps,
+        seed=args.seed,
+        pack=args.pack_sequences,
+        host_id=dist["process_id"],
+        num_hosts=dist["num_processes"],
+    )
+    steps_per_epoch = it.steps_per_epoch()
+    if steps_per_epoch == 0:
+        raise RuntimeError(
+            f"dataset ({len(train_examples)} examples) smaller than one global "
+            f"batch ({global_batch})"
+        )
+    total_steps = (
+        args.max_steps if args.max_steps > 0
+        else int(math.ceil(steps_per_epoch * args.num_train_epochs))
+    )
+
+    # ----- trainer -----------------------------------------------------
+    tcfg = TrainConfig(
+        finetuning_type=args.finetuning_type,
+        lora_rank=args.lora_rank,
+        lora_alpha=args.lora_alpha,
+        lora_dropout=args.lora_dropout,
+        lora_targets=args.lora_targets,
+        num_layer_trainable=args.num_layer_trainable,
+        name_module_trainable=args.name_module_trainable,
+        learning_rate=args.learning_rate,
+        scheduler=args.lr_scheduler_type,
+        optimizer=args.optim,
+        warmup_ratio=args.warmup_ratio,
+        weight_decay=args.weight_decay,
+        max_grad_norm=args.max_grad_norm,
+        total_steps=total_steps,
+        grad_accum=args.gradient_accumulation_steps,
+        neftune_alpha=args.neft_alpha,
+        compute_dtype=jnp.bfloat16 if args.bf16 else None,
+    )
+    trainer = Trainer(cfg, tcfg, mesh=mesh)
+    state = trainer.init_state(params, jax.random.PRNGKey(args.seed))
+
+    run_name = args.uid or os.path.basename(args.output_dir.rstrip("/")) or "run"
+    ckpt_dir = os.path.join(args.storage_path, run_name, "checkpoints")
+    ckpt = CheckpointManager(ckpt_dir, save_interval_steps=args.save_steps)
+    start_step = 0
+    if args.resume and ckpt.latest_step() is not None:
+        restored, start_step = ckpt.restore(state)
+        if restored is not None:
+            state = trainer.place_state(restored)
+            if is_main:
+                print(f"[resume] restored step {start_step} from {ckpt_dir}", flush=True)
+
+    logger = MetricsLogger(
+        args.output_dir, total_steps,
+        metrics_export_address=args.metrics_export_address, uid=args.uid,
+    )
+
+    # ----- loop --------------------------------------------------------
+    step = 0  # counts up through start_step (skipping those batches) on resume
+    final_metrics: dict = {}
+    epochs = range(int(math.ceil(total_steps / steps_per_epoch)))
+    done = False
+    for epoch in epochs:
+        if done:
+            break
+        for batch in it.epoch(epoch):
+            if step >= total_steps:
+                done = True
+                break
+            if step < start_step:  # resumed: fast-forward the data stream
+                step += 1
+                continue
+            state, metrics = trainer.train_step(state, batch)
+            step += 1
+            if is_main and (step % args.logging_steps == 0 or step == total_steps):
+                host = {k: float(v) for k, v in metrics.items()}
+                host["epoch"] = round(step / steps_per_epoch, 3)
+                logger.log_train(step, host)
+                final_metrics = host
+            if args.save_steps > 0:
+                ckpt.maybe_save(state, step)
+            if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
+                _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main)
+
+    # ----- final eval / save / manifest --------------------------------
+    if eval_examples:
+        final_metrics.update(
+            _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main)
+        )
+    ckpt.maybe_save(state, step, force=True)
+
+    manifest_path = None
+    if is_main:
+        checkpoint_uri = os.path.join(ckpt_dir, str(step))
+        manifest_path = write_manifest(
+            args.storage_path, run_name, checkpoint_uri,
+            metrics=final_metrics,
+            extra={
+                "model": args.model_name_or_path,
+                "finetuning_type": args.finetuning_type,
+                "template": args.template,
+                "mesh": dict(zip(("dp", "fsdp", "tp", "sp"), shape)),
+                "steps": step,
+            },
+        )
+        if args.export_dir:
+            lora = state.lora if tcfg.finetuning_type == "lora" else None
+            export_merged_model(
+                jax.device_get(state.params), cfg, args.export_dir,
+                lora=jax.device_get(lora) if lora is not None else None,
+                scaling=trainer.scaling,
+            )
+    ckpt.close()
+    return {
+        "steps": step,
+        "metrics": final_metrics,
+        "manifest": manifest_path,
+        "checkpoint_dir": ckpt_dir,
+    }
+
+
+def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main):
+    data_par = 1
+    if trainer.mesh is not None:
+        data_par = trainer.mesh.shape["dp"] * trainer.mesh.shape["fsdp"]
+    eval_it = BatchIterator(
+        eval_examples,
+        global_batch=args.per_device_eval_batch_size * data_par,
+        block_size=args.block_size,
+        pad_id=pad_id,
+        shuffle=False,
+        drop_remainder=False,  # pad the tail: every eval example counts
+    )
+    m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
+                                 for b in eval_it.epoch(0)))
+    if is_main:
+        logger.log_eval(step, m)
+    return m
+
+
+def main(argv=None):
+    args = parse_train_args(argv)
+    result = run(args)
+    print(f"[done] {result['steps']} steps; manifest: {result['manifest']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Deterministic HF-tokenizer stand-in for template golden tests (no network)."""
+
+
+class FakeTokenizer:
+    def __init__(self, add_bos_token=True):
+        self.bos_token_id = 1
+        self.eos_token_id = 2
+        self.eos_token = "</s>"
+        self.pad_token = None
+        self.pad_token_id = None
+        self.add_bos_token = add_bos_token
+        self._special = {"</s>": 2}
+
+    def encode(self, text, add_special_tokens=False):
+        assert not add_special_tokens
+        # stable per-character ids, offset away from special ids
+        return [10 + (ord(c) % 1987) for c in text]
+
+    def convert_tokens_to_ids(self, token):
+        if token not in self._special:
+            self._special[token] = 3000 + len(self._special)
+        return self._special[token]
+
+    def add_special_tokens(self, mapping, replace_additional_special_tokens=False):
+        for tok in mapping.get("additional_special_tokens", []):
+            self.convert_tokens_to_ids(tok)
+
+    @property
+    def special_tokens_map(self):
+        return dict(self._special)

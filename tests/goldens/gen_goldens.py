@@ -1,0 +1,76 @@
+"""Regenerate template goldens by RUNNING the reference template module
+(read-only import from /root/reference) against the deterministic fake
+tokenizer. Output: tests/goldens/templates.json.
+
+Usage: python tests/goldens/gen_goldens.py
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))  # for fake_tokenizer
+
+from fake_tokenizer import FakeTokenizer  # noqa: E402
+
+REF = "/root/reference/cmd/tuning/template.py"
+
+CASES = [
+    {
+        "id": "single",
+        "query": "What is a TPU?",
+        "response": "A tensor processing unit.",
+        "history": None,
+        "system": None,
+    },
+    {
+        "id": "multiturn_system",
+        "query": "And v5e?",
+        "response": "A cost-efficient TPU generation.",
+        "history": [["Hi", "Hello!"], ["Name a chip", "TPU v4"]],
+        "system": "Be terse.",
+    },
+]
+
+
+def main():
+    spec = importlib.util.spec_from_file_location("ref_template", REF)
+    ref = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref)
+
+    out = {}
+    for name, template in sorted(ref.templates.items()):
+        out[name] = {}
+        for case in CASES:
+            tok = FakeTokenizer()
+            ref.get_template_and_fix_tokenizer(name, tok)
+            pairs = template.encode_multiturn(
+                tok,
+                case["query"],
+                case["response"],
+                [tuple(h) for h in case["history"]] if case["history"] else None,
+                case["system"],
+            )
+            prompt_ids, answer_ids = template.encode_oneturn(
+                tok,
+                case["query"],
+                case["response"],
+                [tuple(h) for h in case["history"]] if case["history"] else None,
+                case["system"],
+            )
+            out[name][case["id"]] = {
+                "pairs": [[list(a), list(b)] for a, b in pairs],
+                "oneturn": [list(prompt_ids), list(answer_ids)],
+                "specials": tok.special_tokens_map,
+            }
+
+    path = os.path.join(HERE, "templates.json")
+    with open(path, "w") as f:
+        json.dump({"cases": CASES, "templates": out}, f, indent=1, sort_keys=True)
+    print(f"wrote {path}: {len(out)} templates x {len(CASES)} cases")
+
+
+if __name__ == "__main__":
+    main()

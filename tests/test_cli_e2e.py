@@ -1,0 +1,157 @@
+"""CLI contract + end-to-end tiny training runs through the real entrypoint.
+
+SURVEY.md §4.4: the Go↔Python seam is the flag list the controller emits
+(reference internal/controller/finetune/finetune_controller.go:457-514); encode
+it once and test both sides. CONTROLLER_FLAGS below is that single encoding —
+operator/generate tests import it too.
+"""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from datatunerx_tpu.tuning.parser import parse_train_args
+
+# The exact flag sequence the reference controller emits (values are
+# representative). finetune_controller.go:457-514 — including the --lora_r
+# (not --lora_rank) spelling and Go strconv.Quote()d --columns.
+CONTROLLER_FLAGS = [
+    "--model_name_or_path", "{model}",
+    "--train_path", "{train}",
+    "--evaluation_path", "{eval}",
+    "--columns", '"{\\"q\\": \\"instruction\\", \\"a\\": \\"response\\"}"',
+    "--output_dir", "{out}",
+    "--deepspeed", "/tuning/ds_config.json",
+    "--lora_target", "q_proj,v_proj",
+    "--lr_scheduler_type", "cosine",
+    "--optim", "adamw",
+    "--quantization", "int8",
+    "--lora_r", "4",
+    "--lora_alpha", "16",
+    "--lora_dropout", "0.05",
+    "--learning_rate", "0.01",
+    "--num_train_epochs", "2",
+    "--block_size", "64",
+    "--per_device_train_batch_size", "2",
+    "--warmup_ratio", "0.1",
+    "--weight_decay", "0.01",
+    "--gradient_accumulation_steps", "2",
+    "--fp16", "false",
+    "--num_workers", "1",
+    "--storage_path", "{storage}",
+    "--metrics_export_address", "",
+    "--uid", "test-uid-123",
+]
+
+
+def _flags(tmp_path, **extra):
+    model = "preset:debug"
+    train = str(tmp_path / "train.csv")
+    evalp = str(tmp_path / "eval.csv")
+    out = str(tmp_path / "out")
+    storage = str(tmp_path / "storage")
+    rows = [("add %d+%d" % (k, k), "answer %d" % (2 * k)) for k in range(96)]
+    for p, rws in ((train, rows), (evalp, rows[:8])):
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["q", "a"])
+            w.writerows(rws)
+    subs = {"{model}": model, "{train}": train, "{eval}": evalp, "{out}": out,
+            "{storage}": storage}
+    argv = [subs.get(a, a) for a in CONTROLLER_FLAGS]
+    for k, v in extra.items():
+        argv += [f"--{k}", str(v)]
+    return argv, out, storage
+
+
+def test_controller_flag_surface_parses(tmp_path):
+    argv, out, storage = _flags(tmp_path)
+    args = parse_train_args(argv)
+    assert args.lora_rank == 4  # via --lora_r alias
+    assert args.columns_map == {"q": "instruction", "a": "response"}
+    assert args.quantization == "int8"
+    assert args.fp16 is False
+    assert args.deepspeed == "/tuning/ds_config.json"  # accepted, ignored
+    assert args.num_train_epochs == 2.0
+    assert args.uid == "test-uid-123"
+
+
+def test_missing_required_flags():
+    with pytest.raises(ValueError, match="train_path"):
+        parse_train_args(["--model_name_or_path", "m", "--storage_path", "s"])
+    with pytest.raises(ValueError, match="storage_path"):
+        parse_train_args(["--model_name_or_path", "m", "--train_path", "t"])
+
+
+def test_e2e_train_eval_manifest(tmp_path):
+    """Full pipeline on CPU: CSV -> LoRA SFT -> checkpoint + manifest + logs."""
+    from datatunerx_tpu.tuning.train import main
+
+    argv, out, storage = _flags(
+        tmp_path, template="alpaca", max_steps="4", logging_steps="1",
+        bf16="false", remat="none", attention="xla",
+    )
+    assert main(argv) == 0
+
+    # jsonl logs (reference callback.py:144-155 contract)
+    trainer_log = [
+        json.loads(l)
+        for l in open(os.path.join(out, "watch", "trainer_log.jsonl"))
+    ]
+    assert len(trainer_log) == 4
+    assert {"loss", "lr", "epoch", "current_steps", "total_steps", "percentage"} <= set(trainer_log[0])
+    eval_log = [json.loads(l) for l in open(os.path.join(out, "watch", "eval_log.jsonl"))]
+    assert {"eval_loss", "perplexity"} <= set(eval_log[-1])
+
+    # completion manifest at the deterministic key (replaces pod-exec scrape)
+    mf = json.load(open(os.path.join(storage, "test-uid-123", "manifest.json")))
+    assert mf["steps"] == 4
+    assert os.path.isdir(mf["checkpoint"])
+    assert "loss" in mf["metrics"]
+    # legacy checkpoint_path file kept for reference-contract compatibility
+    legacy = open(os.path.join(storage, "test-uid-123", "checkpoint_path")).read()
+    assert legacy == mf["checkpoint"]
+
+
+def test_e2e_resume(tmp_path):
+    """Kill-and-resume: second run restores from the checkpoint and continues."""
+    from datatunerx_tpu.tuning.train import run
+
+    argv, out, storage = _flags(
+        tmp_path, template="alpaca", max_steps="2", save_steps="2",
+        bf16="false", remat="none",
+    )
+    args = parse_train_args(argv)
+    r1 = run(args)
+    assert r1["steps"] == 2
+
+    argv2, _, _ = _flags(
+        tmp_path, template="alpaca", max_steps="4", save_steps="2",
+        bf16="false", remat="none",
+    )
+    args2 = parse_train_args(argv2)
+    r2 = run(args2)
+    assert r2["steps"] == 4
+    mf = json.load(open(os.path.join(storage, "test-uid-123", "manifest.json")))
+    assert mf["steps"] == 4
+
+
+def test_e2e_full_finetune_and_export(tmp_path):
+    from datatunerx_tpu.tuning.train import run
+    from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
+
+    export = str(tmp_path / "export")
+    argv, out, storage = _flags(
+        tmp_path, template="alpaca", max_steps="2", finetuning_type="full",
+        bf16="false", remat="none", export_dir=export,
+    )
+    args = parse_train_args(argv)
+    r = run(args)
+    assert r["steps"] == 2
+    assert os.path.exists(os.path.join(export, "model.npz"))
+    # exported model round-trips through the loader
+    cfg, params, tok = load_model_and_tokenizer(export)
+    assert cfg.num_layers == 2

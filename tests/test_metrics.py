@@ -1,0 +1,155 @@
+"""Prometheus remote-write wire format + jsonl logging.
+
+The reference pushes snappy-compressed protobuf WriteRequests
+(cmd/tuning/prometheus/metrics.py:21-39). These tests decode our hand-rolled
+encoding with an independent decoder and verify the reference's
+values-in-labels bug is NOT replicated (values are real samples)."""
+
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from datatunerx_tpu.training.metrics_log import (
+    MetricsLogger,
+    encode_write_request,
+    push_remote_write,
+    snappy_compress_literal,
+)
+
+
+# ---------------------------------------------------------- tiny decoders
+def snappy_decompress(data: bytes) -> bytes:
+    # varint uncompressed length
+    n, shift, i = 0, 0, 0
+    while True:
+        b = data[i]
+        n |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while i < len(data):
+        tag = data[i]
+        assert tag & 3 == 0, "test decoder handles literal elements only"
+        length = (tag >> 2) + 1
+        assert length <= 60
+        out += data[i + 1 : i + 1 + length]
+        i += 1 + length
+    assert len(out) == n
+    return bytes(out)
+
+
+def _read_varint(buf, i):
+    n, shift = 0, 0
+    while True:
+        b = buf[i]
+        n |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse_write_request(buf: bytes):
+    series = []
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        assert key == (1 << 3) | 2  # timeseries
+        ln, i = _read_varint(buf, i)
+        ts_buf, i = buf[i : i + ln], i + ln
+        labels, samples = {}, []
+        j = 0
+        while j < len(ts_buf):
+            k2, j = _read_varint(ts_buf, j)
+            ln2, j = _read_varint(ts_buf, j)
+            payload, j = ts_buf[j : j + ln2], j + ln2
+            if k2 == (1 << 3) | 2:  # Label
+                m = 0
+                kv = {}
+                while m < len(payload):
+                    k3, m = _read_varint(payload, m)
+                    ln3, m = _read_varint(payload, m)
+                    kv[k3 >> 3] = payload[m : m + ln3].decode()
+                    m += ln3
+                labels[kv[1]] = kv[2]
+            elif k2 == (2 << 3) | 2:  # Sample
+                m = 0
+                val, ts = None, None
+                while m < len(payload):
+                    k3, m = _read_varint(payload, m)
+                    if k3 == (1 << 3) | 1:
+                        val = struct.unpack("<d", payload[m : m + 8])[0]
+                        m += 8
+                    else:
+                        ts, m = _read_varint(payload, m)
+                samples.append((val, ts))
+        series.append((labels, samples))
+    return series
+
+
+def test_write_request_roundtrip():
+    body = encode_write_request(
+        {"dtx_train_loss": 1.25, "dtx_train_lr": 2e-4},
+        {"uid": "abc", "phase": "train"},
+        ts_ms=1234567,
+    )
+    series = parse_write_request(body)
+    assert len(series) == 2
+    by_name = {labels["__name__"]: (labels, samples) for labels, samples in series}
+    labels, samples = by_name["dtx_train_loss"]
+    assert labels["uid"] == "abc"
+    # the fix for the reference bug: value is the SAMPLE, not a label
+    assert samples == [(1.25, 1234567)]
+    assert "loss" not in labels.values()
+
+
+def test_snappy_literal_roundtrip():
+    for payload in (b"", b"x", b"hello world" * 50):
+        assert snappy_decompress(snappy_compress_literal(payload)) == payload
+
+
+def test_push_remote_write_live():
+    received = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            received["path"] = self.path
+            received["headers"] = dict(self.headers)
+            received["body"] = self.rfile.read(int(self.headers["Content-Length"]))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.handle_request, daemon=True)
+    t.start()
+    addr = f"http://127.0.0.1:{srv.server_port}"
+    ok = push_remote_write(addr, {"dtx_eval_perplexity": 9.5}, {"uid": "u1"})
+    t.join(timeout=5)
+    srv.server_close()
+    assert ok
+    assert received["path"] == "/api/v1/write"
+    assert received["headers"]["Content-Encoding"] == "snappy"
+    assert received["headers"]["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+    series = parse_write_request(snappy_decompress(received["body"]))
+    assert series[0][0]["__name__"] == "dtx_eval_perplexity"
+    assert series[0][1][0][0] == 9.5
+
+
+def test_push_remote_write_unreachable_never_raises():
+    assert push_remote_write("http://127.0.0.1:1", {"m": 1.0}, {}, timeout=0.2) is False
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    lg = MetricsLogger(str(tmp_path), total_steps=10)
+    lg.log_train(5, {"loss": 2.0, "lr": 1e-4})
+    lg.log_eval(5, {"eval_loss": 1.5, "perplexity": 4.48})
+    tl = json.loads(open(tmp_path / "watch" / "trainer_log.jsonl").read())
+    el = json.loads(open(tmp_path / "watch" / "eval_log.jsonl").read())
+    assert tl["percentage"] == 50.0 and tl["loss"] == 2.0
+    assert el["perplexity"] == 4.48

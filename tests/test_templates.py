@@ -1,0 +1,98 @@
+"""Golden-token parity of the template registry vs the reference implementation.
+
+Goldens were produced by executing the reference's template module against the
+same deterministic fake tokenizer (tests/goldens/gen_goldens.py); these tests
+pin our re-implementation to identical token streams for all 18 templates.
+"""
+
+import json
+import os
+
+import pytest
+
+from datatunerx_tpu.data.templates import get_template, list_templates
+from datatunerx_tpu.data.preprocess import encode_supervised_example
+from datatunerx_tpu.training.loss import IGNORE_INDEX
+from fake_tokenizer import FakeTokenizer
+
+GOLDENS = json.load(
+    open(os.path.join(os.path.dirname(__file__), "goldens", "templates.json"))
+)
+
+
+def _case_args(case):
+    history = [tuple(h) for h in case["history"]] if case["history"] else None
+    return case["query"], case["response"], history, case["system"]
+
+
+def test_all_reference_templates_present():
+    assert sorted(GOLDENS["templates"]) == list_templates()
+
+
+@pytest.mark.parametrize("name", sorted(GOLDENS["templates"]))
+@pytest.mark.parametrize("case", GOLDENS["cases"], ids=lambda c: c["id"])
+def test_template_matches_reference(name, case):
+    golden = GOLDENS["templates"][name][case["id"]]
+    tok = FakeTokenizer()
+    template = get_template(name, tok)
+    q, r, h, s = _case_args(case)
+
+    pairs = template.encode_turns(tok, q, r, h, s)
+    assert [[list(a), list(b)] for a, b in pairs] == golden["pairs"]
+
+    prompt, answer = template.encode_oneturn(tok, q, r, h, s)
+    assert [list(prompt), list(answer)] == golden["oneturn"]
+
+    assert tok.special_tokens_map == golden["specials"]
+
+
+def test_supervised_masking_semantics():
+    """Reference cmd/tuning/train.py:73-117: prompt masked, response trained."""
+    tok = FakeTokenizer()
+    template = get_template("llama2", tok)
+    ids, labels = encode_supervised_example(
+        template, tok, "hello", "world", cutoff_len=1024
+    )
+    assert len(ids) == len(labels)
+    pairs = template.encode_turns(tok, "hello", "world")
+    (src, tgt), = pairs
+    assert labels[: len(src)] == [IGNORE_INDEX] * len(src)
+    assert labels[len(src):] == tgt
+    assert ids == src + tgt
+
+
+def test_supervised_proportional_truncation():
+    tok = FakeTokenizer()
+    template = get_template("vanilla", tok)
+    long_q = "q" * 300
+    long_r = "r" * 100
+    ids, labels = encode_supervised_example(
+        template, tok, long_q, long_r, cutoff_len=100
+    )
+    assert len(ids) <= 100
+    n_src = sum(1 for l in labels if l == IGNORE_INDEX)
+    n_tgt = len(labels) - n_src
+    # proportional split: source gets ~3/4 of the budget
+    assert 70 <= n_src <= 78 and 20 <= n_tgt <= 28, (n_src, n_tgt)
+
+
+def test_supervised_efficient_eos_multiturn():
+    """efficient_eos: later turns carry eos as first label of the source span;
+    one final eos appended (reference train.py:97-106)."""
+    tok = FakeTokenizer()
+    template = get_template("chatml", tok)
+    ids, labels = encode_supervised_example(
+        template, tok, "b", "B", history=[("a", "A")], cutoff_len=1024
+    )
+    assert ids[-1] == tok.eos_token_id and labels[-1] == tok.eos_token_id
+    pairs = template.encode_turns(tok, "b", "B", history=[("a", "A")])
+    (s0, t0), (s1, t1) = pairs
+    # second turn's source span starts with eos in the labels
+    idx = len(s0) + len(t0)
+    assert labels[idx] == tok.eos_token_id
+    assert labels[idx + 1 : idx + len(s1)] == [IGNORE_INDEX] * (len(s1) - 1)
+
+
+def test_unknown_template_raises():
+    with pytest.raises(KeyError):
+        get_template("nope")
