@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 import dataclasses
 import time
+import typing
 from typing import Any, Dict, List, Optional
 
 GROUP_FINETUNE = "finetune.datatunerx.io/v1beta1"
@@ -53,9 +54,9 @@ class CustomResource:
     spec: Dict[str, Any] = dataclasses.field(default_factory=dict)
     status: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
-    # class attributes set by subclasses
-    api_version: str = ""
-    kind: str = ""
+    # class attributes set by subclasses (ClassVar: not dataclass fields)
+    api_version: typing.ClassVar[str] = ""
+    kind: typing.ClassVar[str] = ""
 
     @property
     def key(self) -> str:

@@ -1,0 +1,303 @@
+"""Cluster backends: where training and serving workloads actually run.
+
+The reference delegates to KubeRay (RayJob for training,
+finetune_controller.go:518-619; RayService for serving, generate.go:160-329).
+Controllers here talk to two small interfaces instead, so the same state
+machines drive:
+
+- LocalProcessBackend — host subprocesses running the trainer CLI / serving
+  server (CI, e2e tests, single-host dev);
+- ManifestBackend — renders GKE JobSet/Deployment manifests targeting TPU node
+  pools (``google.com/tpu`` resources + topology selectors, SURVEY.md §5.8);
+  submission is `kubectl apply` territory outside this sandbox;
+- FakeBackend — scripted transitions for controller unit tests (envtest-style,
+  SURVEY.md §4.1).
+
+Status vocabulary mirrors RayJob's deployment states the reference polls
+(finetune_controller.go:169-199): Pending | Running | Succeeded | Failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Protocol
+
+
+class TrainingBackend(Protocol):
+    def submit(self, name: str, spec: dict) -> None: ...
+
+    def status(self, name: str) -> str: ...
+
+    def delete(self, name: str) -> None: ...
+
+
+class ServingBackend(Protocol):
+    def deploy(self, name: str, spec: dict) -> None: ...
+
+    def status(self, name: str) -> str: ...  # HEALTHY | PENDING | FAILED
+
+    def endpoint(self, name: str) -> Optional[str]: ...
+
+    def delete(self, name: str) -> None: ...
+
+
+def _pkg_root() -> str:
+    """Directory containing the datatunerx_tpu package (for subprocess PYTHONPATH)."""
+    import datatunerx_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(datatunerx_tpu.__file__)))
+
+
+# ----------------------------------------------------------------- fakes
+
+class FakeTrainingBackend:
+    """Scripted backend: tests drive transitions explicitly."""
+
+    def __init__(self):
+        self.jobs: Dict[str, dict] = {}
+        self.states: Dict[str, str] = {}
+        self.deleted: List[str] = []
+
+    def submit(self, name, spec):
+        self.jobs[name] = spec
+        self.states.setdefault(name, "Pending")
+
+    def status(self, name):
+        return self.states.get(name, "NotFound")
+
+    def delete(self, name):
+        self.deleted.append(name)
+        self.states.pop(name, None)
+        self.jobs.pop(name, None)
+
+    # test helpers
+    def set_state(self, name, state):
+        self.states[name] = state
+
+
+class FakeServingBackend:
+    def __init__(self):
+        self.apps: Dict[str, dict] = {}
+        self.states: Dict[str, str] = {}
+        self.deleted: List[str] = []
+
+    def deploy(self, name, spec):
+        self.apps[name] = spec
+        self.states.setdefault(name, "PENDING")
+
+    def status(self, name):
+        return self.states.get(name, "NotFound")
+
+    def endpoint(self, name):
+        if self.states.get(name) == "HEALTHY":
+            return f"http://{name}.default.svc:8000"
+        return None
+
+    def delete(self, name):
+        self.deleted.append(name)
+        self.states.pop(name, None)
+        self.apps.pop(name, None)
+
+    def set_state(self, name, state):
+        self.states[name] = state
+
+
+# ---------------------------------------------------------- local process
+
+class LocalProcessBackend:
+    """Runs the trainer CLI as a subprocess per job; completion detected via
+    process exit + the completion manifest (training/checkpoint.py)."""
+
+    def __init__(self, workdir: str, extra_env: Optional[dict] = None):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.extra_env = extra_env or {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, name: str, spec: dict) -> None:
+        with self._lock:
+            if name in self._procs:
+                return
+            jobdir = os.path.join(self.workdir, name)
+            os.makedirs(jobdir, exist_ok=True)
+            argv = [sys.executable, "-m", "datatunerx_tpu.tuning.train"] + [
+                str(a) for a in spec["args"]
+            ]
+            with open(os.path.join(jobdir, "cmd.txt"), "w") as f:
+                f.write(shlex.join(argv))
+            log = open(os.path.join(jobdir, "log.txt"), "w")
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _pkg_root() + os.pathsep + env.get("PYTHONPATH", "")
+            env.update(self.extra_env)
+            env.update(spec.get("env", {}))
+            self._procs[name] = subprocess.Popen(
+                argv, cwd=jobdir, stdout=log, stderr=subprocess.STDOUT, env=env
+            )
+
+    def status(self, name: str) -> str:
+        with self._lock:
+            proc = self._procs.get(name)
+        if proc is None:
+            return "NotFound"
+        rc = proc.poll()
+        if rc is None:
+            return "Running"
+        return "Succeeded" if rc == 0 else "Failed"
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def log_tail(self, name: str, n: int = 40) -> str:
+        path = os.path.join(self.workdir, name, "log.txt")
+        try:
+            with open(path) as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return ""
+
+
+# -------------------------------------------------------------- manifests
+
+class ManifestBackend:
+    """Renders k8s manifests for GKE TPU node pools instead of submitting them.
+
+    Training → JobSet-style Job per TPU host group (replacing the reference's
+    RayCluster worker group with nvidia.com/gpu,
+    finetune_controller.go:576-609); Serving → Deployment + Service.
+    """
+
+    def __init__(self, out_dir: str, accelerator: str = "tpu-v5-lite-podslice",
+                 topology: str = "2x4"):
+        self.out_dir = out_dir
+        self.accelerator = accelerator
+        self.topology = topology
+        os.makedirs(out_dir, exist_ok=True)
+        self._submitted: Dict[str, dict] = {}
+
+    def render_training(self, name: str, spec: dict) -> dict:
+        hosts = int(spec.get("num_hosts", 1))
+        image = spec.get("image", "datatunerx-tpu/trainer:latest")
+        args = [str(a) for a in spec["args"]]
+        return {
+            "apiVersion": "jobset.x-k8s.io/v1alpha2",
+            "kind": "JobSet",
+            "metadata": {"name": name, "labels": spec.get("labels", {})},
+            "spec": {
+                "replicatedJobs": [{
+                    "name": "tpu-hosts",
+                    "replicas": 1,
+                    "template": {
+                        "spec": {
+                            "parallelism": hosts,
+                            "completions": hosts,
+                            "backoffLimit": 0,
+                            "template": {
+                                "metadata": {"labels": spec.get("labels", {})},
+                                "spec": {
+                                    "restartPolicy": "Never",
+                                    "nodeSelector": {
+                                        "cloud.google.com/gke-tpu-accelerator": self.accelerator,
+                                        "cloud.google.com/gke-tpu-topology": self.topology,
+                                    },
+                                    "containers": [{
+                                        "name": "trainer",
+                                        "image": image,
+                                        "command": ["python", "-m", "datatunerx_tpu.tuning.train"],
+                                        "args": args,
+                                        "env": [
+                                            {"name": "DTX_COORDINATOR_ADDRESS",
+                                             "value": f"{name}-tpu-hosts-0-0.{name}:8476"},
+                                            {"name": "DTX_NUM_PROCESSES", "value": str(hosts)},
+                                            {"name": "DTX_PROCESS_ID",
+                                             "valueFrom": {"fieldRef": {"fieldPath": (
+                                                 "metadata.annotations['batch.kubernetes.io/job-completion-index']")}}},
+                                        ] + [
+                                            {"name": k, "value": str(v)}
+                                            for k, v in spec.get("env", {}).items()
+                                        ],
+                                        "resources": {"limits": {"google.com/tpu": "4"}},
+                                    }],
+                                },
+                            },
+                        },
+                    },
+                }],
+            },
+        }
+
+    def render_serving(self, name: str, spec: dict) -> list:
+        labels = {"app": name, **spec.get("labels", {})}
+        deployment = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "nodeSelector": {
+                            "cloud.google.com/gke-tpu-accelerator": self.accelerator,
+                            **spec.get("node_selector", {}),
+                        },
+                        "tolerations": spec.get("tolerations", []),
+                        "containers": [{
+                            "name": "server",
+                            "image": spec.get("image", "datatunerx-tpu/serving:latest"),
+                            "command": ["python", "-m", "datatunerx_tpu.serving.server"],
+                            "args": [
+                                "--model_path", spec["model_path"],
+                                "--checkpoint_path", spec.get("checkpoint_path", ""),
+                                "--port", "8000",
+                            ],
+                            "ports": [{"containerPort": 8000}],
+                            "readinessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8000},
+                                "periodSeconds": 5,
+                            },
+                            "resources": {"limits": {"google.com/tpu": "4"}},
+                        }],
+                    },
+                },
+            },
+        }
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {
+                "selector": {"app": name},
+                "ports": [{"port": 8000, "targetPort": 8000}],
+            },
+        }
+        return [deployment, service]
+
+    def submit(self, name, spec):
+        manifest = self.render_training(name, spec)
+        self._submitted[name] = manifest
+        with open(os.path.join(self.out_dir, f"{name}-jobset.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+    def status(self, name):
+        return "Pending" if name in self._submitted else "NotFound"
+
+    def delete(self, name):
+        self._submitted.pop(name, None)
+        try:
+            os.remove(os.path.join(self.out_dir, f"{name}-jobset.json"))
+        except OSError:
+            pass

@@ -1,0 +1,177 @@
+"""Finetune controller: one training run (reference
+internal/controller/finetune/finetune_controller.go:81-237).
+
+State machine (reference :115-234):
+  "" → Init → (deps missing → Pending, retry) → submit training job →
+  Pending/Running (poll, requeue) → Succeeded → read completion manifest
+  (replaces pod-exec checkpoint-path scrape, :278-305) → status.llmCheckpoint →
+  create LLMCheckpoint provenance snapshot (:307-353,621-653) → Successful
+  | Failed (sticky terminal states, :115-123)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from datatunerx_tpu.operator import config
+from datatunerx_tpu.operator.api import (
+    Dataset,
+    Finetune,
+    FINETUNE_GROUP_FINALIZER,
+    Hyperparameter,
+    LLM,
+    LLMCheckpoint,
+    ObjectMeta,
+)
+from datatunerx_tpu.operator.errors import ErrRecalibrate
+from datatunerx_tpu.operator.generate import (
+    build_trainer_args,
+    generate_training_spec,
+    merge_hyperparameters,
+    rand_suffix,
+)
+from datatunerx_tpu.operator.labels import generate_instance_label
+from datatunerx_tpu.operator.reconciler import Result
+from datatunerx_tpu.operator.store import NotFound, ObjectStore, set_owner
+from datatunerx_tpu.training.checkpoint import read_manifest
+
+POLL_INTERVAL_S = 3.0  # reference finetune_controller.go:55 (3s requeue)
+RUNNING_POLL_S = 30.0  # reference :171,190
+
+
+class FinetuneController:
+    kind = Finetune
+
+    def __init__(self, backend, storage_path: Optional[str] = None):
+        self.backend = backend
+        self.storage_path = storage_path or config.get_storage_path()
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self, store: ObjectStore, ft: Finetune) -> Optional[Result]:
+        meta = ft.metadata
+
+        # deletion: tear down the training job, drop finalizer (reference :98-113)
+        if meta.deletion_timestamp:
+            self.backend.delete(meta.name)
+            if FINETUNE_GROUP_FINALIZER in meta.finalizers:
+                meta.finalizers.remove(FINETUNE_GROUP_FINALIZER)
+                store.update(ft)
+            return None
+
+        if FINETUNE_GROUP_FINALIZER not in meta.finalizers:
+            meta.finalizers.append(FINETUNE_GROUP_FINALIZER)
+            store.update(ft)
+            return Result(requeue_after=0)
+
+        state = ft.status.get("state", "")
+        if state in (Finetune.STATE_SUCCESSFUL, Finetune.STATE_FAILED):
+            return None  # terminal states are sticky (reference :115-123)
+
+        if state == "":
+            ft.status["state"] = Finetune.STATE_INIT
+            store.update(ft)
+            return Result(requeue_after=0)
+
+        # dependencies (reference :389-405: miss → Pending + retry)
+        dataset = store.try_get(Dataset, ft.spec.get("dataset", ""), meta.namespace)
+        hp_ref = ft.spec.get("hyperparameter", {}) or {}
+        hyperparameter = store.try_get(
+            Hyperparameter, hp_ref.get("hyperparameterRef", ""), meta.namespace
+        )
+        llm = store.try_get(LLM, ft.spec.get("llm", ""), meta.namespace)
+        if dataset is None or hyperparameter is None or llm is None:
+            if ft.status.get("state") != Finetune.STATE_PENDING:
+                ft.status["state"] = Finetune.STATE_PENDING
+                store.update(ft)
+            raise ErrRecalibrate(
+                f"{meta.namespace}/{meta.name}: waiting for dataset/hyperparameter/llm"
+            )
+
+        job_status = self.backend.status(meta.name)
+        if job_status == "NotFound":
+            params = merge_hyperparameters(
+                hyperparameter.spec.get("parameters", {}),
+                hp_ref.get("overrides"),
+            )
+            args = build_trainer_args(ft, dataset.spec, params, uid=meta.uid)
+            self.backend.submit(meta.name, generate_training_spec(ft, args))
+            ft.status["state"] = Finetune.STATE_PENDING
+            ft.status["jobInfo"] = {"jobName": meta.name, "backend": type(self.backend).__name__}
+            store.update(ft)
+            return Result(requeue_after=POLL_INTERVAL_S)
+
+        if job_status == "Pending":
+            return Result(requeue_after=POLL_INTERVAL_S)
+        if job_status == "Running":
+            if ft.status.get("state") != Finetune.STATE_RUNNING:
+                ft.status["state"] = Finetune.STATE_RUNNING
+                store.update(ft)
+            return Result(requeue_after=RUNNING_POLL_S)
+        if job_status == "Failed":
+            ft.status["state"] = Finetune.STATE_FAILED
+            store.update(ft)
+            return None
+        if job_status == "Succeeded":
+            return self._on_succeeded(store, ft)
+        return Result(requeue_after=POLL_INTERVAL_S)
+
+    # ------------------------------------------------------- success path
+    def _on_succeeded(self, store: ObjectStore, ft: Finetune) -> Optional[Result]:
+        meta = ft.metadata
+        manifest = read_manifest(self.storage_path, meta.uid)
+        if manifest is None:
+            # completion manifest not yet visible on shared storage
+            return Result(requeue_after=POLL_INTERVAL_S)
+
+        if not ft.status.get("llmCheckpoint"):
+            ft.status["llmCheckpoint"] = {
+                "llmCheckpointRef": f"{meta.name}-{rand_suffix()}",
+                "checkpointPath": manifest["checkpoint"],
+            }
+            store.update(ft)
+            return Result(requeue_after=0)
+
+        ref = ft.status["llmCheckpoint"]["llmCheckpointRef"]
+        if store.try_get(LLMCheckpoint, ref, meta.namespace) is None:
+            self._create_checkpoint_cr(store, ft, ref, manifest)
+
+        ft.status["state"] = Finetune.STATE_SUCCESSFUL
+        store.update(ft)
+        return None
+
+    def _create_checkpoint_cr(self, store, ft: Finetune, ref: str, manifest: dict):
+        """Provenance snapshot: deep-copied dependency specs (reference
+        generateLLMCheckpoint, finetune_controller.go:621-653)."""
+        meta = ft.metadata
+        dataset = store.try_get(Dataset, ft.spec.get("dataset", ""), meta.namespace)
+        hp = store.try_get(
+            Hyperparameter,
+            (ft.spec.get("hyperparameter") or {}).get("hyperparameterRef", ""),
+            meta.namespace,
+        )
+        llm = store.try_get(LLM, ft.spec.get("llm", ""), meta.namespace)
+        ckpt = LLMCheckpoint(
+            metadata=ObjectMeta(
+                name=ref,
+                namespace=meta.namespace,
+                labels=generate_instance_label(meta.name),
+            ),
+            spec={
+                "llm": {"llmRef": ft.spec.get("llm"),
+                        "spec": llm.spec if llm else None},
+                "dataset": {"datasetRef": ft.spec.get("dataset"),
+                            "spec": dataset.spec if dataset else None},
+                "hyperparameter": {
+                    "hyperparameterRef": (ft.spec.get("hyperparameter") or {}).get(
+                        "hyperparameterRef"
+                    ),
+                    "spec": hp.spec if hp else None,
+                },
+                "image": ft.spec.get("image"),
+                "checkpoint": manifest["checkpoint"],
+                "metrics": manifest.get("metrics", {}),
+            },
+        )
+        set_owner(ckpt, ft)
+        store.create(ckpt)

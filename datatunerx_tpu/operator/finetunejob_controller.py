@@ -1,0 +1,268 @@
+"""FinetuneJob controller: the central pipeline state machine (reference
+internal/controller/finetune/finetunejob_controller.go:71-560).
+
+  Init → precondition (deps exist, back-reference bookkeeping :213-257)
+       → create Finetune (:259-283, first pass → ErrRecalibrate 10s requeue)
+       → mirror Finetune status (:285-355)
+       → Finetune Successful → checkpoint-publish stage (replaces the
+         privileged image-bake Job, :310-344 — TPU serving mounts the
+         checkpoint URI directly, SURVEY.md §7.1; state name kept: BuildImage)
+       → deploy serving, health-gate (:357-466)
+       → create Scoring (built-in or plugin, :438-463)
+       → score set → Successful + serving teardown (:468-511)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from datatunerx_tpu.operator.api import (
+    Dataset,
+    Finetune,
+    FINETUNE_GROUP_FINALIZER,
+    FinetuneJob,
+    Hyperparameter,
+    LLM,
+    LLMCheckpoint,
+    Scoring,
+)
+from datatunerx_tpu.operator.errors import ErrRecalibrate
+from datatunerx_tpu.operator.generate import (
+    generate_builtin_scoring,
+    generate_finetune,
+    generate_plugin_scoring,
+    generate_serving_spec,
+)
+from datatunerx_tpu.operator.reconciler import Result
+from datatunerx_tpu.operator.store import AlreadyExists, NotFound, ObjectStore
+
+SERVE_POLL_S = 5.0
+
+
+class FinetuneJobController:
+    kind = FinetuneJob
+
+    def __init__(self, serving_backend):
+        self.serving = serving_backend
+
+    # re-enter when owned Finetune / Scoring change (reference Watches wiring,
+    # finetunejob_controller.go:162-206). Owner references already cover this
+    # via the manager; serving state changes are polled.
+
+    def reconcile(self, store: ObjectStore, job: FinetuneJob) -> Optional[Result]:
+        meta = job.metadata
+
+        if meta.deletion_timestamp:
+            return self._cleanup(store, job)
+
+        if FINETUNE_GROUP_FINALIZER not in meta.finalizers:
+            meta.finalizers.append(FINETUNE_GROUP_FINALIZER)
+            store.update(job)
+            return Result(requeue_after=0)
+
+        state = job.status.get("state", "")
+        if state in (FinetuneJob.STATE_SUCCESSFUL, FinetuneJob.STATE_FAILED):
+            return None
+
+        if state == "":
+            job.status["state"] = FinetuneJob.STATE_INIT
+            store.update(job)
+            return Result(requeue_after=0)
+
+        self._reconcile_precondition(store, job)
+
+        ft = self._reconcile_finetune_send(store, job)
+
+        result = self._reconcile_by_finetune_status(store, job, ft)
+        if result is not None:
+            return result
+
+        result = self._reconcile_serving(store, job)
+        if result is not None:
+            return result
+
+        return self._reconcile_by_scoring_status(store, job)
+
+    # ------------------------------------------------------- preconditions
+    def _reconcile_precondition(self, store: ObjectStore, job: FinetuneJob):
+        """Verify LLM/Hyperparameter/Dataset exist; append this job to their
+        status.referenceFinetuneName (reference :213-257)."""
+        ft_spec = job.spec.get("finetune", {}).get("finetuneSpec", {})
+        refs = [
+            (LLM, ft_spec.get("llm")),
+            (Hyperparameter,
+             (ft_spec.get("hyperparameter") or {}).get("hyperparameterRef")),
+            (Dataset, ft_spec.get("dataset")),
+        ]
+        missing = []
+        for kind, name in refs:
+            if not name:
+                missing.append(kind.kind)
+                continue
+            obj = store.try_get(kind, name, job.metadata.namespace)
+            if obj is None:
+                missing.append(f"{kind.kind}/{name}")
+                continue
+            back = obj.status.setdefault("referenceFinetuneName", [])
+            if job.metadata.name not in back:
+                back.append(job.metadata.name)
+                store.update(obj)
+        if missing:
+            raise ErrRecalibrate(
+                f"{job.metadata.namespace}/{job.metadata.name}: missing {missing}"
+            )
+
+    def _reconcile_finetune_send(self, store: ObjectStore, job: FinetuneJob) -> Finetune:
+        """Create the Finetune child on first pass (reference :259-283)."""
+        ft = generate_finetune(job)
+        existing = store.try_get(Finetune, ft.metadata.name, ft.metadata.namespace)
+        if existing is None:
+            store.create(ft)
+            raise ErrRecalibrate("finetune created; waiting for status")
+        return existing
+
+    # ----------------------------------------------------- finetune status
+    def _reconcile_by_finetune_status(
+        self, store: ObjectStore, job: FinetuneJob, ft: Finetune
+    ) -> Optional[Result]:
+        ft_state = ft.status.get("state", "")
+        job.status["finetuneStatus"] = dict(ft.status)
+
+        if ft_state in ("", Finetune.STATE_INIT, Finetune.STATE_PENDING,
+                        Finetune.STATE_RUNNING):
+            if job.status.get("state") != FinetuneJob.STATE_FINETUNE:
+                job.status["state"] = FinetuneJob.STATE_FINETUNE
+            store.update(job)
+            return Result(requeue_after=SERVE_POLL_S)
+
+        if ft_state == Finetune.STATE_FAILED:
+            job.status["state"] = FinetuneJob.STATE_FAILED
+            store.update(job)
+            return None
+
+        # Successful → checkpoint-publish stage (reference BuildImage, :296-344)
+        if job.status.get("state") == FinetuneJob.STATE_FINETUNE:
+            ckpt_info = ft.status.get("llmCheckpoint") or {}
+            ref = ckpt_info.get("llmCheckpointRef")
+            ckpt = store.try_get(LLMCheckpoint, ref, job.metadata.namespace) if ref else None
+            if ckpt is None:
+                return Result(requeue_after=SERVE_POLL_S)
+            # record the serving artifact pointers (reference fills
+            # CheckpointImage{Name, CheckPointPath, LLMPath}, :328-336)
+            ckpt.spec["checkpointImage"] = {
+                "name": f"ckpt-{job.metadata.name}-{time.strftime('%Y%m%d')}",
+                "checkPointPath": ckpt.spec.get("checkpoint"),
+                "llmPath": (ckpt.spec.get("image") or {}).get("path"),
+            }
+            store.update(ckpt)
+            job.status["state"] = FinetuneJob.STATE_BUILDIMAGE
+            job.status.setdefault("result", {})["modelExportResult"] = True
+            job.status["result"]["image"] = ckpt.spec["checkpointImage"]["name"]
+            job.status["result"]["checkpointPath"] = ckpt.spec.get("checkpoint")
+            store.update(job)
+            return Result(requeue_after=0)
+        return None
+
+    # -------------------------------------------------------------- serving
+    def _reconcile_serving(self, store: ObjectStore, job: FinetuneJob) -> Optional[Result]:
+        if job.status.get("state") not in (FinetuneJob.STATE_BUILDIMAGE,
+                                           FinetuneJob.STATE_SERVE):
+            return None
+
+        name = job.metadata.name
+        serve_status = self.serving.status(name)
+        if serve_status == "NotFound":
+            ckpt_ref = (job.status.get("finetuneStatus", {})
+                        .get("llmCheckpoint", {}) or {}).get("llmCheckpointRef")
+            ckpt = store.try_get(LLMCheckpoint, ckpt_ref, job.metadata.namespace)
+            info = {
+                "llmPath": (ckpt.spec.get("checkpointImage") or {}).get("llmPath")
+                if ckpt else None,
+                "checkpointPath": ckpt.spec.get("checkpoint") if ckpt else None,
+            }
+            self.serving.deploy(name, generate_serving_spec(job, {
+                "llmPath": info["llmPath"],
+                "checkpointPath": info["checkpointPath"],
+            }))
+            job.status["state"] = FinetuneJob.STATE_SERVE
+            store.update(job)
+            return Result(requeue_after=SERVE_POLL_S)
+
+        if serve_status != "HEALTHY":
+            if serve_status == "FAILED":
+                job.status["state"] = FinetuneJob.STATE_FAILED
+                store.update(job)
+                return None
+            return Result(requeue_after=SERVE_POLL_S)
+
+        # HEALTHY (reference gate :423-424) → record endpoints + create Scoring
+        endpoint = self.serving.endpoint(name) or f"http://{name}.{job.metadata.namespace}.svc:8000"
+        result = job.status.setdefault("result", {})
+        changed = result.get("serve") != endpoint
+        result["serve"] = endpoint
+        result["dashboard"] = endpoint.replace(":8000", ":8080")
+        inference_url = endpoint.rstrip("/") + "/chat/completions"  # reference :433
+
+        if store.try_get(Scoring, name, job.metadata.namespace) is None:
+            if job.spec.get("scoringPluginConfig") and job.spec["scoringPluginConfig"].get("name"):
+                scoring = generate_plugin_scoring(job, inference_url)
+            else:
+                scoring = generate_builtin_scoring(job, inference_url)
+            try:
+                store.create(scoring)
+            except AlreadyExists:
+                pass
+            changed = True
+        if changed:
+            store.update(job)
+        return None  # scoring watch / requeue drives the rest
+
+    # -------------------------------------------------------------- scoring
+    def _reconcile_by_scoring_status(self, store: ObjectStore, job: FinetuneJob) -> Optional[Result]:
+        if job.status.get("state") != FinetuneJob.STATE_SERVE:
+            return None
+        scoring = store.try_get(Scoring, job.metadata.name, job.metadata.namespace)
+        if scoring is None or scoring.status.get("score") is None:
+            return Result(requeue_after=SERVE_POLL_S)
+        # score set → Successful; tear down serving (reference :485-508)
+        job.status["state"] = FinetuneJob.STATE_SUCCESSFUL
+        job.status.setdefault("result", {})["score"] = scoring.status["score"]
+        job.status["stats"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        store.update(job)
+        self.serving.delete(job.metadata.name)
+        return None
+
+    # -------------------------------------------------------------- cleanup
+    def _cleanup(self, store: ObjectStore, job: FinetuneJob) -> Optional[Result]:
+        """Reference reconcileCleaner (:513-560): delete children, clear
+        back-references, drop finalizer."""
+        name, ns = job.metadata.name, job.metadata.namespace
+        self.serving.delete(name)
+        for kind, child in ((Scoring, name), (Finetune, f"{name}-finetune")):
+            try:
+                store.delete(kind, child, ns)
+            except NotFound:
+                pass
+        ft_name = job.spec.get("finetune", {}).get("name")
+        if ft_name:
+            try:
+                store.delete(Finetune, ft_name, ns)
+            except NotFound:
+                pass
+        ft_spec = job.spec.get("finetune", {}).get("finetuneSpec", {})
+        for kind, ref in (
+            (LLM, ft_spec.get("llm")),
+            (Hyperparameter, (ft_spec.get("hyperparameter") or {}).get("hyperparameterRef")),
+            (Dataset, ft_spec.get("dataset")),
+        ):
+            if not ref:
+                continue
+            obj = store.try_get(kind, ref, ns)
+            if obj and name in obj.status.get("referenceFinetuneName", []):
+                obj.status["referenceFinetuneName"].remove(name)
+                store.update(obj)
+        if FINETUNE_GROUP_FINALIZER in job.metadata.finalizers:
+            job.metadata.finalizers.remove(FINETUNE_GROUP_FINALIZER)
+            store.update(job)
+        return None

@@ -1,0 +1,129 @@
+"""Controller-manager entrypoint (reference main.go +
+cmd/controller-manager/app/controller_manager.go): wires store + webhooks +
+the three finetune controllers + the built-in scoring controller over a chosen
+backend pair, exposes health/metrics endpoints, and runs the reconcile loop.
+
+CLI flags mirror the reference options (reference
+cmd/controller-manager/app/options/options.go:38-48) where they still make
+sense; leader election and cert rotation are meaningless without a real API
+server and are accepted as no-ops for drop-in compatibility.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from datatunerx_tpu.operator.backends import (
+    FakeServingBackend,
+    FakeTrainingBackend,
+    LocalProcessBackend,
+    ManifestBackend,
+)
+from datatunerx_tpu.operator.finetune_controller import FinetuneController
+from datatunerx_tpu.operator.finetuneexperiment_controller import (
+    FinetuneExperimentController,
+)
+from datatunerx_tpu.operator.finetunejob_controller import FinetuneJobController
+from datatunerx_tpu.operator.reconciler import Manager
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.operator.webhooks import AdmittingStore
+
+
+def build_manager(
+    store: ObjectStore,
+    training_backend,
+    serving_backend,
+    storage_path: str | None = None,
+    with_scoring: bool = True,
+) -> Manager:
+    mgr = Manager(store)
+    mgr.register(FinetuneController(training_backend, storage_path=storage_path))
+    mgr.register(FinetuneJobController(serving_backend))
+    mgr.register(FinetuneExperimentController())
+    if with_scoring:
+        from datatunerx_tpu.scoring.controller import ScoringController
+
+        mgr.register(ScoringController())
+    return mgr
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    manager: Manager = None
+
+    def do_GET(self):
+        if self.path in ("/healthz", "/readyz"):
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+        elif self.path == "/metrics":
+            lines = [
+                "# TYPE dtx_operator_reconcile_errors_total counter",
+                f"dtx_operator_reconcile_errors_total {len(self.manager.errors)}",
+            ]
+            body = "\n".join(lines).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="datatunerx-tpu-controller-manager")
+    # reference options.go:38-48
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", default="false")  # accepted no-op
+    p.add_argument("--enable-cert-rotator", default="false")  # accepted no-op
+    # TPU-native options
+    p.add_argument("--persist-dir", default=None,
+                   help="JSON object store directory (durable CRs)")
+    p.add_argument("--backend", choices=["local", "manifest", "fake"],
+                   default="local")
+    p.add_argument("--workdir", default="/tmp/dtx-operator")
+    p.add_argument("--storage-path", default=None)
+    args = p.parse_args(argv)
+
+    store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
+    if args.backend == "local":
+        training = LocalProcessBackend(args.workdir)
+        from datatunerx_tpu.serving.local_backend import LocalServingBackend
+
+        serving = LocalServingBackend(args.workdir)
+    elif args.backend == "manifest":
+        training = ManifestBackend(args.workdir)
+        serving = FakeServingBackend()
+    else:
+        training, serving = FakeTrainingBackend(), FakeServingBackend()
+
+    mgr = build_manager(store, training, serving, storage_path=args.storage_path)
+
+    port = int(args.health_probe_bind_address.rsplit(":", 1)[-1])
+    _HealthHandler.manager = mgr
+    srv = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    mgr.sync_all()
+    mgr.start()
+    print(f"[controller-manager] running; health on :{port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mgr.stop()
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,10 @@ class ObjectStore:
                     f"{obj.kind} {k[1]}: rv {obj.metadata.resource_version} != "
                     f"{current.metadata.resource_version}"
                 )
+            if obj.to_dict() == current.to_dict():
+                # no-op update: no rv bump, no event (otherwise every
+                # reconcile-that-updates would re-enqueue itself forever)
+                return current.deepcopy()
             self._rv += 1
             obj = obj.deepcopy()
             obj.metadata.resource_version = self._rv

@@ -1,0 +1,185 @@
+"""Admission webhooks: validation + defaulting for the 5 webhook-registered
+kinds (reference cmd/controller-manager/app/controller_manager.go:114-134
+registers FinetuneJob, FinetuneExperiment, LLM, Hyperparameter, Dataset; the
+validate/default bodies live in the unvendored meta-server module, so rules
+here are re-derived from field semantics, SURVEY.md §2.3 + parser asserts,
+cmd/tuning/parser.py:211-221).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from datatunerx_tpu.operator.api import (
+    CustomResource,
+    Dataset,
+    FinetuneExperiment,
+    FinetuneJob,
+    Hyperparameter,
+    LLM,
+)
+
+SCHEDULERS = ("cosine", "linear", "constant", "constant_with_warmup",
+              "cosine_with_restarts", "polynomial")
+OPTIMIZERS = ("adamw", "adam", "sgd", "adafactor", "lion")
+LORA_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                "gate_proj", "up_proj", "down_proj")
+
+
+class AdmissionError(Exception):
+    pass
+
+
+def _require(cond: bool, msg: str):
+    if not cond:
+        raise AdmissionError(msg)
+
+
+# ------------------------------------------------------------- validators
+
+def validate_hyperparameter(obj: CustomResource):
+    p = obj.spec.get("parameters", {})
+    _require(isinstance(p, dict), "spec.parameters must be an object")
+    if p.get("scheduler"):
+        _require(str(p["scheduler"]).lower() in SCHEDULERS,
+                 f"scheduler must be one of {SCHEDULERS}")
+    if p.get("optimizer"):
+        _require(str(p["optimizer"]).lower() in OPTIMIZERS,
+                 f"optimizer must be one of {OPTIMIZERS}")
+    _require(not (_truthy(p.get("int4")) and _truthy(p.get("int8"))),
+             "int4 and int8 are mutually exclusive")
+    for key, lo, hi in (("loRA_Dropout", 0.0, 1.0), ("warmupRatio", 0.0, 1.0)):
+        if p.get(key) is not None:
+            v = _num(p[key], key)
+            _require(lo <= v <= hi, f"{key} must be in [{lo}, {hi}]")
+    for key in ("loRA_R", "epochs", "blockSize", "batchSize", "gradAccSteps"):
+        if p.get(key) is not None:
+            v = _num(p[key], key)
+            _require(v > 0, f"{key} must be positive")
+    if p.get("learningRate") is not None:
+        _require(_num(p["learningRate"], "learningRate") > 0,
+                 "learningRate must be positive")
+    if p.get("loRATarget"):
+        for t in str(p["loRATarget"]).split(","):
+            _require(t.strip() in LORA_TARGETS,
+                     f"invalid lora target {t.strip()!r}")
+
+
+def validate_dataset(obj: CustomResource):
+    info = obj.spec.get("datasetMetadata", {}).get("datasetInfo", {})
+    subsets = info.get("subsets")
+    _require(bool(subsets), "datasetInfo.subsets must not be empty")
+    train = subsets[0].get("splits", {}).get("train", {})
+    _require(bool(train.get("file")), "subsets[0].splits.train.file is required")
+    for f in info.get("features", []) or []:
+        _require(f.get("name") in ("instruction", "response"),
+                 "feature name must be 'instruction' or 'response'")
+        _require(bool(f.get("mapTo")), "feature mapTo is required")
+
+
+def validate_llm(obj: CustomResource):
+    _require(bool(obj.metadata.name), "llm name required")
+
+
+def validate_finetunejob(obj: CustomResource):
+    ft = obj.spec.get("finetune", {})
+    _require(isinstance(ft, dict) and bool(ft.get("finetuneSpec")),
+             "spec.finetune.finetuneSpec is required")
+    spec = ft["finetuneSpec"]
+    for key in ("llm", "dataset"):
+        _require(bool(spec.get(key)), f"finetuneSpec.{key} is required")
+    _require(bool((spec.get("hyperparameter") or {}).get("hyperparameterRef")),
+             "finetuneSpec.hyperparameter.hyperparameterRef is required")
+    node = spec.get("node", 1)
+    _require(int(node) >= 1, "finetuneSpec.node must be >= 1")
+    plugin = obj.spec.get("scoringPluginConfig")
+    if plugin and plugin.get("name") is not None:
+        _require(bool(str(plugin["name"]).strip()),
+                 "scoringPluginConfig.name must be non-empty when set")
+
+
+def validate_finetuneexperiment(obj: CustomResource):
+    jobs = obj.spec.get("finetuneJobs")
+    _require(bool(jobs), "spec.finetuneJobs must not be empty")
+    names = [j.get("name") for j in jobs]
+    _require(all(names), "every finetuneJobs entry needs a name")
+    _require(len(set(names)) == len(names), "finetuneJobs names must be unique")
+    for j in jobs:
+        shim = FinetuneJob(metadata=obj.metadata, spec=j.get("spec", {}))
+        validate_finetunejob(shim)
+
+
+# -------------------------------------------------------------- defaulters
+
+def default_finetunejob(obj: CustomResource):
+    spec = obj.spec.setdefault("finetune", {}).setdefault("finetuneSpec", {})
+    spec.setdefault("node", 1)
+    obj.spec.setdefault("serveConfig", {})
+
+
+def default_hyperparameter(obj: CustomResource):
+    p = obj.spec.setdefault("parameters", {})
+    p.setdefault("scheduler", "cosine")
+    p.setdefault("optimizer", "adamw")
+    p.setdefault("loRA_R", "8")
+    p.setdefault("loRA_Alpha", "32")
+    p.setdefault("loRA_Dropout", "0.1")
+    p.setdefault("learningRate", "2e-4")
+    p.setdefault("epochs", "1")
+    p.setdefault("blockSize", "1024")
+    p.setdefault("batchSize", "4")
+    p.setdefault("gradAccSteps", "1")
+    p.setdefault("PEFT", "true")
+
+
+VALIDATORS: Dict[str, Callable] = {
+    Hyperparameter.kind: validate_hyperparameter,
+    Dataset.kind: validate_dataset,
+    LLM.kind: validate_llm,
+    FinetuneJob.kind: validate_finetunejob,
+    FinetuneExperiment.kind: validate_finetuneexperiment,
+}
+DEFAULTERS: Dict[str, Callable] = {
+    FinetuneJob.kind: default_finetunejob,
+    Hyperparameter.kind: default_hyperparameter,
+}
+
+
+def admit(obj: CustomResource) -> CustomResource:
+    """Defaulting then validation — raises AdmissionError on rejection."""
+    defaulter = DEFAULTERS.get(obj.kind)
+    if defaulter:
+        defaulter(obj)
+    validator = VALIDATORS.get(obj.kind)
+    if validator:
+        validator(obj)
+    return obj
+
+
+class AdmittingStore:
+    """Store wrapper applying admission on create/update (webhook-equivalent
+    choke point, since there is no API server in front)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def create(self, obj):
+        return self._store.create(admit(obj))
+
+    def update(self, obj):
+        admit(obj)
+        return self._store.update(obj)
+
+
+def _truthy(v) -> bool:
+    return str(v).lower() in ("true", "1", "yes")
+
+
+def _num(v, key: str) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise AdmissionError(f"{key} must be numeric, got {v!r}")

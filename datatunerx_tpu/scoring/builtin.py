@@ -1,0 +1,61 @@
+"""Built-in scorer: drives the inference service and produces a 0-100 score.
+
+The reference delegates scoring to a sibling-repo operator that POSTs to the
+job's ``/chat/completions`` endpoint and writes ``Scoring.Status.Score``
+(SURVEY.md §2.3 Scoring, §3.4). This is our in-tree equivalent: a fixed (or
+CR-parameterized) probe set is sent to the endpoint; answers are scored with
+ROUGE-L/BLEU against references, averaged, and scaled to 0-100. Scores stay
+strings end-to-end for API parity (reference quirk, util.go:24-30)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, List, Optional
+
+from datatunerx_tpu.scoring.metrics import generation_scores
+
+DEFAULT_PROBES: List[Dict[str, str]] = [
+    {"prompt": "What is the capital of France?", "reference": "Paris"},
+    {"prompt": "What is 2 + 2?", "reference": "4"},
+    {"prompt": "Name the largest planet in our solar system.", "reference": "Jupiter"},
+    {"prompt": "What color is a clear daytime sky?", "reference": "blue"},
+    {"prompt": "Who wrote the play Hamlet?", "reference": "William Shakespeare"},
+]
+
+
+def query_chat(endpoint: str, prompt: str, timeout: float = 60.0,
+               max_tokens: int = 64) -> str:
+    req = urllib.request.Request(
+        endpoint,
+        data=json.dumps({
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "temperature": 0.0,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = json.load(resp)
+    return payload["choices"][0]["message"]["content"]
+
+
+def score_endpoint(
+    inference_url: str,
+    probes: Optional[List[Dict[str, str]]] = None,
+    timeout: float = 60.0,
+) -> Dict:
+    """Returns {"score": "NN.N", "details": [...]}; raises on transport errors
+    so the controller can retry."""
+    probes = probes or DEFAULT_PROBES
+    details = []
+    total = 0.0
+    for probe in probes:
+        answer = query_chat(inference_url, probe["prompt"], timeout=timeout)
+        s = generation_scores(answer, probe["reference"])
+        per = max(s["rouge-l"], s["bleu-4"])
+        total += per
+        details.append({"prompt": probe["prompt"], "answer": answer, **s})
+    final = 100.0 * total / max(len(probes), 1)
+    return {"score": f"{final:.1f}", "details": details}

@@ -1,0 +1,60 @@
+"""Scoring controller: reconciles Scoring CRs by driving the inference
+endpoint and writing status.score.
+
+The reference keeps this in a sibling-repo operator and only creates/watches
+the CR (SURVEY.md §2.3 Scoring); here it's in-tree so the pipeline is
+self-contained. Built-in path uses the probe scorer; plugin path resolves the
+named plugin (reference plugin contract, generate.go:343-358).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from datatunerx_tpu.operator.api import Scoring
+from datatunerx_tpu.operator.reconciler import Result
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.scoring.builtin import score_endpoint
+from datatunerx_tpu.scoring.plugin import run_plugin
+
+RETRY_S = 10.0
+
+
+class ScoringController:
+    kind = Scoring
+
+    def __init__(self, timeout: float = 120.0):
+        self.timeout = timeout
+
+    def reconcile(self, store: ObjectStore, scoring: Scoring) -> Optional[Result]:
+        if scoring.metadata.deletion_timestamp:
+            return None
+        if scoring.status.get("score") is not None:
+            return None  # done
+
+        url = scoring.spec.get("inferenceService")
+        if not url:
+            scoring.status["error"] = "spec.inferenceService is required"
+            store.update(scoring)
+            return None
+
+        plugin = scoring.spec.get("plugin") or {}
+        try:
+            if plugin.get("loadPlugin"):
+                score = run_plugin(plugin.get("name", ""), url,
+                                   plugin.get("parameters"))
+                details = None
+            else:
+                result = score_endpoint(url, timeout=self.timeout)
+                score, details = result["score"], result["details"]
+        except Exception as e:  # endpoint not ready / transient — retry
+            scoring.status["lastError"] = str(e)[:500]
+            store.update(scoring)
+            return Result(requeue_after=RETRY_S)
+
+        scoring.status["score"] = str(score)
+        if details is not None:
+            scoring.status["details"] = details
+        scoring.status.pop("lastError", None)
+        store.update(scoring)
+        return None

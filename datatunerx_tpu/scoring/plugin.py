@@ -1,0 +1,35 @@
+"""Scoring plugins: the Scoring CR's ``plugin{loadPlugin, name, parameters}``
+contract (reference pkg/util/generate/generate.go:343-358).
+
+A plugin is a Python entrypoint ``module:function`` (or a registered name)
+called as ``fn(inference_url, parameters) -> str | float`` returning the score.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_plugin(name: str, fn: Callable) -> None:
+    _REGISTRY[name] = fn
+
+
+def resolve_plugin(name: str) -> Callable:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    if ":" in name:
+        module, _, attr = name.partition(":")
+        mod = importlib.import_module(module)
+        return getattr(mod, attr)
+    raise KeyError(
+        f"scoring plugin {name!r} not registered and not a module:function path"
+    )
+
+
+def run_plugin(name: str, inference_url: str, parameters) -> str:
+    fn = resolve_plugin(name)
+    result = fn(inference_url, parameters)
+    return str(result)

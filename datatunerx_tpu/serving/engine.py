@@ -1,0 +1,184 @@
+"""Inference engine: jitted prefill + KV-cache decode for chat serving.
+
+Replaces the reference's Ray Serve ``LlamaDeployment`` (deployed from a zip,
+reference internal/controller/finetune/finetunejob_controller.go:378-384; env
+contract BASE_MODEL_DIR + CHECKPOINT_DIR, pkg/util/generate/generate.go:288-294).
+TPU-native: the base model + (optionally) a LoRA adapter checkpoint are loaded
+directly (no image bake) and merged for serving; generation runs as a jitted
+per-token decode step over a static-shape KV cache (JetStream-style decode loop,
+SURVEY.md §7.1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datatunerx_tpu.data.templates import Template, get_template
+from datatunerx_tpu.models.llama import forward, init_cache
+from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model_path: str,
+        checkpoint_path: Optional[str] = None,
+        template: str = "llama2",
+        max_seq_len: int = 1024,
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
+            model_path, dtype=dtype
+        )
+        if checkpoint_path:
+            self._apply_checkpoint(checkpoint_path)
+        self.template: Template = get_template(template, self.tokenizer)
+        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
+        self._decode_step = jax.jit(self._decode_step_impl)
+        self._prefill = jax.jit(self._prefill_impl, static_argnames=("prompt_len",))
+
+    # ---------------------------------------------------------- checkpoint
+    def _apply_checkpoint(self, checkpoint_path: str):
+        """Merge a trained adapter (or swap full params) from an Orbax
+        TrainState checkpoint or an exported model.npz directory."""
+        if os.path.isdir(checkpoint_path) and os.path.exists(
+            os.path.join(checkpoint_path, "model.npz")
+        ):
+            from datatunerx_tpu.utils.hf_convert import convert_hf_state_dict
+
+            sd = dict(np.load(os.path.join(checkpoint_path, "model.npz")))
+            self.params = convert_hf_state_dict(sd, self.cfg, dtype=np.float32)
+            return
+        # Orbax checkpoint dir (…/checkpoints or …/checkpoints/<step>)
+        import orbax.checkpoint as ocp
+
+        root = checkpoint_path.rstrip("/")
+        step: Optional[int] = None
+        if os.path.basename(root).isdigit():
+            step = int(os.path.basename(root))
+            root = os.path.dirname(root)
+        mngr = ocp.CheckpointManager(root)
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_path}")
+        restored = mngr.restore(step)
+        mngr.close()
+        state = restored if isinstance(restored, dict) else dict(restored)
+        lora = state.get("lora")
+        if lora:
+            from datatunerx_tpu.models.lora import lora_scaling, merge_lora
+
+            # scaling travels in the manifest; default alpha/r = 32/8 matches
+            # the reference defaults (cmd/tuning/parser.py:138-145)
+            rank = next(iter(lora["layers"].values()))["a"].shape[-1]
+            self.params = merge_lora(self.params, lora, lora_scaling(32.0, rank))
+        elif state.get("params"):
+            self.params = state["params"]
+
+    # ------------------------------------------------------------ generate
+    def _prefill_impl(self, params, tokens, cache, prompt_len):
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+        logits, cache = forward(
+            params, tokens, self.cfg, positions=positions, cache=cache,
+            compute_dtype=jnp.bfloat16,
+        )
+        return logits[:, prompt_len - 1], cache
+
+    def _decode_step_impl(self, params, token, position, cache):
+        logits, cache = forward(
+            params, token, self.cfg, positions=position[None, None],
+            cache=cache, compute_dtype=jnp.bfloat16,
+        )
+        return logits[:, -1], cache
+
+    def generate(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        stop_ids: Optional[set] = None,
+    ) -> List[int]:
+        stop_ids = stop_ids or {self.tokenizer.eos_token_id}
+        prompt_ids = prompt_ids[-(self.max_seq_len - max_new_tokens):]
+        total = len(prompt_ids) + max_new_tokens
+        cache = init_cache(self.cfg, 1, total, dtype=jnp.bfloat16)
+
+        tokens = jnp.asarray([prompt_ids], jnp.int32)
+        logits, cache = self._prefill(self.params, tokens, cache,
+                                      prompt_len=len(prompt_ids))
+        rng = jax.random.PRNGKey(seed)
+        out: List[int] = []
+        pos = len(prompt_ids)
+        for i in range(max_new_tokens):
+            rng, sub = jax.random.split(rng)
+            nxt = int(_sample(logits[0], temperature, top_p, sub))
+            if nxt in stop_ids:
+                break
+            out.append(nxt)
+            logits, cache = self._decode_step(
+                self.params, jnp.asarray([[nxt]], jnp.int32),
+                jnp.asarray(pos, jnp.int32), cache,
+            )
+            pos += 1
+        return out
+
+    def chat(
+        self,
+        messages: List[dict],
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> str:
+        """OpenAI-ish messages → templated prompt → completion text."""
+        system = None
+        history: List[tuple] = []
+        query = ""
+        pending_user: Optional[str] = None
+        for m in messages:
+            role, content = m.get("role"), m.get("content", "")
+            if role == "system":
+                system = content
+            elif role == "user":
+                if pending_user is not None:
+                    history.append((pending_user, ""))
+                pending_user = content
+            elif role == "assistant" and pending_user is not None:
+                history.append((pending_user, content))
+                pending_user = None
+        query = pending_user or ""
+
+        prompt_ids, _ = self.template.encode_oneturn(
+            self.tokenizer, query, "", history or None, system
+        )
+        stop_ids = {self.tokenizer.eos_token_id}
+        for w in self.template.stop_words:
+            stop_ids.add(self.tokenizer.convert_tokens_to_ids(w))
+        out_ids = self.generate(
+            prompt_ids, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_p=top_p, seed=seed, stop_ids=stop_ids,
+        )
+        return self.tokenizer.decode(out_ids, skip_special_tokens=True)
+
+
+def _sample(logits: jnp.ndarray, temperature: float, top_p: float, rng) -> int:
+    if temperature <= 0.0:
+        return int(jnp.argmax(logits))
+    logits = logits / temperature
+    if top_p < 1.0:
+        sorted_idx = jnp.argsort(-logits)
+        sorted_logits = logits[sorted_idx]
+        probs = jax.nn.softmax(sorted_logits)
+        cum = jnp.cumsum(probs)
+        cut = cum - probs > top_p  # keep tokens until cumulative mass > top_p
+        sorted_logits = jnp.where(cut, -jnp.inf, sorted_logits)
+        choice = jax.random.categorical(rng, sorted_logits)
+        return int(sorted_idx[choice])
+    return int(jax.random.categorical(rng, logits))

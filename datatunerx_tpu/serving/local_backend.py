@@ -1,0 +1,92 @@
+"""Local serving backend: subprocess server per job + /healthz polling.
+
+The ServingBackend implementation used by the local pipeline (CI/e2e/dev);
+status() maps the server's health gate onto the vocabulary the FinetuneJob
+controller polls (HEALTHY gate parity with reference
+finetunejob_controller.go:423-424).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import urllib.request
+from typing import Dict, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalServingBackend:
+    def __init__(self, workdir: str, template: str = "vanilla",
+                 extra_env: dict | None = None):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.template = template
+        self.extra_env = extra_env or {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._ports: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def deploy(self, name: str, spec: dict) -> None:
+        with self._lock:
+            if name in self._procs:
+                return
+            port = _free_port()
+            appdir = os.path.join(self.workdir, f"serve-{name}")
+            os.makedirs(appdir, exist_ok=True)
+            log = open(os.path.join(appdir, "log.txt"), "w")
+            argv = [
+                sys.executable, "-m", "datatunerx_tpu.serving.server",
+                "--model_path", spec["model_path"],
+                "--checkpoint_path", spec.get("checkpoint_path") or "",
+                "--template", spec.get("template", self.template),
+                "--port", str(port),
+            ]
+            from datatunerx_tpu.operator.backends import _pkg_root
+
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _pkg_root() + os.pathsep + env.get("PYTHONPATH", "")
+            env.update(self.extra_env)
+            self._procs[name] = subprocess.Popen(
+                argv, cwd=appdir, stdout=log, stderr=subprocess.STDOUT, env=env
+            )
+            self._ports[name] = port
+
+    def status(self, name: str) -> str:
+        with self._lock:
+            proc = self._procs.get(name)
+            port = self._ports.get(name)
+        if proc is None:
+            return "NotFound"
+        if proc.poll() is not None:
+            return "FAILED"
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2
+            ) as resp:
+                return json.load(resp).get("status", "PENDING")
+        except Exception:
+            return "PENDING"
+
+    def endpoint(self, name: str) -> Optional[str]:
+        port = self._ports.get(name)
+        return f"http://127.0.0.1:{port}" if port else None
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(name, None)
+            self._ports.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -1,0 +1,138 @@
+"""Serving HTTP server: OpenAI-ish ``/chat/completions`` + health gating.
+
+Endpoint contract matches what the reference pipeline consumes
+(reference finetunejob_controller.go:433 builds
+``http://<svc>:8000/chat/completions``; the Scoring operator POSTs there).
+Health semantics replace KubeRay's application-level HEALTHY gate
+(finetunejob_controller.go:423-424): ``/healthz`` returns 503 until the model
+is fully loaded, then 200 — so a k8s readinessProbe gives the same
+"model actually loaded" guarantee.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class ServingState:
+    def __init__(self):
+        self.engine = None
+        self.error: Optional[str] = None
+        self.model_path = ""
+
+
+STATE = ServingState()
+
+
+class Handler(BaseHTTPRequestHandler):
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            if STATE.engine is not None:
+                self._json(200, {"status": "HEALTHY", "model": STATE.model_path})
+            elif STATE.error:
+                self._json(500, {"status": "FAILED", "error": STATE.error})
+            else:
+                self._json(503, {"status": "LOADING"})
+        elif self.path == "/v1/models":
+            self._json(200, {"object": "list", "data": [
+                {"id": STATE.model_path, "object": "model"}]})
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path not in ("/chat/completions", "/v1/chat/completions"):
+            self._json(404, {"error": "not found"})
+            return
+        if STATE.engine is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._json(400, {"error": f"invalid JSON body: {e}"})
+                return
+            messages = req.get("messages")
+            if not isinstance(messages, list) or not messages:
+                self._json(400, {"error": "messages must be a non-empty list"})
+                return
+            text = STATE.engine.chat(
+                messages,
+                max_new_tokens=int(req.get("max_tokens", 128)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_p=float(req.get("top_p", 1.0)),
+            )
+            self._json(200, {
+                "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": STATE.model_path,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "stop",
+                }],
+            })
+        except Exception as e:  # noqa: BLE001 - serving must answer, not die
+            self._json(500, {"error": str(e)})
+
+    def log_message(self, *a):
+        pass
+
+
+def load_engine_async(model_path, checkpoint_path, template, max_seq_len):
+    def _load():
+        try:
+            from datatunerx_tpu.serving.engine import InferenceEngine
+
+            STATE.model_path = model_path
+            STATE.engine = InferenceEngine(
+                model_path, checkpoint_path or None, template=template,
+                max_seq_len=max_seq_len,
+            )
+        except Exception as e:  # noqa: BLE001
+            STATE.error = str(e)
+
+    t = threading.Thread(target=_load, daemon=True)
+    t.start()
+    return t
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="datatunerx-tpu-serving")
+    p.add_argument("--model_path", required=True)
+    p.add_argument("--checkpoint_path", default="")
+    p.add_argument("--template", default="llama2")
+    p.add_argument("--max_seq_len", type=int, default=1024)
+    p.add_argument("--port", type=int, default=8000)
+    args = p.parse_args(argv)
+
+    load_engine_async(args.model_path, args.checkpoint_path, args.template,
+                      args.max_seq_len)
+    srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from datatunerx_tpu.models.config import ModelConfig
@@ -300,9 +301,18 @@ class Trainer:
         return self._eval_step(state, batch)
 
     def _put_batch(self, batch, accum: bool = False):
+        """Batches handed to the Trainer are HOST-LOCAL slices. Single-process
+        (host slice == global batch): plain device_put. Multi-host: assemble
+        the global array from per-process slices — device_put would misread
+        the local slice as the global array (half the data silently dropped)."""
         if self.mesh is not None:
             flat = {k: v for k, v in batch.items() if v is not None}
             sh = batch_shardings(flat, self.mesh, accum=accum)
+            if jax.process_count() > 1:
+                return {
+                    k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))
+                    for k, v in flat.items()
+                }
             return {
                 k: jax.device_put(v, sh[k]) for k, v in flat.items()
             }

@@ -58,6 +58,12 @@ def run(args: TrainArgs) -> dict:
         config_overrides=overrides,
     )
 
+    # export-only invocation: --export_dir with no --train_path
+    if args.train_path is None:
+        export_merged_model(jax.device_get(params), cfg, args.export_dir)
+        return {"steps": 0, "metrics": {}, "manifest": None,
+                "checkpoint_dir": None, "export_dir": args.export_dir}
+
     # ----- data --------------------------------------------------------
     template = get_template(args.template, tokenizer)
     pad_id = tokenizer.pad_token_id or 0
@@ -171,12 +177,19 @@ def run(args: TrainArgs) -> dict:
             if args.save_steps > 0:
                 ckpt.maybe_save(state, step)
             if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
-                _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main)
+                _run_eval(trainer, state, eval_examples, args, pad_id, logger,
+                          step, is_main, dist)
+        if (eval_examples and args.eval_steps == 0 and not done
+                and step < total_steps):
+            # eval_steps=0 → once per epoch (final epoch's eval happens below)
+            _run_eval(trainer, state, eval_examples, args, pad_id, logger,
+                      step, is_main, dist)
 
     # ----- final eval / save / manifest --------------------------------
     if eval_examples:
         final_metrics.update(
-            _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main)
+            _run_eval(trainer, state, eval_examples, args, pad_id, logger,
+                      step, is_main, dist)
         )
     ckpt.maybe_save(state, step, force=True)
 
@@ -210,7 +223,8 @@ def run(args: TrainArgs) -> dict:
     }
 
 
-def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main):
+def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
+              is_main, dist):
     data_par = 1
     if trainer.mesh is not None:
         data_par = trainer.mesh.shape["dp"] * trainer.mesh.shape["fsdp"]
@@ -221,6 +235,8 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step, is_main
         pad_id=pad_id,
         shuffle=False,
         drop_remainder=False,  # pad the tail: every eval example counts
+        host_id=dist["process_id"],
+        num_hosts=dist["num_processes"],
     )
     m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
                                  for b in eval_it.epoch(0)))

@@ -155,3 +155,33 @@ def test_e2e_full_finetune_and_export(tmp_path):
     # exported model round-trips through the loader
     cfg, params, tok = load_model_and_tokenizer(export)
     assert cfg.num_layers == 2
+
+
+def test_export_only_invocation(tmp_path):
+    """--export_dir without --train_path exports and exits cleanly."""
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    export = str(tmp_path / "exp")
+    args = parse_train_args([
+        "--model_name_or_path", "preset:debug", "--export_dir", export,
+        "--storage_path", str(tmp_path / "s"), "--bf16", "false",
+    ])
+    r = run(args)
+    assert r["steps"] == 0
+    assert os.path.exists(os.path.join(export, "model.npz"))
+
+
+def test_eval_once_per_epoch(tmp_path):
+    """eval_steps=0 (default) evaluates at each epoch boundary + final."""
+    from datatunerx_tpu.tuning.train import main
+
+    argv, out, storage = _flags(
+        tmp_path, template="alpaca", num_train_epochs="2", logging_steps="1",
+        bf16="false", remat="none",
+    )
+    # drop the max_steps-free run to 2 epochs of 3 steps: 96 rows / gb 32 = 3
+    assert main(argv) == 0
+    eval_log = [json.loads(l) for l in open(os.path.join(out, "watch", "eval_log.jsonl"))]
+    # one mid-epoch eval (after epoch 1) + final eval
+    assert len(eval_log) == 2, eval_log

@@ -1,0 +1,439 @@
+"""Controller state-machine tests (envtest-equivalent, SURVEY.md §4.1):
+reconcilers run against the in-memory store + fake backends; assertions cover
+the transitions in SURVEY.md §2.3/§3 — including the key one: Scoring.Score
+set ⇒ job Successful + serving torn down (reference
+finetunejob_controller.go:485-508)."""
+
+import json
+import os
+
+import pytest
+
+from datatunerx_tpu.operator.api import (
+    Dataset,
+    Finetune,
+    FinetuneExperiment,
+    FinetuneJob,
+    Hyperparameter,
+    LLM,
+    LLMCheckpoint,
+    ObjectMeta,
+    Scoring,
+)
+from datatunerx_tpu.operator.backends import FakeServingBackend, FakeTrainingBackend
+from datatunerx_tpu.operator.manager import build_manager
+from datatunerx_tpu.operator.store import AlreadyExists, Conflict, NotFound, ObjectStore
+from datatunerx_tpu.operator.webhooks import AdmissionError, AdmittingStore, admit
+from datatunerx_tpu.training.checkpoint import write_manifest
+
+
+# ------------------------------------------------------------ fixtures
+
+def _seed_deps(store, ns="default"):
+    store.create(LLM(metadata=ObjectMeta(name="llama2-7b", namespace=ns),
+                     spec={"path": "/models/llama2-7b"}))
+    store.create(Hyperparameter(
+        metadata=ObjectMeta(name="hp-a", namespace=ns),
+        spec={"parameters": {
+            "scheduler": "cosine", "optimizer": "adamw", "loRA_R": "8",
+            "loRA_Alpha": "32", "loRA_Dropout": "0.1", "learningRate": "2e-4",
+            "epochs": "1", "blockSize": "512", "batchSize": "2",
+            "gradAccSteps": "1", "PEFT": "true", "FP16": "false",
+        }},
+    ))
+    store.create(Dataset(
+        metadata=ObjectMeta(name="ds-a", namespace=ns),
+        spec={"datasetMetadata": {"datasetInfo": {
+            "subsets": [{"splits": {
+                "train": {"file": "/data/train.csv"},
+                "validate": {"file": "/data/val.csv"},
+            }}],
+            "features": [
+                {"name": "instruction", "mapTo": "q"},
+                {"name": "response", "mapTo": "a"},
+            ],
+        }}},
+    ))
+
+
+def _job_spec(suffix=""):
+    return {
+        "finetune": {
+            "name": f"job{suffix}-finetune",
+            "finetuneSpec": {
+                "llm": "llama2-7b",
+                "dataset": "ds-a",
+                "hyperparameter": {"hyperparameterRef": "hp-a"},
+                "image": {"name": "img", "path": "/models/llama2-7b"},
+                "node": 1,
+            },
+        },
+    }
+
+
+@pytest.fixture()
+def world(tmp_path):
+    store = ObjectStore()
+    training = FakeTrainingBackend()
+    serving = FakeServingBackend()
+    mgr = build_manager(store, training, serving,
+                        storage_path=str(tmp_path / "storage"),
+                        with_scoring=False)
+    _seed_deps(store)
+    return store, training, serving, mgr, str(tmp_path / "storage")
+
+
+# ---------------------------------------------------------------- store
+
+def test_store_crud_conflict_and_cascade():
+    store = ObjectStore()
+    llm = LLM(metadata=ObjectMeta(name="m"))
+    created = store.create(llm)
+    with pytest.raises(AlreadyExists):
+        store.create(llm)
+
+    stale = store.get(LLM, "m")
+    fresh = store.get(LLM, "m")
+    fresh.spec["x"] = 1
+    store.update(fresh)
+    stale.spec["x"] = 2
+    with pytest.raises(Conflict):
+        store.update(stale)
+
+    # owner cascade
+    child = Scoring(metadata=ObjectMeta(name="c"))
+    child.metadata.owner_references.append(
+        {"kind": "LLM", "name": "m", "uid": created.metadata.uid})
+    store.create(child)
+    store.delete(LLM, "m")
+    with pytest.raises(NotFound):
+        store.get(Scoring, "c")
+
+
+def test_store_finalizer_gated_deletion():
+    store = ObjectStore()
+    ft = Finetune(metadata=ObjectMeta(name="f", finalizers=["x/y"]))
+    store.create(ft)
+    store.delete(Finetune, "f")
+    obj = store.get(Finetune, "f")  # still present
+    assert obj.metadata.deletion_timestamp is not None
+    obj.metadata.finalizers.remove("x/y")
+    store.update(obj)
+    with pytest.raises(NotFound):
+        store.get(Finetune, "f")
+
+
+def test_store_persistence_roundtrip(tmp_path):
+    d = str(tmp_path / "objs")
+    store = ObjectStore(persist_dir=d)
+    _seed_deps(store)
+    store2 = ObjectStore(persist_dir=d)
+    assert store2.get(Hyperparameter, "hp-a").spec["parameters"]["loRA_R"] == "8"
+    assert len(store2.list(Dataset)) == 1
+
+
+# -------------------------------------------------------------- webhooks
+
+def test_webhook_validation():
+    bad = Hyperparameter(metadata=ObjectMeta(name="h"),
+                         spec={"parameters": {"scheduler": "warp-speed"}})
+    with pytest.raises(AdmissionError, match="scheduler"):
+        admit(bad)
+    bad2 = Hyperparameter(metadata=ObjectMeta(name="h"),
+                          spec={"parameters": {"int4": "true", "int8": "true"}})
+    with pytest.raises(AdmissionError, match="mutually exclusive"):
+        admit(bad2)
+    bad3 = Dataset(metadata=ObjectMeta(name="d"), spec={})
+    with pytest.raises(AdmissionError, match="subsets"):
+        admit(bad3)
+    bad4 = FinetuneJob(metadata=ObjectMeta(name="j"),
+                       spec={"finetune": {"finetuneSpec": {"llm": "x"}}})
+    with pytest.raises(AdmissionError, match="dataset"):
+        admit(bad4)
+
+
+def test_webhook_defaulting():
+    hp = Hyperparameter(metadata=ObjectMeta(name="h"), spec={})
+    admit(hp)
+    assert hp.spec["parameters"]["loRA_R"] == "8"
+    assert hp.spec["parameters"]["scheduler"] == "cosine"
+
+
+def test_admitting_store_rejects():
+    store = AdmittingStore(ObjectStore())
+    with pytest.raises(AdmissionError):
+        store.create(Dataset(metadata=ObjectMeta(name="d"), spec={}))
+
+
+# -------------------------------------------------- finetune controller
+
+def test_finetune_lifecycle_success(world):
+    store, training, serving, mgr, storage = world
+    ft = Finetune(metadata=ObjectMeta(name="run1"), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a",
+                           "overrides": {"learningRate": "5e-4"}},
+        "image": {"name": "img", "path": "/models/llama2-7b"},
+        "node": 2,
+    })
+    store.create(ft)
+    mgr.run_until_idle()
+    obj = store.get(Finetune, "run1")
+    assert obj.status["state"] == Finetune.STATE_PENDING
+    # backend got the job with merged hyperparameters + our CLI contract
+    spec = training.jobs["run1"]
+    assert spec["num_hosts"] == 2
+    args = " ".join(spec["args"])
+    assert "--learning_rate 5e-4" in args  # override won
+    assert "--lr_scheduler_type cosine" in args
+    assert "--num_workers 2" in args
+    assert "--columns" in args
+
+    training.set_state("run1", "Running")
+    mgr.enqueue("Finetune", "default", "run1")
+    mgr.run_until_idle()
+    assert store.get(Finetune, "run1").status["state"] == Finetune.STATE_RUNNING
+
+    # completion: manifest appears on shared storage, job succeeds
+    write_manifest(storage, obj.metadata.uid, "/storage/ckpt/42",
+                   metrics={"loss": 1.5})
+    training.set_state("run1", "Succeeded")
+    mgr.enqueue("Finetune", "default", "run1")
+    mgr.run_until_idle()
+
+    obj = store.get(Finetune, "run1")
+    assert obj.status["state"] == Finetune.STATE_SUCCESSFUL
+    ref = obj.status["llmCheckpoint"]["llmCheckpointRef"]
+    ckpt = store.get(LLMCheckpoint, ref)
+    # provenance deep-copies (reference finetune_controller.go:621-653)
+    assert ckpt.spec["hyperparameter"]["spec"]["parameters"]["loRA_R"] == "8"
+    assert ckpt.spec["dataset"]["spec"]["datasetMetadata"]
+    assert ckpt.spec["checkpoint"] == "/storage/ckpt/42"
+
+
+def test_finetune_missing_deps_pending(world):
+    store, training, serving, mgr, storage = world
+    ft = Finetune(metadata=ObjectMeta(name="run2"), spec={
+        "llm": "nope", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"},
+    })
+    store.create(ft)
+    mgr.run_until_idle()
+    assert store.get(Finetune, "run2").status["state"] == Finetune.STATE_PENDING
+    assert "run2" not in training.jobs
+
+
+def test_finetune_failure(world):
+    store, training, serving, mgr, storage = world
+    ft = Finetune(metadata=ObjectMeta(name="run3"), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"},
+    })
+    store.create(ft)
+    mgr.run_until_idle()
+    training.set_state("run3", "Failed")
+    mgr.enqueue("Finetune", "default", "run3")
+    mgr.run_until_idle()
+    assert store.get(Finetune, "run3").status["state"] == Finetune.STATE_FAILED
+
+
+def test_finetune_deletion_tears_down_job(world):
+    store, training, serving, mgr, storage = world
+    ft = Finetune(metadata=ObjectMeta(name="run4"), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"},
+    })
+    store.create(ft)
+    mgr.run_until_idle()
+    store.delete(Finetune, "run4")
+    mgr.run_until_idle()
+    assert "run4" in training.deleted
+    with pytest.raises(NotFound):
+        store.get(Finetune, "run4")
+
+
+# ----------------------------------------------- finetunejob controller
+
+def _drive_job_to_serve(store, training, serving, mgr, storage, name="jobA"):
+    job = FinetuneJob(metadata=ObjectMeta(name=name), spec=_job_spec())
+    job.spec["finetune"]["name"] = f"{name}-finetune"
+    store.create(job)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+
+    ft_name = f"{name}-finetune"
+    ft = store.get(Finetune, ft_name)
+    assert store.get(FinetuneJob, name).status["state"] == FinetuneJob.STATE_FINETUNE
+
+    # train completes
+    training.set_state(ft_name, "Succeeded")
+    write_manifest(storage, ft.metadata.uid, "/storage/ckpt/7", metrics={"loss": 1.0})
+    mgr.enqueue("Finetune", "default", ft_name)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+
+    job = store.get(FinetuneJob, name)
+    assert job.status["state"] == FinetuneJob.STATE_SERVE
+    assert name in serving.apps
+    return job
+
+
+def test_finetunejob_full_pipeline(world):
+    store, training, serving, mgr, storage = world
+    name = "jobA"
+    _drive_job_to_serve(store, training, serving, mgr, storage, name)
+
+    # serving healthy -> Scoring CR created with inference URL
+    serving.set_state(name, "HEALTHY")
+    mgr.enqueue("FinetuneJob", "default", name)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    scoring = store.get(Scoring, name)
+    assert scoring.spec["inferenceService"].endswith("/chat/completions")
+    assert scoring.spec["plugin"]["loadPlugin"] is False
+
+    # score lands -> job Successful + serving torn down (the key transition)
+    scoring.status["score"] = "87.5"
+    store.update(scoring)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    job = store.get(FinetuneJob, name)
+    assert job.status["state"] == FinetuneJob.STATE_SUCCESSFUL
+    assert job.status["result"]["score"] == "87.5"
+    assert job.status["result"]["modelExportResult"] is True
+    assert name in serving.deleted
+
+    # back-references recorded (reference :213-257)
+    assert name in store.get(LLM, "llama2-7b").status["referenceFinetuneName"]
+    assert name in store.get(Dataset, "ds-a").status["referenceFinetuneName"]
+
+
+def test_finetunejob_plugin_scoring(world):
+    store, training, serving, mgr, storage = world
+    name = "jobP"
+    job_spec = _job_spec("P")
+    job_spec["scoringPluginConfig"] = {"name": "my-plugin", "parameters": '{"k": 1}'}
+    job = FinetuneJob(metadata=ObjectMeta(name=name), spec=job_spec)
+    store.create(job)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    ft_name = f"job{'P'}-finetune"
+    ft = store.get(Finetune, ft_name)
+    training.set_state(ft_name, "Succeeded")
+    write_manifest(storage, ft.metadata.uid, "/ckpt", metrics={})
+    mgr.enqueue("Finetune", "default", ft_name)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    serving.set_state(name, "HEALTHY")
+    mgr.enqueue("FinetuneJob", "default", name)
+    mgr.run_until_idle()
+    scoring = store.get(Scoring, name)
+    assert scoring.spec["plugin"] == {
+        "loadPlugin": True, "name": "my-plugin", "parameters": '{"k": 1}'}
+
+
+def test_finetunejob_failure_propagates(world):
+    store, training, serving, mgr, storage = world
+    job = FinetuneJob(metadata=ObjectMeta(name="jobF"), spec=_job_spec("F"))
+    job.spec["finetune"]["name"] = "jobF-finetune"
+    store.create(job)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    training.set_state("jobF-finetune", "Failed")
+    mgr.enqueue("Finetune", "default", "jobF-finetune")
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    assert store.get(FinetuneJob, "jobF").status["state"] == FinetuneJob.STATE_FAILED
+
+
+# --------------------------------------- finetuneexperiment controller
+
+def _experiment(names):
+    return FinetuneExperiment(
+        metadata=ObjectMeta(name="exp1"),
+        spec={"finetuneJobs": [{"name": n, "spec": _job_spec(n)} for n in names]},
+    )
+
+
+def _finish_job(store, training, serving, mgr, storage, name, score):
+    ft_name = f"job{name}-finetune"
+    ft = store.get(Finetune, ft_name)
+    training.set_state(ft_name, "Succeeded")
+    write_manifest(storage, ft.metadata.uid, f"/ckpt/{name}", metrics={})
+    mgr.enqueue("Finetune", "default", ft_name)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    serving.set_state(name, "HEALTHY")
+    mgr.enqueue("FinetuneJob", "default", name)
+    mgr.run_until_idle()
+    sc = store.get(Scoring, name)
+    sc.status["score"] = score
+    store.update(sc)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+
+
+def test_experiment_fanout_and_best_version(world):
+    store, training, serving, mgr, storage = world
+    exp = _experiment(["expj1", "expj2"])
+    # fix child names to match helper expectations
+    for e in exp.spec["finetuneJobs"]:
+        e["spec"]["finetune"]["name"] = f"job{e['name']}-finetune"
+    store.create(exp)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    assert store.get(FinetuneExperiment, "exp1").status["state"] == \
+        FinetuneExperiment.STATE_PROCESSING
+    assert store.get(FinetuneJob, "expj1") and store.get(FinetuneJob, "expj2")
+
+    _finish_job(store, training, serving, mgr, storage, "expj1", "55.0")
+    _finish_job(store, training, serving, mgr, storage, "expj2", "91.0")
+    mgr.drain_scheduled()
+
+    exp = store.get(FinetuneExperiment, "exp1")
+    assert exp.status["state"] == FinetuneExperiment.STATE_SUCCESS
+    assert exp.status["bestVersion"]["score"] == "91.0"
+    assert exp.status["bestVersion"]["dataset"] == "ds-a"
+    by_name = {s["name"]: s["status"]["state"] for s in exp.status["jobsStatus"]}
+    assert by_name == {"expj1": "Successful", "expj2": "Successful"}
+
+
+def test_experiment_pause_resume(world):
+    store, training, serving, mgr, storage = world
+    exp = _experiment(["pj1"])
+    store.create(exp)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    assert store.try_get(FinetuneJob, "pj1") is not None
+
+    exp = store.get(FinetuneExperiment, "exp1")
+    exp.spec["pending"] = True
+    store.update(exp)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    exp = store.get(FinetuneExperiment, "exp1")
+    assert exp.status["state"] == FinetuneExperiment.STATE_PENDING
+    assert store.try_get(FinetuneJob, "pj1") is None  # children deleted
+
+    exp.spec["pending"] = False
+    store.update(exp)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    assert store.try_get(FinetuneJob, "pj1") is not None  # resumed
+
+
+def test_experiment_all_failed(world):
+    store, training, serving, mgr, storage = world
+    exp = _experiment(["fj1"])
+    store.create(exp)
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    training.set_state("jobfj1-finetune", "Failed")
+    mgr.enqueue("Finetune", "default", "jobfj1-finetune")
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    exp = store.get(FinetuneExperiment, "exp1")
+    assert exp.status["state"] == FinetuneExperiment.STATE_FAILED

@@ -1,0 +1,117 @@
+"""The minimum end-to-end slice (SURVEY.md §7.3), fully live on one host:
+
+Dataset/LLM/Hyperparameter CRs → FinetuneJob → controller launches a REAL
+training subprocess (LoRA SFT, CPU) → Orbax checkpoint + completion manifest →
+LLMCheckpoint CR → REAL serving subprocess answers /chat/completions → built-in
+Scoring drives the endpoint → score recorded → job Successful, serving torn
+down. Exercises every CRD and both process boundaries.
+"""
+
+import csv
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from datatunerx_tpu.operator.api import (
+    Dataset,
+    Finetune,
+    FinetuneJob,
+    Hyperparameter,
+    LLM,
+    LLMCheckpoint,
+    ObjectMeta,
+    Scoring,
+)
+from datatunerx_tpu.operator.backends import LocalProcessBackend
+from datatunerx_tpu.operator.manager import build_manager
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.serving.local_backend import LocalServingBackend
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+@pytest.mark.slow
+def test_minimum_end_to_end_slice(tmp_path):
+    storage = str(tmp_path / "storage")
+    train_csv = str(tmp_path / "train.csv")
+    rows = [("what is 2+2?", "4"), ("capital of France?", "Paris"),
+            ("sky color?", "blue"), ("largest planet?", "Jupiter")] * 8
+    with open(train_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["q", "a"])
+        w.writerows(rows)
+
+    os.environ["STORAGE_PATH"] = storage
+    store = ObjectStore()
+    training = LocalProcessBackend(str(tmp_path / "jobs"), extra_env=CPU_ENV)
+    serving = LocalServingBackend(str(tmp_path / "jobs"), extra_env=CPU_ENV)
+    mgr = build_manager(store, training, serving, storage_path=storage,
+                        with_scoring=True)
+
+    store.create(LLM(metadata=ObjectMeta(name="m"), spec={"path": "preset:debug"}))
+    store.create(Hyperparameter(
+        metadata=ObjectMeta(name="hp"),
+        spec={"parameters": {
+            "scheduler": "constant", "optimizer": "adamw", "loRA_R": "4",
+            "loRA_Alpha": "16", "loRA_Dropout": "0.0", "learningRate": "1e-2",
+            "epochs": "1", "blockSize": "64", "batchSize": "4",
+            "gradAccSteps": "1", "PEFT": "true",
+        }},
+    ))
+    store.create(Dataset(
+        metadata=ObjectMeta(name="ds"),
+        spec={"datasetMetadata": {"datasetInfo": {
+            "subsets": [{"splits": {"train": {"file": train_csv}}}],
+            "features": [
+                {"name": "instruction", "mapTo": "q"},
+                {"name": "response", "mapTo": "a"},
+            ],
+        }}},
+    ))
+    job = FinetuneJob(metadata=ObjectMeta(name="e2e"), spec={
+        "finetune": {
+            "name": "e2e-finetune",
+            "finetuneSpec": {
+                "llm": "m", "dataset": "ds",
+                "hyperparameter": {"hyperparameterRef": "hp"},
+                "image": {"name": "local", "path": "preset:debug"},
+                "node": 1,
+            },
+        },
+    })
+    store.create(job)
+
+    deadline = time.time() + 600
+    state = ""
+    while time.time() < deadline:
+        mgr.drain_scheduled(horizon_s=120, max_wall_s=60)
+        state = store.get(FinetuneJob, "e2e").status.get("state")
+        if state in (FinetuneJob.STATE_SUCCESSFUL, FinetuneJob.STATE_FAILED):
+            break
+        time.sleep(2)
+
+    ft = store.try_get(Finetune, "e2e-finetune")
+    job = store.get(FinetuneJob, "e2e")
+    diag = ""
+    if state != FinetuneJob.STATE_SUCCESSFUL:
+        diag = (
+            f"job={json.dumps(job.status, default=str)[:800]}\n"
+            f"ft={json.dumps(ft.status if ft else {}, default=str)[:400]}\n"
+            f"trainer log:\n{training.log_tail('e2e-finetune')}\n"
+        )
+    assert state == FinetuneJob.STATE_SUCCESSFUL, diag
+
+    # score recorded as a string; serving torn down after eval
+    score = job.status["result"]["score"]
+    assert isinstance(score, str) and float(score) >= 0.0
+    assert serving.status("e2e") == "NotFound"
+    # provenance chain complete
+    ref = ft.status["llmCheckpoint"]["llmCheckpointRef"]
+    ckpt = store.get(LLMCheckpoint, ref)
+    assert os.path.isdir(ckpt.spec["checkpoint"]) or os.path.exists(ckpt.spec["checkpoint"])
+    scoring = store.get(Scoring, "e2e")
+    assert scoring.status["score"] == score
+    assert len(scoring.status["details"]) == 5
