@@ -40,6 +40,10 @@ class ModelConfig:
     # attention implementation: "xla" (einsum softmax) | "flash" (Pallas) |
     # "ring" (sequence-parallel ring attention over a mesh axis)
     attention_impl: str = "xla"
+    # base-weight quantization: None | "int8" | "int4"/"nf4" (QLoRA).
+    # Replaces bitsandbytes (reference cmd/tuning/train.py:224-234).
+    quantization: Optional[str] = None
+    quant_impl: str = "xla"  # "xla" | "pallas"
 
     def __post_init__(self):
         if self.head_dim is None:

@@ -80,13 +80,23 @@ def num_params(params: Params) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))
 
 
-def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0):
+def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
+          quant_mode=None, dims=None, use_pallas=False):
     """Dense projection with optional LoRA delta: h W + drop(h) A B * scale.
 
+    The base weight is either a full-precision kernel or a quantized collection
+    (ops/quant.py) — QLoRA = quantized frozen base + full-precision adapters
+    (reference bnb int4/int8 + peft, cmd/tuning/train.py:224-280).
     LoRA dropout applies to the adapter branch input only, matching peft's
     ``lora_dropout`` (reference cmd/tuning/parser.py:146-149, default 0.1).
     """
-    out = h @ p["kernel"].astype(h.dtype)
+    if "quant" in p:
+        from datatunerx_tpu.ops.quant import quantized_matmul
+
+        out = quantized_matmul(h, p["quant"], quant_mode, dims,
+                               use_pallas=use_pallas)
+    else:
+        out = h @ p["kernel"].astype(h.dtype)
     if "bias" in p:
         out = out + p["bias"].astype(h.dtype)
     if lora_p is not None:
@@ -162,14 +172,27 @@ def forward(
         kv_positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
         kv_valid = kv_positions < (cache["len"] + T)
         kv_seg = None
-    bias = make_causal_bias(
-        positions,
-        kv_positions,
-        kv_valid,
-        sliding_window=cfg.sliding_window,
-        q_segment_ids=segment_ids,
-        kv_segment_ids=kv_seg,
+    # flash/ring kernels are causal-only — exact for right-padded unpacked
+    # batches; they also skip the [B, T, S] bias entirely (building it would
+    # defeat their O(T) memory win)
+    _flash_ok = (
+        cfg.attention_impl in ("flash", "ring")
+        and segment_ids is None
+        and cache is None
+        and cfg.sliding_window is None
+        and (cfg.attention_impl != "flash" or T % 128 == 0 or T < 128)
     )
+    if _flash_ok:
+        bias = None
+    else:
+        bias = make_causal_bias(
+            positions,
+            kv_positions,
+            kv_valid,
+            sliding_window=cfg.sliding_window,
+            q_segment_ids=segment_ids,
+            kv_segment_ids=kv_seg,
+        )
 
     lora_layers, lora_scale = (None, 0.0)
     if lora is not None:
@@ -177,6 +200,11 @@ def forward(
         lora_layers = lora_params.get("layers", lora_params)
 
     drop = lora_dropout if (dropout_rng is not None and lora is not None) else 0.0
+
+    # packed segments, sliding window, and cache decode need the biased path
+    att_impl = cfg.attention_impl if _flash_ok else (
+        "xla" if cfg.attention_impl in ("flash", "ring") else cfg.attention_impl
+    )
 
     def block(x, scanned):
         lp, ll, ck, cv, layer_idx = scanned
@@ -187,10 +215,16 @@ def forward(
         else:
             kget = lambda j: None  # noqa: E731
 
+        qm, qp = cfg.quantization, cfg.quant_impl == "pallas"
+        D, F = cfg.hidden_size, cfg.intermediate_size
+
         h = rms_norm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        q = _proj(h, lp["q_proj"], lget("q_proj"), lora_scale, kget(0), drop)
-        k = _proj(h, lp["k_proj"], lget("k_proj"), lora_scale, kget(1), drop)
-        v = _proj(h, lp["v_proj"], lget("v_proj"), lora_scale, kget(2), drop)
+        q = _proj(h, lp["q_proj"], lget("q_proj"), lora_scale, kget(0), drop,
+                  qm, (D, cfg.q_dim), qp)
+        k = _proj(h, lp["k_proj"], lget("k_proj"), lora_scale, kget(1), drop,
+                  qm, (D, cfg.kv_dim), qp)
+        v = _proj(h, lp["v_proj"], lget("v_proj"), lora_scale, kget(2), drop,
+                  qm, (D, cfg.kv_dim), qp)
         q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -209,16 +243,19 @@ def forward(
         else:
             k_att, v_att = k, v
 
-        attn = attention(q, k_att, v_att, bias, impl=cfg.attention_impl)
+        attn = attention(q, k_att, v_att, bias, impl=att_impl)
         attn = attn.reshape(B, T, cfg.q_dim)
-        x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3), drop)
+        x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3),
+                      drop, qm, (cfg.q_dim, D), qp)
 
         h = rms_norm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
-        gate = _proj(h, lp["gate_proj"], lget("gate_proj"), lora_scale, kget(4), drop)
-        up = _proj(h, lp["up_proj"], lget("up_proj"), lora_scale, kget(5), drop)
+        gate = _proj(h, lp["gate_proj"], lget("gate_proj"), lora_scale, kget(4),
+                     drop, qm, (D, F), qp)
+        up = _proj(h, lp["up_proj"], lget("up_proj"), lora_scale, kget(5),
+                   drop, qm, (D, F), qp)
         mlp = _proj(
             jax.nn.silu(gate) * up, lp["down_proj"], lget("down_proj"),
-            lora_scale, kget(6), drop,
+            lora_scale, kget(6), drop, qm, (F, D), qp,
         )
         x = x + mlp
         return x, (ck, cv)

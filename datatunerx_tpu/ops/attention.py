@@ -76,4 +76,16 @@ def attention(
         from datatunerx_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, bias)
+    if impl == "ring":
+        from datatunerx_tpu.ops.ring_attention import (
+            get_ring_context,
+            ring_attention_sharded,
+        )
+
+        mesh, axis, batch_axes = get_ring_context()
+        if mesh is None or mesh.shape.get(axis, 1) == 1:
+            # no sequence-parallel axis active — plain attention is exact
+            return xla_attention(q, k, v, bias)
+        return ring_attention_sharded(q, k, v, mesh, axis_name=axis,
+                                      batch_axes=batch_axes)
     raise ValueError(f"unknown attention impl {impl!r}")

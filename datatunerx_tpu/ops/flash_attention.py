@@ -1,0 +1,329 @@
+"""Pallas flash attention (causal, GQA) with a flash backward pass.
+
+TPU-first replacement for the reference's flash-attn CUDA toggle (reference
+cmd/tuning/parser.py:66-69): O(T) memory — the [T, S] score matrix never
+materializes in either direction. Forward stores only the per-row logsumexp;
+backward recomputes probabilities tile-by-tile (standard FlashAttention-2
+scheme: one kernel accumulates dQ over K tiles, one accumulates dK/dV over Q
+tiles, with D = rowsum(dO ∘ O) precomputed).
+
+Exact for right-padded unpacked batches: pads sit at the sequence tail, so no
+valid query attends a pad key, and pad queries' outputs are loss-masked.
+Packed segments / sliding window / cache decode fall back to the biased XLA
+path (models/llama.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # stats tiles padded to the TPU lane width
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, block_q: int, block_k: int, scale: float):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_k <= i * block_q + block_q - 1)  # not fully future
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse = m_ref[:, 0:1] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _kv_index(H: int, G: int):
+    """Map the folded (batch·q-head) grid index to the (batch·kv-head) row of
+    the un-expanded K/V arrays — GQA without materializing jnp.repeat."""
+    KV = H // G
+
+    def index(b, i, j):
+        return ((b // H) * KV + (b % H) // G, j, 0)
+
+    return index
+
+
+def _fwd(q, k, v, *, block_q, block_k, interpret, H, G):
+    BH, T, d = q.shape
+    S = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+    )
+    kv_idx = _kv_index(H, G)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, T // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, :, 0]
+
+
+# ------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+                   acc_ref, *, block_q: int, block_k: int, scale: float):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k <= i * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos <= q_pos
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)
+
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dsum_ref[0][:, 0:1]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, block_q: int, block_k: int, scale: float):
+    j = pl.program_id(1)  # k tile
+    i = pl.program_id(2)  # q tile (sequential)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i * block_q + block_q - 1 >= j * block_k)  # q tile not fully past
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos <= q_pos
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)  # [bq, bk]
+
+        do = do_ref[0].astype(jnp.float32)  # [bq, d]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - dsum_ref[0][:, 0:1]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, d]
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(block_q, block_k, interpret, G, res, do):
+    """K/V arrive un-expanded [B*KV, S, d]; expand here (backward only) and
+    group-sum dk/dv at the end — forward never materializes the repeat."""
+    q, k, v, out, lse = res
+    BH, T, d = q.shape
+    if G > 1:
+        BKV = k.shape[0]
+        k = jnp.repeat(k, G, axis=0)
+        v = jnp.repeat(v, G, axis=0)
+    S = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _interpret()
+
+    dsum = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[:, :, None], (BH, T, _LANES))
+    dsum_b = jnp.broadcast_to(dsum[:, :, None], (BH, T, _LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale),
+        grid=(BH, T // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, dsum_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale),
+        grid=(BH, S // block_k, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, d), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, dsum_b)
+    if G > 1:
+        dk = dk.reshape(BKV, G, S, d).sum(axis=1)
+        dv = dv.reshape(BKV, G, S, d).sum(axis=1)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- public
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_causal(q, k, v, block_q: int = 512, block_k: int = 512,
+                           interpret=None, H: int = 1, G: int = 1):
+    """q: [B*H, T, d]; k, v: [B*KV, S, d] (un-expanded GQA). Causal."""
+    out, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k,
+                  interpret=_interpret() if interpret is None else interpret,
+                  H=H, G=G)
+    return out
+
+
+def _vjp_fwd(q, k, v, block_q, block_k, interpret, H, G):
+    out, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k,
+                    interpret=_interpret() if interpret is None else interpret,
+                    H=H, G=G)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(block_q, block_k, interpret, H, G, res, do):
+    return _bwd(block_q, block_k, interpret, G, res, do)
+
+
+flash_attention_causal.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def _pick_block(n: int, cap: int = 512) -> int:
+    """Largest power-of-two divisor of n, capped (TPU-friendly tile sizes)."""
+    b = 1
+    while b < cap and n % (b * 2) == 0:
+        b *= 2
+    return min(b, cap)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, H, d]
+    k: jnp.ndarray,  # [B, S, KV, d]
+    v: jnp.ndarray,
+    bias=None,  # accepted for dispatch parity; causal handled in-kernel
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret=None,
+) -> jnp.ndarray:
+    """GQA wrapper: fold (B, H) into the grid dim; KV stays un-expanded and the
+    kernel's index_map routes each q head to its KV group."""
+    B, T, H, d = q.shape
+    S, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    block_q = min(block_q, _pick_block(T))
+    block_k = min(block_k, _pick_block(S))
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KV, S, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KV, S, d)
+    out = flash_attention_causal(qf, kf, vf, block_q, block_k, interpret, H, G)
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,128 @@
+"""Pallas TPU kernels: fused quantized matmuls (int8 w8a16, nf4 QLoRA).
+
+The bitsandbytes replacement's hot path (SURVEY.md §2.4, §7.4#2): the XLA
+reference implementations live in ops/quant.py; these kernels fuse
+unpack → codebook → scale → MXU dot per tile, so the dequantized weights never
+round-trip through HBM. Correctness is pinned to the XLA path in
+tests/test_quant.py (interpret mode on CPU; compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from datatunerx_tpu.ops.quant import NF4_CODE
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_rows(x2d: jnp.ndarray, bm: int) -> Tuple[jnp.ndarray, int]:
+    m = x2d.shape[0]
+    pad = (-m) % bm
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    return x2d, m
+
+
+# ----------------------------------------------------------------- int8
+
+def _int8_kernel(x_ref, q_ref, s_ref, o_ref):
+    acc = jnp.dot(
+        x_ref[:], q_ref[:].astype(x_ref.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def pallas_matmul_int8(
+    x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
+    block_m: int = 256, block_n: int = 256,
+) -> jnp.ndarray:
+    """x: [..., K] @ q: int8 [K, N] * scale [N] → [..., N]."""
+    *lead, K = x.shape
+    N = q.shape[1]
+    x2d = x.reshape(-1, K)
+    x2d, m_real = _pad_rows(x2d, block_m)
+    M = x2d.shape[0]
+    bn = min(block_n, N)
+    assert N % bn == 0, (N, bn)
+
+    out = pl.pallas_call(
+        _int8_kernel,
+        grid=(M // block_m, N // bn),
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        interpret=_interpret(),
+    )(x2d, q, scale.reshape(1, N))
+    return out[:m_real].reshape(*lead, N)
+
+
+# ------------------------------------------------------------------ nf4
+
+def _nf4_kernel(x_ref, packed_ref, scales_ref, code_ref, o_ref, *, block_size: int):
+    # packed_ref: [bn, K // block, block // 2] uint8 (channel-major blocks)
+    # scales_ref: [bn, K // block] f32; code_ref: [1, 16] nf4 codebook
+    packed = packed_ref[:]
+    bn, nb, half = packed.shape
+    lo = (packed & 0x0F).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    idx = jnp.stack([lo, hi], axis=-1).reshape(bn, nb, block_size)
+    code = code_ref[0]
+    w = code[idx] * scales_ref[:][..., None]  # [bn, nb, block]
+    w = w.reshape(bn, nb * block_size)  # [bn, K]
+    acc = jax.lax.dot_general(
+        x_ref[:], w.astype(x_ref.dtype),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def pallas_matmul_nf4(
+    x: jnp.ndarray, qw: Dict[str, jnp.ndarray], shape: Tuple[int, int],
+    block_m: int = 256, block_n: int = 256, block_size: int = 64,
+) -> jnp.ndarray:
+    """x: [..., K] @ nf4-packed weights (ops/quant.py layout) → [..., N]."""
+    K, N = shape
+    *lead, K2 = x.shape
+    assert K2 == K, (K2, K)
+    nb_per_channel = K // block_size
+    packed = qw["packed"].reshape(N, nb_per_channel, block_size // 2)
+    scales = (qw["scale_q"].astype(jnp.float32) * qw["meta"][0]).reshape(
+        N, nb_per_channel
+    )
+
+    x2d = x.reshape(-1, K)
+    x2d, m_real = _pad_rows(x2d, block_m)
+    M = x2d.shape[0]
+    bn = min(block_n, N)
+    assert N % bn == 0, (N, bn)
+
+    out = pl.pallas_call(
+        functools.partial(_nf4_kernel, block_size=block_size),
+        grid=(M // block_m, N // bn),
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, nb_per_channel, block_size // 2),
+                         lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((bn, nb_per_channel), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, 16), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        interpret=_interpret(),
+    )(x2d, packed, scales, jnp.asarray(NF4_CODE).reshape(1, 16))
+    return out[:m_real].reshape(*lead, N)

@@ -1,0 +1,197 @@
+"""Weight quantization: int8 (per-channel) and int4-nf4 (per-block, QLoRA).
+
+Functional replacement for bitsandbytes' CUDA kernels (reference
+cmd/tuning/train.py:224-234 selects int8 `load_in_8bit` or int4 nf4
+`bnb_4bit_quant_type`; flags from cmd/tuning/parser.py:40-55). This module is
+the XLA path + pack/dequant math; Pallas fused kernels (ops/pallas_quant.py)
+are validated against it.
+
+Design constraint: quantized param collections contain ONLY arrays (static
+metadata — shapes, block size, mode — travels in ModelConfig / call sites), so
+stacked [L, ...] quantized layers slice cleanly through `lax.scan`.
+
+Formats:
+- int8: symmetric per-output-channel absmax. {"q": int8[in, out], "scale": f32[out]}
+- nf4 (QLoRA): per-block (64) absmax-normalized weights snapped to the 16-level
+  NormalFloat4 codebook, two nibbles per uint8, channel-contiguous blocks.
+  Double quantization: block scales stored int8 against a per-tensor meta scale
+  (reference `double_quantization` default True, parser.py:48-51).
+  {"packed": uint8[n_blocks, block/2], "scale_q": int8[n_blocks], "meta": f32[1]}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NF4_BLOCK = 64
+
+# QLoRA NF4 codebook (16 quantiles of N(0,1), normalized to [-1, 1]).
+NF4_CODE = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    dtype=np.float32,
+)
+
+
+# ----------------------------------------------------------------- int8
+
+def quantize_int8(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """w: [in, out] → per-out-channel symmetric int8."""
+    w = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequant_int8(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    return (q.astype(jnp.float32) * scale[None, :]).astype(dtype)
+
+
+def matmul_int8(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """x: [..., in] @ int8 weights → [..., out]; scale applied after the dot so
+    the contraction runs mixed-precision on the MXU without a dequant copy."""
+    y = jnp.einsum(
+        "...i,io->...o", x, q.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return (y * scale[None, :]).astype(x.dtype)
+
+
+# ------------------------------------------------------------------ nf4
+
+def quantize_nf4(w: jnp.ndarray, block_size: int = NF4_BLOCK) -> Dict[str, jnp.ndarray]:
+    """w: [in, out] → packed nf4 (channel-contiguous blocks: tensor is
+    transposed to [out, in] then flattened, so each block holds one channel's
+    consecutive input weights)."""
+    in_dim, out_dim = w.shape
+    if in_dim % block_size != 0:
+        raise ValueError(
+            f"nf4 requires in_dim % block_size == 0 (got {in_dim} % "
+            f"{block_size}): blocks must not straddle output channels"
+        )
+    flat = w.astype(jnp.float32).T.reshape(-1)
+    blocks = flat.reshape(-1, block_size)
+    absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1), 1e-12)
+    normed = blocks / absmax[:, None]
+    code = jnp.asarray(NF4_CODE)
+    idx = jnp.argmin(jnp.abs(normed[..., None] - code[None, None, :]), axis=-1)
+    idx = idx.astype(jnp.uint8)
+    lo, hi = idx[:, 0::2], idx[:, 1::2]
+    packed = (lo | (hi << 4)).astype(jnp.uint8)
+
+    meta = jnp.maximum(jnp.max(absmax) / 127.0, 1e-12)
+    scale_q = jnp.clip(jnp.round(absmax / meta), 1, 127).astype(jnp.int8)
+    return {"packed": packed, "scale_q": scale_q, "meta": meta.reshape(1)}
+
+
+def nf4_scales(qw: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    return qw["scale_q"].astype(jnp.float32) * qw["meta"][0]
+
+
+def dequant_nf4(
+    qw: Dict[str, jnp.ndarray], shape: Tuple[int, int], dtype=jnp.float32
+) -> jnp.ndarray:
+    in_dim, out_dim = shape
+    packed = qw["packed"]
+    lo = (packed & 0x0F).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    idx = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+    vals = jnp.asarray(NF4_CODE)[idx] * nf4_scales(qw)[:, None]
+    return vals.reshape(out_dim, in_dim).T.astype(dtype)
+
+
+def matmul_nf4(
+    x: jnp.ndarray, qw: Dict[str, jnp.ndarray], shape: Tuple[int, int]
+) -> jnp.ndarray:
+    """XLA path: dequantize then matmul (XLA fuses the unpack chain into the
+    dot's operand pipeline). The Pallas kernel does the unpack per-tile."""
+    w = dequant_nf4(qw, shape, dtype=x.dtype)
+    return jnp.einsum(
+        "...i,io->...o", x, w, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+# ------------------------------------------------------- param-tree level
+
+QUANT_KERNELS = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+)
+
+
+def quantize_model_params(params, mode: str):
+    """Quantize the stacked [L, in, out] transformer kernels in-tree.
+    Embeddings, norms, and lm_head stay full-precision (bnb's skip list).
+    Array-only leaves: int8 → q [L,in,out] + scale [L,out];
+    nf4 → packed [L,nb,b/2] + scale_q [L,nb] + meta [L,1]."""
+    if mode not in ("int8", "int4", "nf4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    layers = dict(params["layers"])
+    for name in QUANT_KERNELS:
+        proj = dict(layers[name])
+        kern = proj.pop("kernel")
+        L = kern.shape[0]
+        per_layer = [
+            quantize_int8(kern[i]) if mode == "int8" else quantize_nf4(kern[i])
+            for i in range(L)
+        ]
+        proj["quant"] = {
+            k: jnp.stack([pl_[k] for pl_ in per_layer]) for k in per_layer[0]
+        }
+        layers[name] = proj
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def dequantize_model_params(params, mode: str, dims_fn):
+    """Inverse of quantize_model_params (for export): dims_fn(name) -> (in, out)."""
+    layers = dict(params["layers"])
+    for name in QUANT_KERNELS:
+        proj = dict(layers[name])
+        quant = proj.pop("quant")
+        L = jax.tree_util.tree_leaves(quant)[0].shape[0]
+        if mode == "int8":
+            kern = jnp.stack(
+                [dequant_int8(quant["q"][i], quant["scale"][i]) for i in range(L)]
+            )
+        else:
+            per = [
+                dequant_nf4({k: v[i] for k, v in quant.items()}, dims_fn(name))
+                for i in range(L)
+            ]
+            kern = jnp.stack(per)
+        proj["kernel"] = kern
+        layers[name] = proj
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def quantized_matmul(
+    x: jnp.ndarray,
+    quant: Dict[str, jnp.ndarray],
+    mode: str,
+    shape: Tuple[int, int],
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    if mode == "int8":
+        if use_pallas:
+            from datatunerx_tpu.ops.pallas_quant import pallas_matmul_int8
+
+            return pallas_matmul_int8(x, quant["q"], quant["scale"])
+        return matmul_int8(x, quant["q"], quant["scale"])
+    if use_pallas:
+        from datatunerx_tpu.ops.pallas_quant import pallas_matmul_nf4
+
+        return pallas_matmul_nf4(x, quant, shape)
+    return matmul_nf4(x, quant, shape)

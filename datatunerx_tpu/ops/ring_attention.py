@@ -1,0 +1,128 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY.md §5.7: the
+reference only truncates to block_size). Sequences shard over the mesh's
+``sp`` axis; K/V chunks rotate around the ring via ``ppermute`` (ICI
+neighbor exchange) while each device accumulates online-softmax statistics —
+attention memory per device stays O(T_local), total sequence length scales
+with the ring size.
+
+`ring_attention` is written to run inside `shard_map` (it uses
+`lax.axis_index`/`lax.ppermute`); `ring_attention_sharded` wraps it for a
+given mesh. The plain GSPMD path (all-gather K/V) remains the fallback the
+compiler picks when the model runs without the explicit ring (sp axis in
+parallel/sharding.py batch specs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+# set by the Trainer when cfg.attention_impl == "ring" and a mesh is active;
+# the model-level dispatch (ops/attention.py) reads it
+_RING: dict = {"mesh": None, "axis": "sp", "batch_axes": ("dp", "fsdp")}
+
+
+def set_ring_context(mesh: Optional[Mesh], axis_name: str = "sp",
+                     batch_axes=("dp", "fsdp")) -> None:
+    _RING.update(mesh=mesh, axis=axis_name, batch_axes=batch_axes)
+
+
+def get_ring_context():
+    return _RING["mesh"], _RING["axis"], _RING["batch_axes"]
+
+
+def _chunk_attention(q, k, v, q_pos, k_pos, scale):
+    """One K/V chunk's unnormalized contribution + stats, GQA-aware (no KV
+    head expansion).
+
+    q: [B, Tq, KV, G, d]; k, v: [B, Tk, KV, d].
+    Returns (o [B, Tq, KV, G, d], m, l both [B, Tq, KV, G, 1]).
+    """
+    s = jnp.einsum("bqkgd,bskd->bkgqs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = k_pos[:, None, None, None, :] <= q_pos[:, None, None, :, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B, KV, G, Tq, 1]
+    p = jnp.exp(s - jnp.maximum(m, NEG_INF / 2))
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", p, v.astype(jnp.float32))
+    perm = (0, 3, 1, 2, 4)
+    return o, m.transpose(perm), l.transpose(perm)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, T_local, H, d]  (local sequence shard)
+    k: jnp.ndarray,  # [B, T_local, KV, d]
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Causal ring attention; call under shard_map with sequence sharded on
+    `axis_name`. Chunks are laid out contiguously: device i owns global
+    positions [i*T_local, (i+1)*T_local)."""
+    B, T, H, d = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    q = q.reshape(B, T, KV, G, d)
+    scale = 1.0 / (d ** 0.5)
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    q_pos = my * T + jnp.arange(T, dtype=jnp.int32)[None].repeat(B, 0)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send to next, recv from prev
+
+    def step(carry, _):
+        kc, vc, src, acc, m_run, l_run = carry
+        k_pos = src * T + jnp.arange(T, dtype=jnp.int32)[None].repeat(B, 0)
+        o_c, m_c, l_c = _chunk_attention(q, kc, vc, q_pos, k_pos, scale)
+
+        m_new = jnp.maximum(m_run, m_c)
+        corr_run = jnp.exp(m_run - m_new)
+        corr_c = jnp.exp(m_c - m_new)
+        acc = acc * corr_run + o_c * corr_c
+        l_run = l_run * corr_run + l_c * corr_c
+
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        src = (src - 1) % n  # after rotation we hold the previous device's chunk
+        return (kc, vc, src, acc, m_new, l_run), None
+
+    acc0 = jnp.zeros((B, T, KV, G, d), jnp.float32)
+    m0 = jnp.full((B, T, KV, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, T, KV, G, 1), jnp.float32)
+    (_, _, _, acc, m_run, l_run), _ = jax.lax.scan(
+        step, (k, v, my, acc0, m0, l0), None, length=n
+    )
+    out = acc / jnp.maximum(l_run, 1e-30)
+    return out.reshape(B, T, H, d).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # [B, T_global, H, d]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    batch_axes=("dp", "fsdp"),
+) -> jnp.ndarray:
+    """Convenience wrapper: shard_map over (batch, sequence) with KV/head dims
+    replicated; tp sharding of heads composes by adding 'tp' to the H spec."""
+    spec_q = P(batch_axes, axis_name, None, None)
+    spec_kv = P(batch_axes, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+        check_vma=False,
+    )(q, k, v)

@@ -27,10 +27,15 @@ _COLUMN = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
 _ROW = {"o_proj", "down_proj"}
 
 
+_MODULES = _COLUMN | _ROW | {"lm_head", "embed_tokens"}
+
+
 def _spec_for(path: tuple[str, ...], x: Any) -> P:
     names = [p for p in path if isinstance(p, str)]
     leaf = names[-1] if names else ""
-    module = names[-2] if len(names) >= 2 else ""
+    # the owning module may sit deeper than names[-2] (e.g.
+    # layers/<proj>/quant/<leaf>) — search the path for a known module name
+    module = next((n for n in names if n in _MODULES), "")
     rank = getattr(x, "ndim", len(getattr(x, "shape", ())))
 
     if leaf == "embedding":  # [V, D]
@@ -45,8 +50,18 @@ def _spec_for(path: tuple[str, ...], x: Any) -> P:
         if module in _ROW:
             return P(None, "tp", "fsdp")
         return P(None, "fsdp", "tp")
+    if leaf == "q" and rank == 3:  # int8 kernel [L, in, out] (ops/quant.py)
+        if module in _ROW:
+            return P(None, "tp", "fsdp")
+        return P(None, "fsdp", "tp")
     if leaf == "bias" and rank == 2:  # [L, out]
         return P(None, "tp" if module in _COLUMN else "fsdp")
+    if leaf == "scale" and rank == 2 and (module in _COLUMN or module in _ROW):
+        # int8 per-channel scales [L, out]
+        return P(None, "tp" if module in _COLUMN else "fsdp")
+    if leaf in ("packed", "scale_q") and rank >= 2:
+        # nf4 blocks are output-channel-contiguous: shard the block axis
+        return P(None, "fsdp", *([None] * (rank - 2)))
     if leaf == "scale":  # norms — tiny, replicate
         return P()
     # optimizer-state scalars (counts) and anything unrecognized: replicate

@@ -86,6 +86,10 @@ class Trainer:
         self.model_cfg = model_cfg
         self.cfg = train_cfg
         self.mesh = mesh
+        if model_cfg.attention_impl == "ring":
+            from datatunerx_tpu.ops.ring_attention import set_ring_context
+
+            set_ring_context(mesh)
         self.schedule = make_schedule(
             train_cfg.scheduler,
             train_cfg.learning_rate,

@@ -155,6 +155,9 @@ def parse_train_args(argv=None) -> TrainArgs:
         if f.name not in ns or ns[f.name] is None:
             continue
         raw = ns[f.name]
+        if raw == "" and f.default is None:
+            continue  # empty string clears an optional flag (controller may
+            # emit e.g. --metrics_export_address "" / --quantization "")
         if f.name in _BOOLS:
             kwargs[f.name] = str(raw).lower() in ("true", "1", "yes")
         elif f.type in ("int", int):

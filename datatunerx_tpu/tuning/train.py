@@ -52,6 +52,18 @@ def run(args: TrainArgs) -> dict:
             rope_scaling_type=args.rope_scaling,
             rope_scaling_factor=args.rope_scaling_factor,
         )
+    if args.quantization:
+        if args.quantization == "int4" and args.quantization_type == "fp4":
+            raise NotImplementedError(
+                "fp4 is not supported; use nf4 (the reference default, "
+                "cmd/tuning/parser.py:45-47)"
+            )
+        if args.finetuning_type != "lora":
+            raise ValueError(
+                "--quantization requires finetuning_type lora "
+                "(quantized base weights are frozen, as with bitsandbytes+peft)"
+            )
+        overrides["quantization"] = args.quantization
     dtype = jnp.bfloat16 if args.bf16 else np.float32
     cfg, params, tokenizer = load_model_and_tokenizer(
         args.model_name_or_path, dtype=dtype, seed=args.seed,
@@ -63,6 +75,11 @@ def run(args: TrainArgs) -> dict:
         export_merged_model(jax.device_get(params), cfg, args.export_dir)
         return {"steps": 0, "metrics": {}, "manifest": None,
                 "checkpoint_dir": None, "export_dir": args.export_dir}
+
+    if args.quantization:
+        from datatunerx_tpu.ops.quant import quantize_model_params
+
+        params = quantize_model_params(params, args.quantization)
 
     # ----- data --------------------------------------------------------
     template = get_template(args.template, tokenizer)
@@ -209,8 +226,17 @@ def run(args: TrainArgs) -> dict:
         )
         if args.export_dir:
             lora = state.lora if tcfg.finetuning_type == "lora" else None
+            export_params = jax.device_get(state.params)
+            if args.quantization:
+                from datatunerx_tpu.models.lora import target_dims
+                from datatunerx_tpu.ops.quant import dequantize_model_params
+
+                export_params = dequantize_model_params(
+                    export_params, args.quantization,
+                    dims_fn=lambda n: target_dims(cfg, n),
+                )
             export_merged_model(
-                jax.device_get(state.params), cfg, args.export_dir,
+                export_params, cfg, args.export_dir,
                 lora=jax.device_get(lora) if lora is not None else None,
                 scaling=trainer.scaling,
             )

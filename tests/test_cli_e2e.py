@@ -146,7 +146,7 @@ def test_e2e_full_finetune_and_export(tmp_path):
     export = str(tmp_path / "export")
     argv, out, storage = _flags(
         tmp_path, template="alpaca", max_steps="2", finetuning_type="full",
-        bf16="false", remat="none", export_dir=export,
+        bf16="false", remat="none", export_dir=export, quantization="",
     )
     args = parse_train_args(argv)
     r = run(args)

@@ -1,0 +1,101 @@
+"""Ring attention vs full-sequence XLA attention on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.ops.attention import make_causal_bias, xla_attention
+from datatunerx_tpu.ops.ring_attention import ring_attention_sharded
+from datatunerx_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.parametrize("shape", [(1, 1, 1, 8), (2, 1, 1, 4)])
+def test_ring_matches_full_attention(shape, devices8):
+    mesh = make_mesh(shape)
+    sp = shape[3]
+    B, T, H, KV, d = 2, 64 * sp, 4, 2, 16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+
+    pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    ref = xla_attention(q, k, v, make_causal_bias(pos, pos))
+
+    out = ring_attention_sharded(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gradients_flow(devices8):
+    mesh = make_mesh((1, 1, 1, 4))
+    B, T, H, d = 1, 128, 2, 16
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh) ** 2)
+
+    def loss_ref(q, k, v):
+        pos = jnp.arange(T)[None]
+        return jnp.sum(xla_attention(q, k, v, make_causal_bias(pos, pos)) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_ring_training_through_trainer(devices8):
+    """--attention ring end-to-end: the model dispatches to ring attention
+    under an sp>1 mesh and the train step runs + decreases loss."""
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.models.config import ModelConfig
+    from datatunerx_tpu.models.llama import init_params
+    from datatunerx_tpu.training import TrainConfig, Trainer
+    from datatunerx_tpu.training.loss import IGNORE_INDEX
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=256, remat="none",
+        attention_impl="ring",
+    )
+    mesh = make_mesh((2, 1, 1, 4))
+    tr = Trainer(cfg, TrainConfig(finetuning_type="lora", lora_rank=4,
+                                  lora_dropout=0.0, learning_rate=2e-2,
+                                  scheduler="constant", total_steps=10,
+                                  compute_dtype=None), mesh=mesh)
+    state = tr.init_state(init_params(cfg, jax.random.PRNGKey(0)),
+                          jax.random.PRNGKey(1))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(4, 128, (4, 64)).astype(np.int32)
+    labels = toks.copy()
+    labels[:, :8] = IGNORE_INDEX
+    batch = {"input_ids": jnp.asarray(toks), "labels": jnp.asarray(labels)}
+    losses = []
+    for _ in range(6):
+        state, m = tr.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+    # parity: same model with plain xla attention on a single device
+    import dataclasses
+
+    from datatunerx_tpu.ops.ring_attention import set_ring_context
+
+    set_ring_context(None)
+    xcfg = dataclasses.replace(cfg, attention_impl="xla")
+    tr2 = Trainer(xcfg, TrainConfig(finetuning_type="lora", lora_rank=4,
+                                    lora_dropout=0.0, learning_rate=2e-2,
+                                    scheduler="constant", total_steps=10,
+                                    compute_dtype=None))
+    s2 = tr2.init_state(init_params(cfg, jax.random.PRNGKey(0)),
+                        jax.random.PRNGKey(1))
+    s2, m2 = tr2.train_step(s2, batch)
+    np.testing.assert_allclose(losses[0], float(m2["loss"]), rtol=1e-5)
