@@ -1,0 +1,212 @@
+"""dtx — the operator CLI (reference ecosystem's ``dtx-ctl``, SURVEY.md §1,
+INSTALL.md:26-48 — install/apply/inspect instead of Helm+kubectl).
+
+Talks to the operator's REST API (operator/apiserver.py):
+
+  dtx apply -f resources.json|yaml     create/update CRs (accepts a single
+                                       object or a list; JSON, or YAML if
+                                       pyyaml is available)
+  dtx get <kind> [name] [-n ns] [-o json]
+  dtx delete <kind> <name> [-n ns]
+  dtx status <finetunejob-name>        condensed pipeline view
+
+Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
+bearer auth via DTX_API_TOKEN when the server requires it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_GROUP_BY_KIND = {
+    "Finetune": "finetune.datatunerx.io",
+    "FinetuneJob": "finetune.datatunerx.io",
+    "FinetuneExperiment": "finetune.datatunerx.io",
+    "LLM": "core.datatunerx.io",
+    "Hyperparameter": "core.datatunerx.io",
+    "LLMCheckpoint": "core.datatunerx.io",
+    "Dataset": "extension.datatunerx.io",
+    "Scoring": "extension.datatunerx.io",
+}
+_KIND_ALIASES = {k.lower(): k for k in _GROUP_BY_KIND}
+_KIND_ALIASES.update({k.lower() + "s": k for k in _GROUP_BY_KIND})
+_KIND_ALIASES.update({"ftj": "FinetuneJob", "ftexp": "FinetuneExperiment",
+                      "ft": "Finetune", "hp": "Hyperparameter", "ds": "Dataset"})
+
+
+def _kind(raw: str) -> str:
+    k = _KIND_ALIASES.get(raw.lower())
+    if not k:
+        sys.exit(f"error: unknown kind {raw!r}; one of {sorted(_GROUP_BY_KIND)}")
+    return k
+
+
+def _url(server: str, kind: str, ns: str = None, name: str = None) -> str:
+    group = _GROUP_BY_KIND[kind]
+    url = f"{server}/apis/{group}/v1beta1/{kind.lower()}"
+    if ns:
+        url += f"/{ns}"
+        if name:
+            url += f"/{name}"
+    return url
+
+
+def _request(method: str, url: str, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    headers = {"Content-Type": "application/json"}
+    if os.environ.get("DTX_API_TOKEN"):
+        headers["Authorization"] = f"Bearer {os.environ['DTX_API_TOKEN']}"
+    req = urllib.request.Request(url, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.load(e)
+        except Exception:
+            return e.code, {"error": e.reason}
+    except urllib.error.URLError as e:
+        sys.exit(f"error: cannot reach API server at {url.split('/apis')[0]}: {e.reason}")
+
+
+def _load_docs(path: str):
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        try:
+            import yaml  # optional
+
+            docs = [d for d in yaml.safe_load_all(text) if d]
+        except ImportError:
+            sys.exit("error: pyyaml not available; use JSON manifests")
+    else:
+        loaded = json.loads(text)
+        docs = loaded if isinstance(loaded, list) else [loaded]
+    return docs
+
+
+def cmd_apply(args):
+    for doc in _load_docs(args.filename):
+        kind = _kind(doc.get("kind", ""))
+        meta = doc.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        name = meta.get("name")
+        code, resp = _request("POST", _url(args.server, kind), doc)
+        if code == 409:  # exists → fetch rv and update
+            code_get, current = _request("GET", _url(args.server, kind, ns, name))
+            if code_get == 200:
+                doc.setdefault("metadata", {})["resource_version"] = (
+                    current["metadata"]["resource_version"]
+                )
+                doc["metadata"]["uid"] = current["metadata"]["uid"]
+                code, resp = _request("PUT", _url(args.server, kind, ns, name), doc)
+        if code in (200, 201):
+            print(f"{kind}/{name} {'created' if code == 201 else 'configured'}")
+        else:
+            sys.exit(f"error applying {kind}/{name}: {resp.get('error', resp)}")
+
+
+def cmd_get(args):
+    kind = _kind(args.kind)
+    if args.name:
+        code, resp = _request("GET", _url(args.server, kind, args.namespace, args.name))
+        if code != 200:
+            sys.exit(f"error: {resp.get('error')}")
+        if args.output == "json":
+            print(json.dumps(resp, indent=1, default=str))
+        else:
+            _print_table(kind, [resp])
+        return
+    code, resp = _request("GET", _url(args.server, kind) + f"/{args.namespace}")
+    if code != 200:
+        sys.exit(f"error: {resp.get('error')}")
+    if args.output == "json":
+        print(json.dumps(resp, indent=1, default=str))
+    else:
+        _print_table(kind, resp.get("items", []))
+
+
+def _print_table(kind, items):
+    rows = []
+    for it in items:
+        meta, status = it.get("metadata", {}), it.get("status", {})
+        state = status.get("state", "")
+        extra = ""
+        if kind == "FinetuneJob":
+            extra = str(status.get("result", {}).get("score", ""))
+        elif kind == "FinetuneExperiment":
+            extra = str(status.get("bestVersion", {}).get("score", ""))
+        elif kind == "Scoring":
+            state = ""
+            extra = str(status.get("score", ""))
+        rows.append((meta.get("name", ""), state, extra))
+    name_w = max([4] + [len(r[0]) for r in rows]) + 2
+    state_w = max([5] + [len(r[1]) for r in rows]) + 2
+    print(f"{'NAME':<{name_w}}{'STATE':<{state_w}}SCORE")
+    for name, state, extra in rows:
+        print(f"{name:<{name_w}}{state:<{state_w}}{extra}")
+
+
+def cmd_delete(args):
+    kind = _kind(args.kind)
+    code, resp = _request("DELETE", _url(args.server, kind, args.namespace, args.name))
+    if code != 200:
+        sys.exit(f"error: {resp.get('error')}")
+    print(f"{kind}/{args.name} deleted")
+
+
+def cmd_status(args):
+    code, job = _request(
+        "GET", _url(args.server, "FinetuneJob", args.namespace, args.name))
+    if code != 200:
+        sys.exit(f"error: {job.get('error')}")
+    status = job.get("status", {})
+    result = status.get("result", {})
+    print(f"FinetuneJob {args.name}")
+    print(f"  state:      {status.get('state', '')}")
+    print(f"  finetune:   {status.get('finetuneStatus', {}).get('state', '')}")
+    print(f"  serve:      {result.get('serve', '')}")
+    print(f"  score:      {result.get('score', '')}")
+    print(f"  checkpoint: {result.get('checkpointPath', '')}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="dtx")
+    p.add_argument("--server", default=os.environ.get("DTX_SERVER",
+                                                      "http://127.0.0.1:8080"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ap = sub.add_parser("apply")
+    ap.add_argument("-f", "--filename", required=True)
+    ap.set_defaults(fn=cmd_apply)
+
+    gp = sub.add_parser("get")
+    gp.add_argument("kind")
+    gp.add_argument("name", nargs="?")
+    gp.add_argument("-n", "--namespace", default="default")
+    gp.add_argument("-o", "--output", choices=["table", "json"], default="table")
+    gp.set_defaults(fn=cmd_get)
+
+    dp = sub.add_parser("delete")
+    dp.add_argument("kind")
+    dp.add_argument("name")
+    dp.add_argument("-n", "--namespace", default="default")
+    dp.set_defaults(fn=cmd_delete)
+
+    sp = sub.add_parser("status")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.set_defaults(fn=cmd_status)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,18 @@ class FinetuneController:
                 store.update(ft)
             return Result(requeue_after=RUNNING_POLL_S)
         if job_status == "Failed":
+            # bounded retry with checkpoint-resume (SURVEY.md §5.3 — the
+            # reference has no retry at all): the trainer auto-resumes from its
+            # latest Orbax checkpoint (same uid → same storage key), so a retry
+            # continues rather than restarts
+            limit = int(ft.spec.get("backoffLimit", 0) or 0)
+            retries = int(ft.status.get("retries", 0))
+            if retries < limit:
+                self.backend.delete(meta.name)
+                ft.status["retries"] = retries + 1
+                ft.status["state"] = Finetune.STATE_PENDING
+                store.update(ft)
+                return Result(requeue_after=POLL_INTERVAL_S)
             ft.status["state"] = Finetune.STATE_FAILED
             store.update(ft)
             return None

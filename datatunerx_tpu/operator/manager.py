@@ -53,6 +53,9 @@ def build_manager(
 
 
 class _HealthHandler(BaseHTTPRequestHandler):
+    """Probe-only endpoint (reference --health-probe-bind-address,
+    options.go:13-14); metrics live on the API address only."""
+
     manager: Manager = None
 
     def do_GET(self):
@@ -60,16 +63,6 @@ class _HealthHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.end_headers()
             self.wfile.write(b"ok")
-        elif self.path == "/metrics":
-            lines = [
-                "# TYPE dtx_operator_reconcile_errors_total counter",
-                f"dtx_operator_reconcile_errors_total {len(self.manager.errors)}",
-            ]
-            body = "\n".join(lines).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.end_headers()
-            self.wfile.write(body)
         else:
             self.send_response(404)
             self.end_headers()
@@ -108,20 +101,36 @@ def main(argv=None):
 
     mgr = build_manager(store, training, serving, storage_path=args.storage_path)
 
-    port = int(args.health_probe_bind_address.rsplit(":", 1)[-1])
+    # REST API (kubectl-shaped user surface + metrics) on the metrics address,
+    # plain health probes on the probe address — mirroring the reference's
+    # :8080/:8081 split (options.go:13-14)
+    from datatunerx_tpu.operator.apiserver import serve_api
+
+    api_host, _, api_port = args.metrics_bind_address.rpartition(":")
+    api_srv, api_port = serve_api(
+        store, manager=mgr, port=int(api_port),
+        host=api_host or "127.0.0.1",  # loopback unless explicitly widened
+    )
+
+    health_port = int(args.health_probe_bind_address.rsplit(":", 1)[-1])
     _HealthHandler.manager = mgr
-    srv = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+    srv = ThreadingHTTPServer(("0.0.0.0", health_port), _HealthHandler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
 
     mgr.sync_all()
     mgr.start()
-    print(f"[controller-manager] running; health on :{port}", flush=True)
+    print(
+        f"[controller-manager] running; api+metrics on :{api_port}, "
+        f"health on :{health_port}",
+        flush=True,
+    )
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         mgr.stop()
         srv.shutdown()
+        api_srv.shutdown()
     return 0
 
 

@@ -77,6 +77,7 @@ class TrainArgs:
     fp16: bool = False  # accepted for contract; bf16 is the TPU dtype
     bf16: bool = True
     # TPU additions
+    profile_steps: int = 0  # capture a jax.profiler trace for N steps
     mesh: Optional[str] = None  # e.g. "dp=4,fsdp=2,tp=1,sp=1"
     attention: str = "xla"  # xla | flash | ring
     remat: str = "dots"  # none | dots | full

@@ -170,6 +170,12 @@ def run(args: TrainArgs) -> dict:
     )
 
     # ----- loop --------------------------------------------------------
+    # profiling (SURVEY.md §5.1 — the reference exposes only the Ray
+    # dashboard): capture a profiler trace for steps [2, 2+N) viewable in
+    # TensorBoard/XProf; the trace dir lands in the completion manifest
+    trace_dir = os.path.join(args.output_dir, "trace")
+    profiling = {"active": False, "done": args.profile_steps <= 0}
+
     step = 0  # counts up through start_step (skipping those batches) on resume
     final_metrics: dict = {}
     epochs = range(int(math.ceil(total_steps / steps_per_epoch)))
@@ -184,8 +190,18 @@ def run(args: TrainArgs) -> dict:
             if step < start_step:  # resumed: fast-forward the data stream
                 step += 1
                 continue
+            if not profiling["done"] and not profiling["active"] and step >= start_step + 1:
+                jax.profiler.start_trace(trace_dir)
+                profiling["active"] = True
+                profiling["until"] = step + args.profile_steps
             state, metrics = trainer.train_step(state, batch)
             step += 1
+            if profiling["active"] and step >= profiling["until"]:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling.update(active=False, done=True)
+                if is_main:
+                    print(f"[profile] trace captured to {trace_dir}", flush=True)
             if is_main and (step % args.logging_steps == 0 or step == total_steps):
                 host = {k: float(v) for k, v in metrics.items()}
                 host["epoch"] = round(step / steps_per_epoch, 3)
@@ -201,6 +217,10 @@ def run(args: TrainArgs) -> dict:
             # eval_steps=0 → once per epoch (final epoch's eval happens below)
             _run_eval(trainer, state, eval_examples, args, pad_id, logger,
                       step, is_main, dist)
+
+    if profiling["active"]:  # window extended past the last step
+        jax.profiler.stop_trace()
+        profiling.update(active=False, done=True)
 
     # ----- final eval / save / manifest --------------------------------
     if eval_examples:
@@ -222,6 +242,7 @@ def run(args: TrainArgs) -> dict:
                 "template": args.template,
                 "mesh": dict(zip(("dp", "fsdp", "tp", "sp"), shape)),
                 "steps": step,
+                "trace": trace_dir if (args.profile_steps > 0 and profiling["done"]) else None,
             },
         )
         if args.export_dir:

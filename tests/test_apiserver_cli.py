@@ -1,0 +1,164 @@
+"""REST API server + dtx CLI: the kubectl/dtx-ctl-shaped user surface."""
+
+import json
+
+import pytest
+
+from datatunerx_tpu.cli import main as dtx_main
+from datatunerx_tpu.operator.apiserver import serve_api
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.operator.webhooks import AdmittingStore
+
+
+@pytest.fixture()
+def api():
+    store = AdmittingStore(ObjectStore())
+    srv, port = serve_api(store, port=0)
+    yield store, f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _req(method, url, payload=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _dataset(name="ds1"):
+    return {
+        "kind": "Dataset",
+        "metadata": {"name": name},
+        "spec": {"datasetMetadata": {"datasetInfo": {
+            "subsets": [{"splits": {"train": {"file": "/data/t.csv"}}}],
+            "features": [{"name": "instruction", "mapTo": "q"},
+                         {"name": "response", "mapTo": "a"}],
+        }}},
+    }
+
+
+def test_crud_roundtrip(api):
+    store, server = api
+    base = f"{server}/apis/extension.datatunerx.io/v1beta1/dataset"
+
+    code, resp = _req("POST", base, _dataset())
+    assert code == 201 and resp["metadata"]["resource_version"] == 1
+
+    code, resp = _req("GET", f"{base}/default/ds1")
+    assert code == 200 and resp["kind"] == "Dataset"
+
+    # stale update -> 409
+    stale = dict(resp)
+    stale["metadata"] = dict(resp["metadata"], resource_version=999)
+    code, _ = _req("PUT", f"{base}/default/ds1", stale)
+    assert code == 409
+
+    code, resp2 = _req("PUT", f"{base}/default/ds1",
+                       {**resp, "spec": {**resp["spec"]}})
+    assert code == 200
+
+    code, listing = _req("GET", f"{base}/default")
+    assert code == 200 and len(listing["items"]) == 1
+
+    code, _ = _req("DELETE", f"{base}/default/ds1")
+    assert code == 200
+    code, _ = _req("GET", f"{base}/default/ds1")
+    assert code == 404
+
+
+def test_admission_enforced_over_http(api):
+    store, server = api
+    base = f"{server}/apis/extension.datatunerx.io/v1beta1/datasets"  # plural ok
+    code, resp = _req("POST", base, {"kind": "Dataset",
+                                     "metadata": {"name": "bad"}, "spec": {}})
+    assert code == 422 and "subsets" in resp["error"]
+
+    hp_base = f"{server}/apis/core.datatunerx.io/v1beta1/hyperparameter"
+    code, resp = _req("POST", hp_base, {
+        "kind": "Hyperparameter", "metadata": {"name": "h"},
+        "spec": {"parameters": {"scheduler": "warp"}}})
+    assert code == 422
+
+
+def test_discovery_and_unknown_kind(api):
+    _, server = api
+    code, resp = _req("GET", f"{server}/apis")
+    assert code == 200 and "finetune.datatunerx.io" in resp["groups"]
+    code, _ = _req("GET", f"{server}/apis/x/v1/frobnicator")
+    assert code == 404
+
+
+def test_dtx_cli_flow(api, tmp_path, capsys):
+    _, server = api
+    manifest = tmp_path / "res.json"
+    manifest.write_text(json.dumps([
+        _dataset("cli-ds"),
+        {"kind": "Hyperparameter", "metadata": {"name": "cli-hp"}, "spec": {}},
+    ]))
+
+    assert dtx_main(["--server", server, "apply", "-f", str(manifest)]) == 0
+    out = capsys.readouterr().out
+    assert "Dataset/cli-ds created" in out
+    assert "Hyperparameter/cli-hp created" in out
+
+    # re-apply -> configured (update path via rv fetch)
+    assert dtx_main(["--server", server, "apply", "-f", str(manifest)]) == 0
+    assert "configured" in capsys.readouterr().out
+
+    assert dtx_main(["--server", server, "get", "datasets"]) == 0
+    out = capsys.readouterr().out
+    assert "cli-ds" in out and "NAME" in out
+
+    assert dtx_main(["--server", server, "get", "hp", "cli-hp", "-o", "json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    # defaulting webhook ran on create
+    assert parsed["spec"]["parameters"]["loRA_R"] == "8"
+
+    assert dtx_main(["--server", server, "delete", "dataset", "cli-ds"]) == 0
+    with pytest.raises(SystemExit):
+        dtx_main(["--server", server, "get", "dataset", "cli-ds"])
+        capsys.readouterr()
+
+
+def test_delete_unknown_kind_and_put_mismatch(api):
+    _, server = api
+    code, resp = _req("DELETE", f"{server}/apis/x/v1/frobnicator/default/foo")
+    assert code == 404
+
+    base = f"{server}/apis/extension.datatunerx.io/v1beta1/dataset"
+    code, created = _req("POST", base, _dataset("pm"))
+    assert code == 201
+    # body names a different object than the path -> 400
+    body = dict(created)
+    body["metadata"] = dict(created["metadata"], name="other")
+    code, resp = _req("PUT", f"{base}/default/pm", body)
+    assert code == 400 and "match the URL path" in resp["error"]
+
+    code, _ = _req("GET", f"{base}/default?labelSelector=oops")
+    assert code == 400
+
+
+def test_bearer_token_auth():
+    from datatunerx_tpu.operator.apiserver import serve_api as _serve
+
+    store = AdmittingStore(ObjectStore())
+    srv, port = _serve(store, port=0, token="s3cret")
+    base = f"http://127.0.0.1:{port}/apis/core.datatunerx.io/v1beta1/llm"
+    try:
+        code, resp = _req("GET", f"{base}/default")
+        assert code == 401
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{base}/default", headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()

@@ -185,3 +185,21 @@ def test_eval_once_per_epoch(tmp_path):
     eval_log = [json.loads(l) for l in open(os.path.join(out, "watch", "eval_log.jsonl"))]
     # one mid-epoch eval (after epoch 1) + final eval
     assert len(eval_log) == 2, eval_log
+
+
+def test_profile_trace_capture(tmp_path):
+    """--profile_steps captures a profiler trace + records it in the manifest."""
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    argv, out, storage = _flags(
+        tmp_path, template="vanilla", max_steps="3", bf16="false",
+        remat="none", profile_steps="1", quantization="",
+    )
+    args = parse_train_args(argv)
+    r = run(args)
+    assert r["steps"] == 3
+    trace_dir = os.path.join(out, "trace")
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
+    mf = json.load(open(os.path.join(storage, "test-uid-123", "manifest.json")))
+    assert mf["trace"] == trace_dir

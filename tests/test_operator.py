@@ -437,3 +437,51 @@ def test_experiment_all_failed(world):
     mgr.drain_scheduled()
     exp = store.get(FinetuneExperiment, "exp1")
     assert exp.status["state"] == FinetuneExperiment.STATE_FAILED
+
+
+def test_finetune_bounded_retry_with_resume(world):
+    """SURVEY §5.3: backoffLimit retries re-submit the job; the trainer resumes
+    from its checkpoint (same uid -> same storage key)."""
+    store, training, serving, mgr, storage = world
+    ft = Finetune(metadata=ObjectMeta(name="run-retry"), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"}, "backoffLimit": 2,
+    })
+    store.create(ft)
+    mgr.run_until_idle()
+
+    training.set_state("run-retry", "Failed")
+    mgr.enqueue("Finetune", "default", "run-retry")
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    obj = store.get(Finetune, "run-retry")
+    assert obj.status["retries"] == 1
+    assert obj.status["state"] != Finetune.STATE_FAILED
+    assert "run-retry" in training.jobs  # resubmitted
+
+    # second failure, then success
+    training.set_state("run-retry", "Failed")
+    mgr.enqueue("Finetune", "default", "run-retry")
+    mgr.run_until_idle()
+    mgr.drain_scheduled()
+    assert store.get(Finetune, "run-retry").status["retries"] == 2
+
+    write_manifest(storage, obj.metadata.uid, "/ckpt/r", metrics={})
+    training.set_state("run-retry", "Succeeded")
+    mgr.enqueue("Finetune", "default", "run-retry")
+    mgr.run_until_idle()
+    assert store.get(Finetune, "run-retry").status["state"] == Finetune.STATE_SUCCESSFUL
+
+    # exhausting the limit fails terminally
+    ft2 = Finetune(metadata=ObjectMeta(name="run-exhaust"), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"}, "backoffLimit": 0,
+    })
+    store.create(ft2)
+    mgr.run_until_idle()
+    training.set_state("run-exhaust", "Failed")
+    mgr.enqueue("Finetune", "default", "run-exhaust")
+    mgr.run_until_idle()
+    assert store.get(Finetune, "run-exhaust").status["state"] == Finetune.STATE_FAILED
