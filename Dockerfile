@@ -1,0 +1,13 @@
+# Operator image (parity with reference Dockerfile:1-17 — small runtime image
+# for the controller-manager; no accelerator needed).
+FROM python:3.12-slim
+
+WORKDIR /app
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+COPY pyproject.toml ./
+COPY datatunerx_tpu ./datatunerx_tpu
+RUN pip install --no-cache-dir . numpy
+
+EXPOSE 8080 8081
+ENTRYPOINT ["python", "-m", "datatunerx_tpu.operator.manager"]

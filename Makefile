@@ -1,0 +1,35 @@
+# Build/test/deploy targets (parity with the reference's kubebuilder Makefile
+# test/docker-build/deploy surface, Makefile:96-165).
+
+IMG_OPERATOR ?= datatunerx-tpu/operator:latest
+IMG_TRAINER  ?= datatunerx-tpu/trainer:latest
+
+.PHONY: test test-fast native bench graft-check docker-build deploy undeploy fmt
+
+test:            ## full test suite (8-device virtual CPU mesh)
+	python -m pytest tests/ -q
+
+test-fast:       ## skip the slow live-pipeline e2e
+	python -m pytest tests/ -q -m "not slow"
+
+native:          ## build the C++ data-path extension
+	python -c "from datatunerx_tpu import native; assert native.available(); print('native OK')"
+
+bench:           ## headline benchmark (one JSON line)
+	python bench.py
+
+graft-check:     ## driver contract: entry() + dryrun_multichip(8)
+	python scripts/graft_check.py
+
+docker-build:    ## operator + trainer images
+	docker build -t $(IMG_OPERATOR) -f Dockerfile .
+	docker build -t $(IMG_TRAINER) -f Dockerfile.trainer .
+
+deploy:          ## apply operator manifests to the current cluster
+	kubectl apply -f deploy/rbac.yaml -f deploy/operator.yaml
+
+undeploy:
+	kubectl delete -f deploy/operator.yaml -f deploy/rbac.yaml
+
+fmt:
+	python -m compileall -q datatunerx_tpu

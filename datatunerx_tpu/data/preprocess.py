@@ -106,8 +106,16 @@ def pad_to_block(
     examples: Sequence[Dict[str, List[int]]],
     block_size: int,
     pad_id: int = 0,
+    use_native: bool = True,
 ) -> Dict[str, np.ndarray]:
-    """Right-pad each example to the static block_size."""
+    """Right-pad each example to the static block_size. The hot loop runs in
+    the C++ extension when available (datatunerx_tpu/native)."""
+    if use_native and examples:
+        from datatunerx_tpu import native
+
+        out = native.fill_batch_native(examples, block_size, pad_id, IGNORE_INDEX)
+        if out is not None:
+            return out
     B = len(examples)
     input_ids = np.full((B, block_size), pad_id, np.int32)
     labels = np.full((B, block_size), IGNORE_INDEX, np.int32)
@@ -124,11 +132,18 @@ def pack_to_block(
     examples: Sequence[Dict[str, List[int]]],
     block_size: int,
     pad_id: int = 0,
+    use_native: bool = True,
 ) -> Dict[str, np.ndarray]:
     """Greedy first-fit packing of short examples into block_size rows with
     segment_ids; cross-segment attention is masked by the model. Raises the
     useful-token density vs plain padding (TPU static shapes make padding
     waste real FLOPs)."""
+    if use_native and examples:
+        from datatunerx_tpu import native
+
+        out = native.pack_batch_native(examples, block_size, pad_id, IGNORE_INDEX)
+        if out is not None:
+            return out
     rows: List[List[Dict[str, List[int]]]] = []
     used: List[int] = []
     for ex in sorted(examples, key=lambda e: -len(e["input_ids"])):

@@ -87,6 +87,14 @@ def main(argv=None):
     p.add_argument("--storage-path", default=None)
     args = p.parse_args(argv)
 
+    if args.storage_path:
+        # one source of truth: generate.py renders --storage_path for trainers
+        # from env config, and the Finetune controller reads manifests from the
+        # same key — both must see this value
+        import os
+
+        os.environ["STORAGE_PATH"] = args.storage_path
+
     store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
     if args.backend == "local":
         training = LocalProcessBackend(args.workdir)

@@ -1,0 +1,19 @@
+"""Driver-contract check: entry() compiles, dryrun_multichip(8) executes."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import importlib.util
+
+spec = importlib.util.spec_from_file_location("g", "__graft_entry__.py")
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+fn, args = m.entry()
+jax.eval_shape(fn, *args)
+m.dryrun_multichip(8)
+print("graft contract OK")
