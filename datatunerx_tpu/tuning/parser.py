@@ -76,6 +76,10 @@ class TrainArgs:
     seed: int = 42
     fp16: bool = False  # accepted for contract; bf16 is the TPU dtype
     bf16: bool = True
+    # generative eval (reference GenEvalSeq2SeqTrainer, cmd/tuning/trainer.py)
+    predict_with_generate: bool = False
+    max_new_tokens: int = 64
+    generate_examples: int = 32
     # TPU additions
     profile_steps: int = 0  # capture a jax.profiler trace for N steps
     mesh: Optional[str] = None  # e.g. "dp=4,fsdp=2,tp=1,sp=1"
@@ -128,7 +132,7 @@ class TrainArgs:
 
 
 _BOOLS = {"fp16", "bf16", "flash_attn", "shift_attn", "double_quantization",
-          "pack_sequences", "resume"}
+          "pack_sequences", "resume", "predict_with_generate"}
 _ALIASES = {"lora_r": "lora_rank"}  # controller emits --lora_r
 
 

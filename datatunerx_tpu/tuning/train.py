@@ -89,10 +89,12 @@ def run(args: TrainArgs) -> dict:
     if not train_examples:
         raise RuntimeError("Empty dataset!")
     eval_examples = None
+    eval_records = None
     if args.evaluation_path:
-        eval_examples = CsvDataset(
-            args.evaluation_path, columns=args.columns_map
-        ).encode(template, tokenizer, cutoff_len=args.block_size)
+        eval_ds = CsvDataset(args.evaluation_path, columns=args.columns_map)
+        eval_records = eval_ds.records
+        eval_examples = eval_ds.encode(template, tokenizer,
+                                       cutoff_len=args.block_size)
 
     # ----- mesh --------------------------------------------------------
     n_dev = len(jax.devices())
@@ -229,6 +231,35 @@ def run(args: TrainArgs) -> dict:
                       step, is_main, dist)
         )
     ckpt.maybe_save(state, step, force=True)
+
+    if args.predict_with_generate and eval_records:
+        # single-host only: generation is a process-0-only loop, which would
+        # touch non-addressable shards / desync collectives under multi-host
+        if dist["num_processes"] > 1:
+            if is_main:
+                print("[generate] skipped: predict_with_generate is "
+                      "single-host only for now", flush=True)
+        else:
+            from datatunerx_tpu.training.generate import generative_eval
+
+            gen_lora = None
+            if tcfg.finetuning_type == "lora":
+                gen_lora = (state.lora, trainer.scaling)
+            try:
+                gen_metrics = generative_eval(
+                    state.params, cfg, tokenizer, template, eval_records,
+                    args.output_dir,
+                    lora=gen_lora,
+                    max_new_tokens=args.max_new_tokens,
+                    max_examples=args.generate_examples,
+                    columns=args.columns_map,
+                )
+            except Exception as e:  # noqa: BLE001 — never lose a finished run
+                print(f"[generate] failed (run preserved): {e}", flush=True)
+                gen_metrics = {}
+            if gen_metrics:
+                logger.log_eval(step, gen_metrics)
+                final_metrics.update(gen_metrics)
 
     manifest_path = None
     if is_main:

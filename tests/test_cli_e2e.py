@@ -203,3 +203,23 @@ def test_profile_trace_capture(tmp_path):
     assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
     mf = json.load(open(os.path.join(storage, "test-uid-123", "manifest.json")))
     assert mf["trace"] == trace_dir
+
+
+def test_predict_with_generate(tmp_path):
+    """Generative eval: generated_predictions.jsonl + rouge/bleu in eval log
+    (reference GenEvalSeq2SeqTrainer contract)."""
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    argv, out, storage = _flags(
+        tmp_path, template="vanilla", max_steps="2", bf16="false",
+        remat="none", quantization="", predict_with_generate="true",
+        max_new_tokens="8", generate_examples="4",
+    )
+    args = parse_train_args(argv)
+    r = run(args)
+    preds = [json.loads(l) for l in
+             open(os.path.join(out, "generated_predictions.jsonl"))]
+    assert len(preds) == 4
+    assert {"prompt", "label", "predict"} <= set(preds[0])
+    assert {"rouge-1", "rouge-2", "rouge-l", "bleu-4"} <= set(r["metrics"])
