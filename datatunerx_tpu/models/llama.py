@@ -110,6 +110,10 @@ def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
     return out
 
 
+POS_SENTINEL = jnp.int32(2**30)  # marks invalid/pad cache slots: the causal
+# check kv_pos <= q_pos then masks them with no separate validity plumbing
+
+
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16):
     L = cfg.num_layers
     shape = (L, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
@@ -117,6 +121,9 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16):
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
         "len": jnp.zeros((), jnp.int32),
+        # rope position of each written slot (slots ≠ positions under
+        # left-padded prefill); sentinel = unwritten or pad
+        "pos": jnp.full((batch, max_len), POS_SENTINEL, jnp.int32),
     }
 
 
@@ -167,10 +174,19 @@ def forward(
         kv_positions = positions
         kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
         kv_seg = segment_ids
+        cache_pos = None
     else:
-        S = cache["k"].shape[2]
-        kv_positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        kv_valid = kv_positions < (cache["len"] + T)
+        # record each new slot's rope position; pads (attention_mask 0) get the
+        # sentinel so the causal check masks them everywhere
+        pos_update = positions
+        if attention_mask is not None:
+            pos_update = jnp.where(attention_mask.astype(bool), positions,
+                                   POS_SENTINEL)
+        cache_pos = jax.lax.dynamic_update_slice(
+            cache["pos"], pos_update, (0, cache["len"])
+        )
+        kv_positions = cache_pos
+        kv_valid = None  # sentinel positions handle both unwritten and pads
         kv_seg = None
     # flash/ring kernels are causal-only — exact for right-padded unpacked
     # batches; they also skip the [B, T, S] bias entirely (building it would
@@ -285,5 +301,6 @@ def forward(
 
     new_cache = None
     if cache is not None:
-        new_cache = {"k": new_k, "v": new_v, "len": cache["len"] + T}
+        new_cache = {"k": new_k, "v": new_v, "len": cache["len"] + T,
+                     "pos": cache_pos}
     return logits, new_cache

@@ -39,8 +39,12 @@ class InferenceEngine:
             self._apply_checkpoint(checkpoint_path)
         self.template: Template = get_template(template, self.tokenizer)
         self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
-        self._decode_step = jax.jit(self._decode_step_impl)
         self._prefill = jax.jit(self._prefill_impl, static_argnames=("prompt_len",))
+        # whole decode loop in ONE device program (lax.while_loop): per-token
+        # Python dispatch costs ~RTT each — fatal over a tunneled accelerator
+        self._decode_loop = jax.jit(
+            self._decode_loop_impl, static_argnames=("max_new_tokens",)
+        )
 
     # ---------------------------------------------------------- checkpoint
     def _apply_checkpoint(self, checkpoint_path: str):
@@ -81,20 +85,48 @@ class InferenceEngine:
             self.params = state["params"]
 
     # ------------------------------------------------------------ generate
-    def _prefill_impl(self, params, tokens, cache, prompt_len):
-        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+    def _prefill_impl(self, params, tokens, mask, positions, cache, prompt_len):
         logits, cache = forward(
-            params, tokens, self.cfg, positions=positions, cache=cache,
-            compute_dtype=jnp.bfloat16,
+            params, tokens, self.cfg, positions=positions,
+            attention_mask=mask, cache=cache, compute_dtype=jnp.bfloat16,
         )
         return logits[:, prompt_len - 1], cache
 
-    def _decode_step_impl(self, params, token, position, cache):
-        logits, cache = forward(
-            params, token, self.cfg, positions=position[None, None],
-            cache=cache, compute_dtype=jnp.bfloat16,
+    def _decode_loop_impl(self, params, first_logits, cache, start_pos,
+                          stop_arr, rng, temperature, top_p, limit, *,
+                          max_new_tokens: int):
+        """Greedy/sampled decode as one lax.while_loop program. Returns
+        (tokens [max_new_tokens buffer], n_generated); `limit` is the dynamic
+        request cap within the static buffer."""
+        out0 = jnp.zeros((max_new_tokens,), jnp.int32)
+
+        def sample(logits, rng):
+            return _sample_jit(logits, temperature, top_p, rng)
+
+        def cond(carry):
+            i, logits, cache, rng, out, stopped = carry
+            return (~stopped) & (i < limit)
+
+        def body(carry):
+            i, logits, cache, rng, out, stopped = carry
+            rng, sub = jax.random.split(rng)
+            nxt = sample(logits[0], sub)
+            stopped = jnp.any(nxt == stop_arr)
+            out = jnp.where(stopped, out, out.at[i].set(nxt))
+            logits2, cache = forward(
+                params, nxt[None, None], self.cfg,
+                positions=(start_pos + i)[None, None],
+                cache=cache, compute_dtype=jnp.bfloat16,
+            )
+            return (i + jnp.where(stopped, 0, 1), logits2[:, -1], cache, rng,
+                    out, stopped)
+
+        i, _, _, _, out, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.zeros((), jnp.int32), first_logits, cache, rng, out0,
+             jnp.zeros((), bool)),
         )
-        return logits[:, -1], cache
+        return out, i
 
     def generate(
         self,
@@ -105,29 +137,35 @@ class InferenceEngine:
         seed: int = 0,
         stop_ids: Optional[set] = None,
     ) -> List[int]:
-        stop_ids = stop_ids or {self.tokenizer.eos_token_id}
-        prompt_ids = prompt_ids[-(self.max_seq_len - max_new_tokens):]
-        total = len(prompt_ids) + max_new_tokens
-        cache = init_cache(self.cfg, 1, total, dtype=jnp.bfloat16)
+        from datatunerx_tpu.utils.decoding import prepare_prompt
 
-        tokens = jnp.asarray([prompt_ids], jnp.int32)
-        logits, cache = self._prefill(self.params, tokens, cache,
-                                      prompt_len=len(prompt_ids))
-        rng = jax.random.PRNGKey(seed)
-        out: List[int] = []
-        pos = len(prompt_ids)
-        for i in range(max_new_tokens):
-            rng, sub = jax.random.split(rng)
-            nxt = int(_sample(logits[0], temperature, top_p, sub))
-            if nxt in stop_ids:
-                break
-            out.append(nxt)
-            logits, cache = self._decode_step(
-                self.params, jnp.asarray([[nxt]], jnp.int32),
-                jnp.asarray(pos, jnp.int32), cache,
-            )
-            pos += 1
-        return out
+        stop_ids = {s for s in (stop_ids or {self.tokenizer.eos_token_id})
+                    if isinstance(s, int)}
+        stop_ids.add(self.tokenizer.eos_token_id)
+        ids, mask, positions, plen, n_prompt, max_new = prepare_prompt(
+            prompt_ids, self.tokenizer.eos_token_id, self.max_seq_len,
+            max_new_tokens,
+        )
+        buf = len(ids) and (-(-max_new // 64) * 64)
+        buf = min(buf, self.max_seq_len - plen)
+
+        cache = init_cache(self.cfg, 1, plen + buf, dtype=jnp.bfloat16)
+        logits, cache = self._prefill(
+            self.params, jnp.asarray([ids], jnp.int32),
+            jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
+            cache, prompt_len=plen,
+        )
+        stop_arr = jnp.asarray(sorted(stop_ids), jnp.int32)
+        out, n = self._decode_loop(
+            self.params, logits, cache,
+            jnp.asarray(n_prompt, jnp.int32), stop_arr,
+            jax.random.PRNGKey(seed),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(max_new, jnp.int32),
+            max_new_tokens=buf,
+        )
+        return [int(t) for t in list(out[: int(n)])]
 
     def chat(
         self,
@@ -160,7 +198,9 @@ class InferenceEngine:
         )
         stop_ids = {self.tokenizer.eos_token_id}
         for w in self.template.stop_words:
-            stop_ids.add(self.tokenizer.convert_tokens_to_ids(w))
+            tid = self.tokenizer.convert_tokens_to_ids(w)
+            if isinstance(tid, int):  # no-unk fast tokenizers return None
+                stop_ids.add(tid)
         out_ids = self.generate(
             prompt_ids, max_new_tokens=max_new_tokens, temperature=temperature,
             top_p=top_p, seed=seed, stop_ids=stop_ids,
@@ -168,17 +208,20 @@ class InferenceEngine:
         return self.tokenizer.decode(out_ids, skip_special_tokens=True)
 
 
-def _sample(logits: jnp.ndarray, temperature: float, top_p: float, rng) -> int:
-    if temperature <= 0.0:
-        return int(jnp.argmax(logits))
-    logits = logits / temperature
-    if top_p < 1.0:
-        sorted_idx = jnp.argsort(-logits)
-        sorted_logits = logits[sorted_idx]
-        probs = jax.nn.softmax(sorted_logits)
-        cum = jnp.cumsum(probs)
-        cut = cum - probs > top_p  # keep tokens until cumulative mass > top_p
-        sorted_logits = jnp.where(cut, -jnp.inf, sorted_logits)
-        choice = jax.random.categorical(rng, sorted_logits)
-        return int(sorted_idx[choice])
-    return int(jax.random.categorical(rng, logits))
+def _sample_jit(logits: jnp.ndarray, temperature, top_p, rng) -> jnp.ndarray:
+    """Traceable sampling: greedy when temperature<=0, else top-p sampling.
+    All branches computed and selected with where (cheap at vocab scale)."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+
+    t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t
+    sorted_idx = jnp.argsort(-scaled)
+    sorted_logits = scaled[sorted_idx]
+    probs = jax.nn.softmax(sorted_logits)
+    cum = jnp.cumsum(probs)
+    cut = (cum - probs > top_p) & (top_p < 1.0)
+    filtered = jnp.where(cut, -jnp.inf, sorted_logits)
+    choice = jax.random.categorical(rng, filtered)
+    sampled = sorted_idx[choice].astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)

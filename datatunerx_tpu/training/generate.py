@@ -21,8 +21,6 @@ from datatunerx_tpu.models.config import ModelConfig
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.scoring.metrics import generation_scores
 
-_BUCKET = 64
-
 
 def greedy_generate(
     params,
@@ -34,28 +32,27 @@ def greedy_generate(
     max_new_tokens: int = 64,
     stop_ids=None,
 ) -> List[int]:
-    stop_ids = set(stop_ids or []) | {tokenizer.eos_token_id}
-    max_prompt = cfg.max_seq_len - max_new_tokens
-    prompt_ids = prompt_ids[-max_prompt:]
-    # bucket the prompt length so repeated calls share compilations
-    padded_len = min(-(-len(prompt_ids) // _BUCKET) * _BUCKET, max_prompt)
-    pad = padded_len - len(prompt_ids)
-    # left-pad (reference uses left padding for generation, trainer.py:76-97):
-    # cache positions stay contiguous and the last prefill logit is the
-    # true next-token distribution
-    ids = [tokenizer.eos_token_id] * pad + list(prompt_ids)
-    total = padded_len + max_new_tokens
+    from datatunerx_tpu.utils.decoding import prepare_prompt
 
-    cache = init_cache(cfg, 1, total, dtype=jnp.bfloat16)
-    positions = jnp.asarray([list(range(padded_len))], jnp.int32)
+    stop_ids = {s for s in (stop_ids or set()) if isinstance(s, int)}
+    stop_ids.add(tokenizer.eos_token_id)
+    # left-pad (reference uses left padding for generation, trainer.py:76-97);
+    # pads are attention-masked and real tokens keep rope positions
+    # 0..len(prompt)-1 (cache slot != position handled by the cache's per-slot
+    # position record, models/llama.py)
+    ids, mask, positions, padded_len, n_prompt, max_new_tokens = prepare_prompt(
+        prompt_ids, tokenizer.eos_token_id, cfg.max_seq_len, max_new_tokens,
+    )
+    cache = init_cache(cfg, 1, padded_len + max_new_tokens, dtype=jnp.bfloat16)
     logits, cache = forward(
         params, jnp.asarray([ids], jnp.int32), cfg,
-        positions=positions, cache=cache, lora=lora,
+        positions=jnp.asarray([positions], jnp.int32),
+        attention_mask=jnp.asarray([mask], jnp.int32), cache=cache, lora=lora,
         compute_dtype=jnp.bfloat16,
     )
     out: List[int] = []
     nxt = int(jnp.argmax(logits[0, -1]))
-    pos = padded_len
+    pos = n_prompt
     for _ in range(max_new_tokens):
         if nxt in stop_ids:
             break

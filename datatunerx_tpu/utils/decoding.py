@@ -1,0 +1,40 @@
+"""Shared prompt-preparation for KV-cache generation (serving engine +
+in-training generative eval).
+
+Left-pad to a compile bucket with the pads attention-masked; real tokens keep
+rope positions 0..n-1 regardless of cache slot (models/llama.py records
+per-slot positions). Budgets are clamped so cache width never exceeds
+max_seq_len — oversized caches would wrongly trigger dynamic-NTK rope
+inflation (ops/rope.py reads the cache width as seq_len).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+DECODE_BUCKET = 64
+
+
+def prepare_prompt(
+    prompt_ids: List[int],
+    eos_id: int,
+    max_seq_len: int,
+    max_new_tokens: int,
+    bucket: int = DECODE_BUCKET,
+) -> Tuple[List[int], List[int], List[int], int, int, int]:
+    """Returns (ids, mask, positions, plen, n_prompt, max_new_clamped)."""
+    max_new = max(1, min(max_new_tokens, max_seq_len - bucket))
+    keep = max_seq_len - max_new
+    prompt_ids = list(prompt_ids)[-keep:]
+    n = max(len(prompt_ids), 1)
+    plen = min(-(-n // bucket) * bucket, keep)
+    prompt_ids = prompt_ids[-plen:]
+    n = len(prompt_ids)
+    pad = plen - n
+    ids = [eos_id] * pad + prompt_ids
+    mask = [0] * pad + [1] * n
+    positions = [0] * pad + list(range(n))
+    # clamp the decode budget so plen + buffer <= max_seq_len
+    buf = min(-(-max_new // bucket) * bucket, max_seq_len - plen)
+    max_new = min(max_new, buf)
+    return ids, mask, positions, plen, n, max_new

@@ -1,0 +1,103 @@
+"""Serving engine: jitted while-loop decode vs stepwise reference, sampling,
+chat templating."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models.llama import forward, init_cache
+from datatunerx_tpu.serving.engine import InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine("preset:debug", template="vanilla", max_seq_len=256)
+
+
+def _reference_greedy(engine, prompt_ids, max_new):
+    """Stepwise python-loop decode (the pre-jit implementation)."""
+    cfg, params, tok = engine.cfg, engine.params, engine.tokenizer
+    cache = init_cache(cfg, 1, len(prompt_ids) + max_new, dtype=jnp.bfloat16)
+    logits, cache = forward(
+        params, jnp.asarray([prompt_ids], jnp.int32), cfg,
+        positions=jnp.arange(len(prompt_ids))[None], cache=cache,
+        compute_dtype=jnp.bfloat16,
+    )
+    out = []
+    pos = len(prompt_ids)
+    nxt = int(jnp.argmax(logits[0, -1]))
+    for _ in range(max_new):
+        if nxt == tok.eos_token_id:
+            break
+        out.append(nxt)
+        logits, cache = forward(
+            params, jnp.asarray([[nxt]], jnp.int32), cfg,
+            positions=jnp.asarray([[pos]]), cache=cache,
+            compute_dtype=jnp.bfloat16,
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        pos += 1
+    return out
+
+
+def test_jit_decode_matches_stepwise(engine):
+    prompt = engine.tokenizer.encode("the quick brown fox")
+    a = engine.generate(prompt, max_new_tokens=12)
+    # left-pad bucketing must not change greedy output vs exact-length decode
+    b = _reference_greedy(engine, prompt, 12)
+    assert a == b, (a, b)
+
+
+def test_same_bucket_prompts_share_shapes(engine):
+    # both prompts land in the 64-token bucket; second call must reuse compiles
+    out1 = engine.generate(engine.tokenizer.encode("abc"), max_new_tokens=4)
+    out2 = engine.generate(engine.tokenizer.encode("a longer prompt here"),
+                           max_new_tokens=4)
+    assert isinstance(out1, list) and isinstance(out2, list)
+
+
+def test_sampling_deterministic_per_seed(engine):
+    prompt = engine.tokenizer.encode("hello")
+    a = engine.generate(prompt, max_new_tokens=8, temperature=0.9, seed=7)
+    b = engine.generate(prompt, max_new_tokens=8, temperature=0.9, seed=7)
+    c = engine.generate(prompt, max_new_tokens=8, temperature=0.9, seed=8)
+    assert a == b
+    # different seeds normally diverge on a random model (not guaranteed, but
+    # overwhelmingly likely over 8 tokens of a 3104-way softmax)
+    assert a != c or len(a) == 0
+
+
+def test_chat_assembles_history_and_system(engine):
+    msgs = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+        {"role": "user", "content": "bye"},
+    ]
+    # vanilla template ignores history/system but the assembly path must not
+    # crash and must produce a string
+    out = engine.chat(msgs, max_new_tokens=4)
+    assert isinstance(out, str)
+
+
+def test_max_tokens_cap(engine):
+    prompt = engine.tokenizer.encode("x" * 10)
+    out = engine.generate(prompt, max_new_tokens=3,
+                          stop_ids={-1})  # unreachable stop -> hits the cap
+    assert len(out) == 3
+
+
+def test_oversized_max_tokens_clamped(engine):
+    """max_tokens >= max_seq_len must degrade gracefully, not crash."""
+    prompt = engine.tokenizer.encode("hello world " * 50)
+    out = engine.generate(prompt, max_new_tokens=512, stop_ids={-1})
+    # engine max_seq_len=256 -> budget clamped; no trace error, bounded output
+    assert 0 < len(out) <= 256
+
+
+def test_long_prompt_truncated_not_overflowed(engine):
+    prompt = engine.tokenizer.encode("x" * 1000)  # >> max_seq_len
+    out = engine.generate(prompt, max_new_tokens=8, stop_ids={-1})
+    assert len(out) == 8
