@@ -137,17 +137,17 @@ class InferenceEngine:
         seed: int = 0,
         stop_ids: Optional[set] = None,
     ) -> List[int]:
+        import numbers
+
         from datatunerx_tpu.utils.decoding import prepare_prompt
 
-        stop_ids = {s for s in (stop_ids or {self.tokenizer.eos_token_id})
-                    if isinstance(s, int)}
+        stop_ids = {int(s) for s in (stop_ids or set())
+                    if isinstance(s, numbers.Integral)}
         stop_ids.add(self.tokenizer.eos_token_id)
-        ids, mask, positions, plen, n_prompt, max_new = prepare_prompt(
+        ids, mask, positions, plen, n_prompt, max_new, buf = prepare_prompt(
             prompt_ids, self.tokenizer.eos_token_id, self.max_seq_len,
             max_new_tokens,
         )
-        buf = len(ids) and (-(-max_new // 64) * 64)
-        buf = min(buf, self.max_seq_len - plen)
 
         cache = init_cache(self.cfg, 1, plen + buf, dtype=jnp.bfloat16)
         logits, cache = self._prefill(
@@ -165,7 +165,8 @@ class InferenceEngine:
             jnp.asarray(max_new, jnp.int32),
             max_new_tokens=buf,
         )
-        return [int(t) for t in list(out[: int(n)])]
+        n = int(n)
+        return np.asarray(out).tolist()[:n]  # ONE device->host fetch
 
     def chat(
         self,

@@ -32,18 +32,21 @@ def greedy_generate(
     max_new_tokens: int = 64,
     stop_ids=None,
 ) -> List[int]:
+    import numbers
+
     from datatunerx_tpu.utils.decoding import prepare_prompt
 
-    stop_ids = {s for s in (stop_ids or set()) if isinstance(s, int)}
+    stop_ids = {int(s) for s in (stop_ids or set())
+                if isinstance(s, numbers.Integral)}
     stop_ids.add(tokenizer.eos_token_id)
     # left-pad (reference uses left padding for generation, trainer.py:76-97);
     # pads are attention-masked and real tokens keep rope positions
     # 0..len(prompt)-1 (cache slot != position handled by the cache's per-slot
     # position record, models/llama.py)
-    ids, mask, positions, padded_len, n_prompt, max_new_tokens = prepare_prompt(
+    ids, mask, positions, padded_len, n_prompt, max_new_tokens, buf = prepare_prompt(
         prompt_ids, tokenizer.eos_token_id, cfg.max_seq_len, max_new_tokens,
     )
-    cache = init_cache(cfg, 1, padded_len + max_new_tokens, dtype=jnp.bfloat16)
+    cache = init_cache(cfg, 1, padded_len + buf, dtype=jnp.bfloat16)
     logits, cache = forward(
         params, jnp.asarray([ids], jnp.int32), cfg,
         positions=jnp.asarray([positions], jnp.int32),

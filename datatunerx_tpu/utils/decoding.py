@@ -22,12 +22,17 @@ def prepare_prompt(
     max_new_tokens: int,
     bucket: int = DECODE_BUCKET,
 ) -> Tuple[List[int], List[int], List[int], int, int, int]:
-    """Returns (ids, mask, positions, plen, n_prompt, max_new_clamped)."""
+    """Returns (ids, mask, positions, plen, n_prompt, max_new_clamped, buf).
+
+    buf is the static decode-buffer length (cache width = plen + buf)."""
     max_new = max(1, min(max_new_tokens, max_seq_len - bucket))
     keep = max_seq_len - max_new
     prompt_ids = list(prompt_ids)[-keep:]
-    n = max(len(prompt_ids), 1)
-    plen = min(-(-n // bucket) * bucket, keep)
+    if not prompt_ids:
+        # empty prompt: seed with a single (unmasked) eos — an all-masked
+        # prefill row would softmax to NaN
+        prompt_ids = [eos_id]
+    plen = min(-(-len(prompt_ids) // bucket) * bucket, keep)
     prompt_ids = prompt_ids[-plen:]
     n = len(prompt_ids)
     pad = plen - n
@@ -37,4 +42,4 @@ def prepare_prompt(
     # clamp the decode budget so plen + buffer <= max_seq_len
     buf = min(-(-max_new // bucket) * bucket, max_seq_len - plen)
     max_new = min(max_new, buf)
-    return ids, mask, positions, plen, n, max_new
+    return ids, mask, positions, plen, n, max_new, buf

@@ -10,7 +10,11 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 import importlib.util
 
-spec = importlib.util.spec_from_file_location("g", "__graft_entry__.py")
+spec = importlib.util.spec_from_file_location(
+    "g",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "__graft_entry__.py"),
+)
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)
 fn, args = m.entry()

@@ -223,3 +223,35 @@ def test_predict_with_generate(tmp_path):
     assert len(preds) == 4
     assert {"prompt", "label", "predict"} <= set(preds[0])
     assert {"rouge-1", "rouge-2", "rouge-l", "bleu-4"} <= set(r["metrics"])
+
+
+@pytest.mark.parametrize("preset", ["mistral-7b", "qwen1.5-7b"])
+def test_model_family_smoke(tmp_path, preset):
+    """Sliding-window (mistral) and qkv-bias (qwen) variants train through the
+    CLI on scaled-down dims."""
+    import dataclasses as _dc
+
+    from datatunerx_tpu.models.config import PRESETS, ModelConfig
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    big = PRESETS[preset]
+    tiny = _dc.replace(
+        big, name=f"tiny-{preset}", vocab_size=3104, hidden_size=32,
+        intermediate_size=64, num_layers=2, num_heads=4, num_kv_heads=big.num_kv_heads
+        if big.num_kv_heads <= 4 else 4, max_seq_len=128,
+        sliding_window=16 if big.sliding_window else None,
+    )
+    PRESETS[f"tiny-{preset}"] = tiny
+    try:
+        argv, out, storage = _flags(
+            tmp_path, template="vanilla", max_steps="2", bf16="false",
+            remat="none", quantization="",
+        )
+        argv[argv.index("preset:debug")] = f"preset:tiny-{preset}"
+        args = parse_train_args(argv)
+        r = run(args)
+        assert r["steps"] == 2
+        assert "loss" in r["metrics"]
+    finally:
+        del PRESETS[f"tiny-{preset}"]

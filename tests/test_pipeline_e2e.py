@@ -115,3 +115,85 @@ def test_minimum_end_to_end_slice(tmp_path):
     scoring = store.get(Scoring, "e2e")
     assert scoring.status["score"] == score
     assert len(scoring.status["details"]) == 5
+
+
+@pytest.mark.slow
+def test_concurrent_experiment_two_live_jobs(tmp_path):
+    """FinetuneExperiment fan-out with TWO live training subprocesses running
+    concurrently (north-star metric #2 shape: concurrent FinetuneJobs on
+    shared hardware), aggregated to bestVersion."""
+    from datatunerx_tpu.operator.api import FinetuneExperiment
+
+    storage = str(tmp_path / "storage")
+    train_csv = str(tmp_path / "train.csv")
+    rows = [("q %d" % k, "a %d" % k) for k in range(32)]
+    with open(train_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["q", "a"])
+        w.writerows(rows)
+
+    os.environ["STORAGE_PATH"] = storage
+    store = ObjectStore()
+    training = LocalProcessBackend(str(tmp_path / "jobs"), extra_env=CPU_ENV)
+    serving = LocalServingBackend(str(tmp_path / "jobs"), extra_env=CPU_ENV)
+    mgr = build_manager(store, training, serving, storage_path=storage,
+                        with_scoring=True)
+
+    store.create(LLM(metadata=ObjectMeta(name="m"), spec={"path": "preset:debug"}))
+    store.create(Hyperparameter(
+        metadata=ObjectMeta(name="hp"),
+        spec={"parameters": {
+            "scheduler": "constant", "optimizer": "adamw", "loRA_R": "4",
+            "loRA_Dropout": "0.0", "learningRate": "1e-2", "epochs": "1",
+            "blockSize": "64", "batchSize": "4", "PEFT": "true",
+        }},
+    ))
+    store.create(Dataset(
+        metadata=ObjectMeta(name="ds"),
+        spec={"datasetMetadata": {"datasetInfo": {
+            "subsets": [{"splits": {"train": {"file": train_csv}}}],
+            "features": [{"name": "instruction", "mapTo": "q"},
+                         {"name": "response", "mapTo": "a"}],
+        }}},
+    ))
+
+    def job_entry(name, lr):
+        return {"name": name, "spec": {"finetune": {
+            "name": f"{name}-finetune",
+            "finetuneSpec": {
+                "llm": "m", "dataset": "ds",
+                "hyperparameter": {"hyperparameterRef": "hp",
+                                   "overrides": {"learningRate": lr}},
+                "image": {"name": "local", "path": "preset:debug"},
+                "node": 1,
+            },
+        }}}
+
+    exp = FinetuneExperiment(
+        metadata=ObjectMeta(name="exp-live"),
+        spec={"finetuneJobs": [job_entry("cj1", "1e-2"), job_entry("cj2", "5e-3")]},
+    )
+    store.create(exp)
+
+    deadline = time.time() + 900
+    state = ""
+    overlapped = False
+    while time.time() < deadline:
+        mgr.drain_scheduled(horizon_s=120, max_wall_s=60)
+        running = [n for n in ("cj1-finetune", "cj2-finetune")
+                   if training.status(n) == "Running"]
+        overlapped = overlapped or len(running) == 2
+        state = store.get(FinetuneExperiment, "exp-live").status.get("state", "")
+        if state in ("Success", "Failed"):
+            break
+        time.sleep(2)
+
+    exp = store.get(FinetuneExperiment, "exp-live")
+    diag = json.dumps(exp.status, default=str)[:1200]
+    assert state == "Success", diag + "\n" + training.log_tail("cj1-finetune")
+    assert overlapped, "jobs never ran concurrently"
+    best = exp.status["bestVersion"]
+    assert best["hyperparameter"] == "hp"
+    scores = {s["name"]: s["status"]["result"]["score"]
+              for s in exp.status["jobsStatus"]}
+    assert best["score"] == max(scores.values(), key=float)

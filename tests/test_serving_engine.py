@@ -101,3 +101,21 @@ def test_long_prompt_truncated_not_overflowed(engine):
     prompt = engine.tokenizer.encode("x" * 1000)  # >> max_seq_len
     out = engine.generate(prompt, max_new_tokens=8, stop_ids={-1})
     assert len(out) == 8
+
+
+def test_empty_prompt_no_nan(engine):
+    out = engine.generate([], max_new_tokens=4, stop_ids={-1})
+    assert len(out) == 4
+    assert all(isinstance(t, int) and 0 <= t < engine.cfg.vocab_size for t in out)
+
+
+def test_numpy_stop_ids_respected(engine):
+    import numpy as _np
+
+    # np integer stop ids must not be dropped by the filter
+    prompt = engine.tokenizer.encode("abc")
+    full = engine.generate(prompt, max_new_tokens=8, stop_ids={-1})
+    if full:  # stop on the first token the model actually produces
+        stopped = engine.generate(prompt, max_new_tokens=8,
+                                  stop_ids={_np.int64(full[0])})
+        assert stopped == []
