@@ -263,6 +263,7 @@ class ManifestBackend:
                                 "--model_path", spec["model_path"],
                                 "--checkpoint_path", spec.get("checkpoint_path", ""),
                                 "--port", "8000",
+                                "--quantization", spec.get("quantization", ""),
                             ],
                             "ports": [{"containerPort": 8000}],
                             "readinessProbe": {

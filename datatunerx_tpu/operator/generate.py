@@ -195,6 +195,9 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         "labels": generate_instance_label(job.metadata.name),
         "node_selector": serve_cfg.get("nodeSelector", {}),
         "tolerations": serve_cfg.get("tolerations", []),
+        # serve-time base quantization (serving/engine.py): fit big models on
+        # one chip's HBM; TPU addition to ServeConfig
+        "quantization": serve_cfg.get("quantization", ""),
     }
 
 

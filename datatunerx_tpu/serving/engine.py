@@ -31,12 +31,22 @@ class InferenceEngine:
         template: str = "llama2",
         max_seq_len: int = 1024,
         dtype=jnp.bfloat16,
+        quantization: Optional[str] = None,
     ):
         self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
             model_path, dtype=dtype
         )
         if checkpoint_path:
             self._apply_checkpoint(checkpoint_path)
+        if quantization:
+            # post-load weight quantization: serve a 7B in ~7GB (int8) or
+            # ~3.5GB (nf4) of HBM — the serving-side use of ops/quant.py
+            import dataclasses
+
+            from datatunerx_tpu.ops.quant import quantize_model_params
+
+            self.params = quantize_model_params(self.params, quantization)
+            self.cfg = dataclasses.replace(self.cfg, quantization=quantization)
         self.template: Template = get_template(template, self.tokenizer)
         self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
         self._prefill = jax.jit(self._prefill_impl, static_argnames=("prompt_len",))

@@ -49,6 +49,7 @@ class LocalServingBackend:
                 "--checkpoint_path", spec.get("checkpoint_path") or "",
                 "--template", spec.get("template", self.template),
                 "--port", str(port),
+                "--quantization", spec.get("quantization") or "",
             ]
             from datatunerx_tpu.operator.backends import _pkg_root
 

@@ -96,7 +96,8 @@ class Handler(BaseHTTPRequestHandler):
         pass
 
 
-def load_engine_async(model_path, checkpoint_path, template, max_seq_len):
+def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
+                      quantization=None):
     def _load():
         try:
             from datatunerx_tpu.serving.engine import InferenceEngine
@@ -104,7 +105,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len):
             STATE.model_path = model_path
             STATE.engine = InferenceEngine(
                 model_path, checkpoint_path or None, template=template,
-                max_seq_len=max_seq_len,
+                max_seq_len=max_seq_len, quantization=quantization or None,
             )
         except Exception as e:  # noqa: BLE001
             STATE.error = str(e)
@@ -121,10 +122,13 @@ def main(argv=None):
     p.add_argument("--template", default="llama2")
     p.add_argument("--max_seq_len", type=int, default=1024)
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--quantization", default="",
+                   choices=["", "int8", "int4", "nf4"],
+                   help="serve-time base-weight quantization")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
-                      args.max_seq_len)
+                      args.max_seq_len, quantization=args.quantization)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

@@ -119,3 +119,36 @@ def test_numpy_stop_ids_respected(engine):
         stopped = engine.generate(prompt, max_new_tokens=8,
                                   stop_ids={_np.int64(full[0])})
         assert stopped == []
+
+
+def test_concurrent_chat_requests(engine):
+    """ThreadingHTTPServer serves requests concurrently; the engine must be
+    safe under parallel chat() calls (per-call caches, shared params)."""
+    import threading
+
+    results, errors = [], []
+
+    def worker(i):
+        try:
+            out = engine.chat([{"role": "user", "content": f"msg {i}"}],
+                              max_new_tokens=4)
+            results.append(out)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 4
+
+
+def test_quantized_engine_generates():
+    """Serve-time int8 quantization: engine quantizes post-load and decodes."""
+    e = InferenceEngine("preset:debug", template="vanilla", max_seq_len=128,
+                        quantization="int8")
+    assert "quant" in e.params["layers"]["q_proj"]
+    out = e.generate(e.tokenizer.encode("hello"), max_new_tokens=4, stop_ids={-1})
+    assert len(out) == 4
