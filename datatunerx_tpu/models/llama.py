@@ -188,14 +188,15 @@ def forward(
         kv_positions = cache_pos
         kv_valid = None  # sentinel positions handle both unwritten and pads
         kv_seg = None
-    # flash/ring kernels are causal-only — exact for right-padded unpacked
-    # batches; they also skip the [B, T, S] bias entirely (building it would
-    # defeat their O(T) memory win)
+    # flash/ring kernels skip the [B, T, S] bias entirely (building it would
+    # defeat their O(T) memory win). Flash handles causal + packed segments
+    # in-kernel; ring is causal-only. Cache decode and sliding window need the
+    # biased path.
     _flash_ok = (
         cfg.attention_impl in ("flash", "ring")
-        and segment_ids is None
         and cache is None
         and cfg.sliding_window is None
+        and (cfg.attention_impl != "ring" or segment_ids is None)
         and (cfg.attention_impl != "flash" or T % 128 == 0 or T < 128)
     )
     if _flash_ok:
@@ -259,7 +260,8 @@ def forward(
         else:
             k_att, v_att = k, v
 
-        attn = attention(q, k_att, v_att, bias, impl=att_impl)
+        attn = attention(q, k_att, v_att, bias, impl=att_impl,
+                         segment_ids=segment_ids if att_impl == "flash" else None)
         attn = attn.reshape(B, T, cfg.q_dim)
         x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3),
                       drop, qm, (cfg.q_dim, D), qp)

@@ -69,13 +69,14 @@ def attention(
     bias: jnp.ndarray,
     *,
     impl: str = "xla",
+    segment_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     if impl == "xla":
         return xla_attention(q, k, v, bias)
     if impl == "flash":
         from datatunerx_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, bias)
+        return flash_attention(q, k, v, bias, segment_ids=segment_ids)
     if impl == "ring":
         from datatunerx_tpu.ops.ring_attention import (
             get_ring_context,

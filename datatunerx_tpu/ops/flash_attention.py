@@ -7,10 +7,11 @@ backward recomputes probabilities tile-by-tile (standard FlashAttention-2
 scheme: one kernel accumulates dQ over K tiles, one accumulates dK/dV over Q
 tiles, with D = rowsum(dO ∘ O) precomputed).
 
-Exact for right-padded unpacked batches: pads sit at the sequence tail, so no
-valid query attends a pad key, and pad queries' outputs are loss-masked.
-Packed segments / sliding window / cache decode fall back to the biased XLA
-path (models/llama.py).
+Masking is handled in-kernel: causal by row index, plus packed-segment
+isolation via per-row segment ids (all-equal ids degenerate to plain causal,
+so unpacked right-padded batches are exact — pads sit at the tail where no
+valid query can attend them). Sliding window and cache decode fall back to the
+biased XLA path (models/llama.py).
 """
 
 from __future__ import annotations
@@ -32,7 +33,8 @@ def _interpret() -> bool:
 
 # ------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
                 *, block_q: int, block_k: int, scale: float):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -54,7 +56,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        mask = k_pos <= q_pos
+        # packed-segment isolation (all-equal ids = plain causal)
+        mask &= qseg_ref[0][:, None] == kseg_ref[0][None, :]
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -87,7 +92,7 @@ def _kv_index(H: int, G: int):
     return index
 
 
-def _fwd(q, k, v, *, block_q, block_k, interpret, H, G):
+def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
     BH, T, d = q.shape
     S = k.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -102,6 +107,8 @@ def _fwd(q, k, v, *, block_q, block_k, interpret, H, G):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_idx),
             pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // H, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -117,13 +124,14 @@ def _fwd(q, k, v, *, block_q, block_k, interpret, H, G):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, q_seg, kv_seg)
     return out, lse[:, :, 0]
 
 
 # ------------------------------------------------------------- backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                   qseg_ref, kseg_ref, dq_ref,
                    acc_ref, *, block_q: int, block_k: int, scale: float):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -142,7 +150,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos <= q_pos
+        mask = (k_pos <= q_pos) & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)
 
         do = do_ref[0].astype(jnp.float32)
@@ -161,6 +169,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                    qseg_ref, kseg_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, block_q: int, block_k: int, scale: float):
     j = pl.program_id(1)  # k tile
@@ -181,7 +190,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos <= q_pos
+        mask = (k_pos <= q_pos) & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)  # [bq, bk]
 
         do = do_ref[0].astype(jnp.float32)  # [bq, d]
@@ -206,7 +215,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
 def _bwd(block_q, block_k, interpret, G, res, do):
     """K/V arrive un-expanded [B*KV, S, d]; expand here (backward only) and
     group-sum dk/dv at the end — forward never materializes the repeat."""
-    q, k, v, out, lse = res
+    q, k, v, q_seg, kv_seg, out, lse = res
     BH, T, d = q.shape
     if G > 1:
         BKV = k.shape[0]
@@ -217,6 +226,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
     if interpret is None:
         interpret = _interpret()
 
+    H_ = BH // q_seg.shape[0]  # q heads per batch row (segment index maps)
     dsum = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse_b = jnp.broadcast_to(lse[:, :, None], (BH, T, _LANES))
     dsum_b = jnp.broadcast_to(dsum[:, :, None], (BH, T, _LANES))
@@ -232,12 +242,14 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // H_, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H_, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_b, dsum_b)
+    )(q, k, v, do, lse_b, dsum_b, q_seg, kv_seg)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -250,6 +262,8 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b // H_, i)),
+            pl.BlockSpec((1, block_k), lambda b, j, i: (b // H_, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -264,7 +278,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_b, dsum_b)
+    )(q, k, v, do, lse_b, dsum_b, q_seg, kv_seg)
     if G > 1:
         dk = dk.reshape(BKV, G, S, d).sum(axis=1)
         dv = dv.reshape(BKV, G, S, d).sum(axis=1)
@@ -273,25 +287,28 @@ def _bwd(block_q, block_k, interpret, G, res, do):
 
 # --------------------------------------------------------------- public
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention_causal(q, k, v, block_q: int = 512, block_k: int = 512,
-                           interpret=None, H: int = 1, G: int = 1):
-    """q: [B*H, T, d]; k, v: [B*KV, S, d] (un-expanded GQA). Causal."""
-    out, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_causal(q, k, v, q_seg, kv_seg, block_q: int = 512,
+                           block_k: int = 512, interpret=None, H: int = 1,
+                           G: int = 1):
+    """q: [B*H, T, d]; k, v: [B*KV, S, d] (un-expanded GQA);
+    q_seg/kv_seg: [B, T]/[B, S] int32 segment ids (all-equal = plain causal)."""
+    out, _ = _fwd(q, k, v, q_seg, kv_seg, block_q=block_q, block_k=block_k,
                   interpret=_interpret() if interpret is None else interpret,
                   H=H, G=G)
     return out
 
 
-def _vjp_fwd(q, k, v, block_q, block_k, interpret, H, G):
-    out, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k,
+def _vjp_fwd(q, k, v, q_seg, kv_seg, block_q, block_k, interpret, H, G):
+    out, lse = _fwd(q, k, v, q_seg, kv_seg, block_q=block_q, block_k=block_k,
                     interpret=_interpret() if interpret is None else interpret,
                     H=H, G=G)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _vjp_bwd(block_q, block_k, interpret, H, G, res, do):
-    return _bwd(block_q, block_k, interpret, G, res, do)
+    dq, dk, dv = _bwd(block_q, block_k, interpret, G, res, do)
+    return dq, dk, dv, None, None
 
 
 flash_attention_causal.defvjp(_vjp_fwd, _vjp_bwd)
@@ -311,19 +328,31 @@ def flash_attention(
     v: jnp.ndarray,
     bias=None,  # accepted for dispatch parity; causal handled in-kernel
     *,
+    segment_ids: jnp.ndarray | None = None,  # [B, T] packed-segment ids
     block_q: int = 512,
     block_k: int = 512,
     interpret=None,
 ) -> jnp.ndarray:
     """GQA wrapper: fold (B, H) into the grid dim; KV stays un-expanded and the
-    kernel's index_map routes each q head to its KV group."""
+    kernel's index_map routes each q head to its KV group. With segment_ids,
+    attention is additionally confined within packed segments (self-attention:
+    T == S, ids shared between q and kv)."""
     B, T, H, d = q.shape
     S, KV = k.shape[1], k.shape[2]
     G = H // KV
     block_q = min(block_q, _pick_block(T))
     block_k = min(block_k, _pick_block(S))
+    if segment_ids is None:
+        q_seg = jnp.ones((B, T), jnp.int32)
+        kv_seg = jnp.ones((B, S), jnp.int32)
+    else:
+        assert T == S, (
+            f"segment_ids requires self-attention (T == S), got T={T} S={S}")
+        q_seg = segment_ids.astype(jnp.int32)
+        kv_seg = q_seg  # self-attention
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
     kf = k.transpose(0, 2, 1, 3).reshape(B * KV, S, d)
     vf = v.transpose(0, 2, 1, 3).reshape(B * KV, S, d)
-    out = flash_attention_causal(qf, kf, vf, block_q, block_k, interpret, H, G)
+    out = flash_attention_causal(qf, kf, vf, q_seg, kv_seg, block_q, block_k,
+                                 interpret, H, G)
     return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)

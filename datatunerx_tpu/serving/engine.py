@@ -39,13 +39,23 @@ class InferenceEngine:
         if checkpoint_path:
             self._apply_checkpoint(checkpoint_path)
         if quantization:
-            # post-load weight quantization: serve a 7B in ~7GB (int8) or
-            # ~3.5GB (nf4) of HBM — the serving-side use of ops/quant.py
+            # serve-time weight quantization (int8 ≈ half, nf4 ≈ quarter of
+            # bf16 HBM). Quantize on the HOST, then upload only the quantized
+            # tree — quantizing on-device would need full-precision + quantized
+            # resident simultaneously, OOMing exactly the big-model case this
+            # feature exists for.
             import dataclasses
 
             from datatunerx_tpu.ops.quant import quantize_model_params
 
-            self.params = quantize_model_params(self.params, quantization)
+            host_params = jax.device_get(self.params)
+            cpu = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
+            if cpu is not None:
+                with jax.default_device(cpu):
+                    qparams = quantize_model_params(host_params, quantization)
+                self.params = jax.device_put(jax.device_get(qparams))
+            else:
+                self.params = quantize_model_params(host_params, quantization)
             self.cfg = dataclasses.replace(self.cfg, quantization=quantization)
         self.template: Template = get_template(template, self.tokenizer)
         self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
