@@ -9,6 +9,7 @@ Talks to the operator's REST API (operator/apiserver.py):
   dtx get <kind> [name] [-n ns] [-o json]
   dtx delete <kind> <name> [-n ns]
   dtx status <finetunejob-name>        condensed pipeline view
+  dtx logs <finetune-name>             trainer log tail (local backend)
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.
@@ -175,6 +176,13 @@ def cmd_status(args):
     print(f"  checkpoint: {result.get('checkpointPath', '')}")
 
 
+def cmd_logs(args):
+    code, resp = _request("GET", f"{args.server}/logs/{args.namespace}/{args.name}")
+    if code != 200:
+        sys.exit(f"error: {resp.get('error')}")
+    print(resp.get("log", ""), end="")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dtx")
     p.add_argument("--server", default=os.environ.get("DTX_SERVER",
@@ -202,6 +210,11 @@ def main(argv=None):
     sp.add_argument("name")
     sp.add_argument("-n", "--namespace", default="default")
     sp.set_defaults(fn=cmd_status)
+
+    lp = sub.add_parser("logs")
+    lp.add_argument("name")
+    lp.add_argument("-n", "--namespace", default="default")
+    lp.set_defaults(fn=cmd_logs)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -101,6 +101,21 @@ class ApiHandler(BaseHTTPRequestHandler):
             return
         if url.path == "/apis":
             return self._send(200, {"groups": _GROUPS})
+        if url.path.startswith("/logs/"):
+            # /logs/{ns}/{name}: trainer log tail for a Finetune (local backend)
+            parts = [p for p in url.path.split("/")[2:] if p]
+            if len(parts) != 2:
+                return self._send(400, {"error": "use /logs/{namespace}/{name}"})
+            ns, name = parts
+            if not re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name):
+                return self._send(400, {"error": "invalid job name"})
+            if self.store.try_get("Finetune", name, ns) is None:
+                return self._send(404, {"error": f"Finetune {ns}/{name} not found"})
+            backend = getattr(self.manager, "training_backend", None) if self.manager else None
+            tail = getattr(backend, "log_tail", None)
+            if tail is None:
+                return self._send(501, {"error": "log tail not supported by this backend"})
+            return self._send(200, {"name": name, "log": tail(name, 100)})
 
         m = _PATH.match(url.path)
         if not m:

@@ -160,11 +160,15 @@ class LocalProcessBackend:
             except subprocess.TimeoutExpired:
                 proc.kill()
 
-    def log_tail(self, name: str, n: int = 40) -> str:
+    def log_tail(self, name: str, n: int = 40, max_bytes: int = 256 * 1024) -> str:
         path = os.path.join(self.workdir, name, "log.txt")
         try:
-            with open(path) as f:
-                return "".join(f.readlines()[-n:])
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - max_bytes, 0))
+                data = f.read().decode(errors="replace")
+            return "".join(data.splitlines(keepends=True)[-n:])
         except OSError:
             return ""
 

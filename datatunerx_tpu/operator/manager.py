@@ -42,6 +42,7 @@ def build_manager(
     with_scoring: bool = True,
 ) -> Manager:
     mgr = Manager(store)
+    mgr.training_backend = training_backend  # exposed for the /logs endpoint
     mgr.register(FinetuneController(training_backend, storage_path=storage_path))
     mgr.register(FinetuneJobController(serving_backend))
     mgr.register(FinetuneExperimentController())

@@ -162,3 +162,38 @@ def test_bearer_token_auth():
             assert r.status == 200
     finally:
         srv.shutdown()
+
+
+def test_logs_endpoint_and_cli(tmp_path, capsys):
+    """/logs/<ns>/<name> serves the trainer log tail; dtx logs prints it."""
+    from datatunerx_tpu.operator.backends import FakeServingBackend, LocalProcessBackend
+    from datatunerx_tpu.operator.manager import build_manager
+
+    store = AdmittingStore(ObjectStore())
+    backend = LocalProcessBackend(str(tmp_path / "jobs"))
+    mgr = build_manager(store, backend, FakeServingBackend(),
+                        storage_path=str(tmp_path), with_scoring=False)
+    # the Finetune CR must exist for its logs to be addressable
+    from datatunerx_tpu.operator.api import Finetune, ObjectMeta
+
+    store._store.create(Finetune(metadata=ObjectMeta(name="myrun")))  # bypass admission
+    jobdir = tmp_path / "jobs" / "myrun"
+    jobdir.mkdir(parents=True)
+    (jobdir / "log.txt").write_text("line1\nline2\n")
+
+    srv, port = serve_api(store, manager=mgr, port=0)
+    try:
+        server = f"http://127.0.0.1:{port}"
+        code, resp = _req("GET", f"{server}/logs/default/myrun")
+        assert code == 200 and "line2" in resp["log"]
+
+        assert dtx_main(["--server", server, "logs", "myrun"]) == 0
+        assert "line1" in capsys.readouterr().out
+
+        # unknown job -> 404; path-escape name -> 400
+        code, _ = _req("GET", f"{server}/logs/default/nope")
+        assert code == 404
+        code, _ = _req("GET", f"{server}/logs/default/..%2f..")
+        assert code in (400, 404)
+    finally:
+        srv.shutdown()
