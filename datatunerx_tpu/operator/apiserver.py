@@ -90,10 +90,18 @@ class ApiHandler(BaseHTTPRequestHandler):
             return self._send(401, {"error": "unauthorized"})
         if url.path == "/metrics":
             n_err = len(self.manager.errors) if self.manager else 0
-            body = (
-                "# TYPE dtx_operator_reconcile_errors_total counter\n"
-                f"dtx_operator_reconcile_errors_total {n_err}\n"
-            ).encode()
+            lines = [
+                "# TYPE dtx_operator_reconcile_errors_total counter",
+                f"dtx_operator_reconcile_errors_total {n_err}",
+                "# TYPE dtx_operator_reconciles_total counter",
+            ]
+            counts = dict(  # snapshot: the manager thread inserts keys live
+                getattr(self.manager, "reconcile_counts", {}) if self.manager else {}
+            )
+            for kind, n in sorted(counts.items()):
+                lines.append(
+                    f'dtx_operator_reconciles_total{{kind="{kind}"}} {n}')
+            body = ("\n".join(lines) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
             self.end_headers()

@@ -50,6 +50,7 @@ class Manager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.errors: List[Tuple[str, BaseException]] = []
+        self.reconcile_counts: dict = {}  # kind -> reconciles run
         store.watch(self._on_event)
 
     # ------------------------------------------------------------ plumbing
@@ -90,6 +91,7 @@ class Manager:
         obj = self.store.try_get(kind, name, namespace)
         if obj is None:
             return
+        self.reconcile_counts[kind] = self.reconcile_counts.get(kind, 0) + 1
         try:
             result = controller.reconcile(self.store, obj)
         except Conflict:

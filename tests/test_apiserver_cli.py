@@ -197,3 +197,25 @@ def test_logs_endpoint_and_cli(tmp_path, capsys):
         assert code in (400, 404)
     finally:
         srv.shutdown()
+
+
+def test_metrics_reconcile_counters(tmp_path):
+    from datatunerx_tpu.operator.backends import FakeServingBackend, FakeTrainingBackend
+    from datatunerx_tpu.operator.manager import build_manager
+    from datatunerx_tpu.operator.api import LLM, ObjectMeta
+    import urllib.request
+
+    raw = ObjectStore()
+    mgr = build_manager(raw, FakeTrainingBackend(), FakeServingBackend(),
+                        storage_path=str(tmp_path), with_scoring=False)
+    from datatunerx_tpu.operator.api import Finetune
+
+    raw.create(Finetune(metadata=ObjectMeta(name="f1"), spec={"llm": "x"}))
+    mgr.run_until_idle()
+    srv, port = serve_api(raw, manager=mgr, port=0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'dtx_operator_reconciles_total{kind="Finetune"}' in text
+    finally:
+        srv.shutdown()
