@@ -19,7 +19,7 @@ import sys
 import threading
 import time
 
-BENCH_TIMEOUT_S = float(os.environ.get("DTX_BENCH_TIMEOUT_S", "900"))
+BENCH_TIMEOUT_S = float(os.environ.get("DTX_BENCH_TIMEOUT_S", "480"))
 
 # Round-1 recorded tokens/sec/chip on TPU v5e-1 (see BASELINE.md); update only
 # alongside BASELINE.md.

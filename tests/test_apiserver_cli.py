@@ -202,13 +202,12 @@ def test_logs_endpoint_and_cli(tmp_path, capsys):
 def test_metrics_reconcile_counters(tmp_path):
     from datatunerx_tpu.operator.backends import FakeServingBackend, FakeTrainingBackend
     from datatunerx_tpu.operator.manager import build_manager
-    from datatunerx_tpu.operator.api import LLM, ObjectMeta
+    from datatunerx_tpu.operator.api import Finetune, ObjectMeta
     import urllib.request
 
     raw = ObjectStore()
     mgr = build_manager(raw, FakeTrainingBackend(), FakeServingBackend(),
                         storage_path=str(tmp_path), with_scoring=False)
-    from datatunerx_tpu.operator.api import Finetune
 
     raw.create(Finetune(metadata=ObjectMeta(name="f1"), spec={"llm": "x"}))
     mgr.run_until_idle()
