@@ -202,17 +202,21 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
 
 
 def generate_builtin_scoring(job: FinetuneJob, inference_url: str) -> Scoring:
-    """Reference generate.go:331-341: plugin-less Scoring CR."""
+    """Reference generate.go:331-341: plugin-less Scoring CR. Probes may be
+    customized per job via spec.scoringProbes [{prompt, reference}]."""
+    spec = {
+        "inferenceService": inference_url,
+        "plugin": {"loadPlugin": False},
+    }
+    if job.spec.get("scoringProbes"):
+        spec["probes"] = job.spec["scoringProbes"]
     sc = Scoring(
         metadata=ObjectMeta(
             name=job.metadata.name,
             namespace=job.metadata.namespace,
             labels=generate_instance_label(job.metadata.name),
         ),
-        spec={
-            "inferenceService": inference_url,
-            "plugin": {"loadPlugin": False},
-        },
+        spec=spec,
     )
     set_owner(sc, job)
     return sc

@@ -17,6 +17,7 @@ from datatunerx_tpu.operator.api import (
     FinetuneJob,
     Hyperparameter,
     LLM,
+    Scoring,
 )
 
 SCHEDULERS = ("cosine", "linear", "constant", "constant_with_warmup",
@@ -96,6 +97,7 @@ def validate_finetunejob(obj: CustomResource):
     if plugin and plugin.get("name") is not None:
         _require(bool(str(plugin["name"]).strip()),
                  "scoringPluginConfig.name must be non-empty when set")
+    _validate_probes(obj.spec.get("scoringProbes"))
 
 
 def validate_finetuneexperiment(obj: CustomResource):
@@ -132,12 +134,31 @@ def default_hyperparameter(obj: CustomResource):
     p.setdefault("PEFT", "true")
 
 
+def _validate_probes(probes):
+    if probes is None:
+        return
+    _require(isinstance(probes, list) and probes,
+             "scoring probes must be a non-empty list")
+    for pr in probes:
+        _require(isinstance(pr, dict)
+                 and isinstance(pr.get("prompt"), str) and pr["prompt"]
+                 and isinstance(pr.get("reference"), str) and pr["reference"],
+                 "each scoring probe needs non-empty 'prompt' and 'reference'")
+
+
+def validate_scoring(obj: CustomResource):
+    _require(bool(obj.spec.get("inferenceService")),
+             "spec.inferenceService is required")
+    _validate_probes(obj.spec.get("probes"))
+
+
 VALIDATORS: Dict[str, Callable] = {
     Hyperparameter.kind: validate_hyperparameter,
     Dataset.kind: validate_dataset,
     LLM.kind: validate_llm,
     FinetuneJob.kind: validate_finetunejob,
     FinetuneExperiment.kind: validate_finetuneexperiment,
+    Scoring.kind: validate_scoring,
 }
 DEFAULTERS: Dict[str, Callable] = {
     FinetuneJob.kind: default_finetunejob,

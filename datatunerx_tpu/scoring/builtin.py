@@ -53,7 +53,7 @@ def score_endpoint(
     total = 0.0
     for probe in probes:
         answer = query_chat(inference_url, probe["prompt"], timeout=timeout)
-        s = generation_scores(answer, probe["reference"])
+        s = generation_scores(answer, probe["reference"], strict_bleu=True)
         per = max(s["rouge-l"], s["bleu-4"])
         total += per
         details.append({"prompt": probe["prompt"], "answer": answer, **s})

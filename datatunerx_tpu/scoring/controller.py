@@ -45,8 +45,16 @@ class ScoringController:
                                    plugin.get("parameters"))
                 details = None
             else:
-                result = score_endpoint(url, timeout=self.timeout)
+                # built-in scorer accepts CR-supplied probes
+                # (spec.probes: [{prompt, reference}]); defaults otherwise
+                probes = scoring.spec.get("probes") or None
+                result = score_endpoint(url, probes=probes, timeout=self.timeout)
                 score, details = result["score"], result["details"]
+        except (KeyError, TypeError, ValueError) as e:
+            # malformed spec (bad probes/parameters): permanent — do not retry
+            scoring.status["error"] = f"invalid scoring spec: {e!r}"[:500]
+            store.update(scoring)
+            return None
         except Exception as e:  # endpoint not ready / transient — retry
             scoring.status["lastError"] = str(e)[:500]
             store.update(scoring)

@@ -53,7 +53,11 @@ def rouge_l(candidate: str, reference: str) -> float:
     return 2 * p * r / (p + r)
 
 
-def bleu4(candidate: str, reference: str) -> float:
+def bleu4(candidate: str, reference: str, zero_unigram_zero: bool = False) -> float:
+    """Smoothed BLEU-4. With zero_unigram_zero (the SCORER's mode), zero
+    unigram overlap returns 0 — uniform +1 smoothing otherwise scores 1-token
+    garbage ~0.5, which corrupts probe-based model scoring. The default keeps
+    uniform smoothing for eval-curve continuity (training generative eval)."""
     cand, ref = _tokens(candidate), _tokens(reference)
     if not cand:
         return 0.0
@@ -62,16 +66,22 @@ def bleu4(candidate: str, reference: str) -> float:
         c, r = _ngram_counts(cand, n), _ngram_counts(ref, n)
         total = max(sum(c.values()), 1)
         overlap = sum((c & r).values())
-        # +1 smoothing (method-1) so short strings don't zero out
-        logs += math.log((overlap + 1) / (total + 1))
+        if n == 1 and zero_unigram_zero:
+            if overlap == 0:
+                return 0.0
+            logs += math.log(overlap / total)
+        else:
+            # +1 smoothing (method-1) so short strings don't zero out
+            logs += math.log((overlap + 1) / (total + 1))
     bp = 1.0 if len(cand) >= len(ref) else math.exp(1 - len(ref) / max(len(cand), 1))
     return bp * math.exp(logs / 4)
 
 
-def generation_scores(candidate: str, reference: str) -> Dict[str, float]:
+def generation_scores(candidate: str, reference: str,
+                      strict_bleu: bool = False) -> Dict[str, float]:
     return {
         "rouge-1": rouge_n(candidate, reference, 1),
         "rouge-2": rouge_n(candidate, reference, 2),
         "rouge-l": rouge_l(candidate, reference),
-        "bleu-4": bleu4(candidate, reference),
+        "bleu-4": bleu4(candidate, reference, zero_unigram_zero=strict_bleu),
     }

@@ -1,0 +1,98 @@
+"""Scoring: metrics math, built-in scorer with custom probes, plugin path,
+controller retry semantics."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from datatunerx_tpu.operator.api import ObjectMeta, Scoring
+from datatunerx_tpu.operator.manager import build_manager
+from datatunerx_tpu.operator.backends import FakeServingBackend, FakeTrainingBackend
+from datatunerx_tpu.operator.store import ObjectStore
+from datatunerx_tpu.scoring.metrics import bleu4, generation_scores, rouge_l, rouge_n
+from datatunerx_tpu.scoring.plugin import register_plugin
+
+
+def test_metrics_math():
+    assert rouge_n("the cat sat", "the cat sat", 1) == 1.0
+    assert rouge_n("dog", "the cat sat", 1) == 0.0
+    assert rouge_l("a b c d", "a x c d") == pytest.approx(0.75)
+    assert bleu4("same tokens here ok", "same tokens here ok") == pytest.approx(1.0)
+    s = generation_scores("paris", "Paris is the capital")
+    assert 0 <= s["rouge-1"] <= 1
+
+
+class _ChatStub(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        prompt = body["messages"][0]["content"]
+        answer = {"say blue": "blue", "say cat": "cat"}.get(prompt, "dunno")
+        payload = json.dumps({"choices": [{"message": {"content": answer}}]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def chat_stub():
+    srv = HTTPServer(("127.0.0.1", 0), _ChatStub)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}/chat/completions"
+    srv.shutdown()
+
+
+def test_builtin_scoring_with_custom_probes(chat_stub, tmp_path):
+    store = ObjectStore()
+    mgr = build_manager(store, FakeTrainingBackend(), FakeServingBackend(),
+                        storage_path=str(tmp_path), with_scoring=True)
+    store.create(Scoring(
+        metadata=ObjectMeta(name="sc1"),
+        spec={
+            "inferenceService": chat_stub,
+            "plugin": {"loadPlugin": False},
+            "probes": [
+                {"prompt": "say blue", "reference": "blue"},
+                {"prompt": "say cat", "reference": "cat"},
+            ],
+        },
+    ))
+    mgr.run_until_idle()
+    sc = store.get(Scoring, "sc1")
+    assert sc.status["score"] == "100.0"
+    assert len(sc.status["details"]) == 2
+
+
+def test_plugin_scoring(chat_stub, tmp_path):
+    register_plugin("always-42", lambda url, params: 42.0)
+    store = ObjectStore()
+    mgr = build_manager(store, FakeTrainingBackend(), FakeServingBackend(),
+                        storage_path=str(tmp_path), with_scoring=True)
+    store.create(Scoring(
+        metadata=ObjectMeta(name="sc2"),
+        spec={"inferenceService": chat_stub,
+              "plugin": {"loadPlugin": True, "name": "always-42"}},
+    ))
+    mgr.run_until_idle()
+    assert store.get(Scoring, "sc2").status["score"] == "42.0"
+
+
+def test_scoring_retries_on_unreachable_endpoint(tmp_path):
+    store = ObjectStore()
+    mgr = build_manager(store, FakeTrainingBackend(), FakeServingBackend(),
+                        storage_path=str(tmp_path), with_scoring=True)
+    store.create(Scoring(
+        metadata=ObjectMeta(name="sc3"),
+        spec={"inferenceService": "http://127.0.0.1:1/chat/completions",
+              "plugin": {"loadPlugin": False}},
+    ))
+    mgr.run_until_idle()
+    sc = store.get(Scoring, "sc3")
+    assert sc.status.get("score") is None
+    assert "lastError" in sc.status  # transient failure recorded, retry queued
