@@ -20,6 +20,10 @@ import threading
 import time
 
 BENCH_TIMEOUT_S = float(os.environ.get("DTX_BENCH_TIMEOUT_S", "480"))
+# Pre-flight deadline: generous enough for first-compile of a tiny matmul
+# (~20-40s cold) but far below the full watchdog, so a wedged relay costs
+# ~90s + a CPU smoke run instead of the whole 480s budget.
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("DTX_BENCH_PREFLIGHT_S", "90"))
 
 # Round-1 recorded tokens/sec/chip on TPU v5e-1 (see BASELINE.md); update only
 # alongside BASELINE.md.
@@ -95,11 +99,41 @@ def main():
     )
 
 
+def _preflight_device_ok():
+    """Probe the default device with a tiny matmul in a SUBPROCESS.
+
+    The tunneled TPU backend wedges by hanging (not erroring), and once a
+    process has initialized the wedged platform it cannot recover — so the
+    probe must be isolated. If the probe hangs or fails, the bench falls back
+    to the CPU smoke immediately instead of burning the full watchdog budget.
+    """
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256), jnp.float32);"
+        "print(float((x @ x)[0, 0]))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=PREFLIGHT_TIMEOUT_S, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return p.returncode == 0 and "256.0" in p.stdout
+
+
 def _run_with_watchdog():
     """The tunneled TPU backend can wedge indefinitely (device ops hang, not
     error). Run the bench on a daemon thread; if it exceeds the deadline, emit
     the error JSON line and hard-exit so the driver always gets exactly one
     line of stdout."""
+    if not os.environ.get("DTX_BENCH_FORCE_CPU") and not _preflight_device_ok():
+        # Device hung/failed the pre-flight: emit the CPU smoke line rather
+        # than a bench_error so BENCH_rN always carries signal.
+        os.environ["DTX_BENCH_FORCE_CPU"] = "1"
+
     result = {}
 
     def target():

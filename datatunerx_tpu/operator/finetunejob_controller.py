@@ -223,7 +223,17 @@ class FinetuneJobController:
         if job.status.get("state") != FinetuneJob.STATE_SERVE:
             return None
         scoring = store.try_get(Scoring, job.metadata.name, job.metadata.namespace)
-        if scoring is None or scoring.status.get("score") is None:
+        if scoring is None:
+            return Result(requeue_after=SERVE_POLL_S)
+        if scoring.status.get("error"):
+            # permanent scoring failure (invalid spec) — fail the job and tear
+            # down serving rather than polling SERVE forever
+            job.status["state"] = FinetuneJob.STATE_FAILED
+            job.status.setdefault("result", {})["scoringError"] = scoring.status["error"]
+            store.update(job)
+            self.serving.delete(job.metadata.name)
+            return None
+        if scoring.status.get("score") is None:
             return Result(requeue_after=SERVE_POLL_S)
         # score set → Successful; tear down serving (reference :485-508)
         job.status["state"] = FinetuneJob.STATE_SUCCESSFUL

@@ -24,6 +24,26 @@ DEFAULT_PROBES: List[Dict[str, str]] = [
 ]
 
 
+def validate_probes(probes) -> Optional[List[Dict[str, str]]]:
+    """Shape-check CR-supplied probes before any endpoint traffic, so the
+    controller can scope its permanent invalid-spec branch to THIS check
+    (endpoint responses that fail to parse must stay retryable — a warming
+    server can return a 200 with a non-OpenAI body). None → built-in defaults.
+    """
+    if probes is None:
+        return None
+    if not isinstance(probes, list) or not probes:
+        raise ValueError("spec.probes must be a non-empty list")
+    for i, p in enumerate(probes):
+        if (not isinstance(p, dict)
+                or not isinstance(p.get("prompt"), str)
+                or not isinstance(p.get("reference"), str)):
+            raise ValueError(
+                f"spec.probes[{i}] must be {{prompt: str, reference: str}}"
+            )
+    return probes
+
+
 def query_chat(endpoint: str, prompt: str, timeout: float = 60.0,
                max_tokens: int = 64) -> str:
     req = urllib.request.Request(

@@ -14,8 +14,8 @@ from typing import Optional
 from datatunerx_tpu.operator.api import Scoring
 from datatunerx_tpu.operator.reconciler import Result
 from datatunerx_tpu.operator.store import ObjectStore
-from datatunerx_tpu.scoring.builtin import score_endpoint
-from datatunerx_tpu.scoring.plugin import run_plugin
+from datatunerx_tpu.scoring.builtin import score_endpoint, validate_probes
+from datatunerx_tpu.scoring.plugin import resolve_plugin
 
 RETRY_S = 10.0
 
@@ -39,22 +39,31 @@ class ScoringController:
             return None
 
         plugin = scoring.spec.get("plugin") or {}
+        # Validate the spec BEFORE any endpoint traffic — this is the only
+        # permanent-error branch. Endpoint failures (including a warming
+        # server returning a 200 with a non-OpenAI body, which surfaces as
+        # JSONDecodeError/KeyError from the response parser) must retry.
         try:
             if plugin.get("loadPlugin"):
-                score = run_plugin(plugin.get("name", ""), url,
-                                   plugin.get("parameters"))
-                details = None
+                fn = resolve_plugin(plugin.get("name", ""))
             else:
                 # built-in scorer accepts CR-supplied probes
                 # (spec.probes: [{prompt, reference}]); defaults otherwise
-                probes = scoring.spec.get("probes") or None
-                result = score_endpoint(url, probes=probes, timeout=self.timeout)
-                score, details = result["score"], result["details"]
-        except (KeyError, TypeError, ValueError) as e:
-            # malformed spec (bad probes/parameters): permanent — do not retry
+                probes = validate_probes(scoring.spec.get("probes"))
+        except (KeyError, TypeError, ValueError, PermissionError,
+                ImportError, AttributeError) as e:
+            # bad spec OR bad-but-allowlisted plugin path — permanent either way
             scoring.status["error"] = f"invalid scoring spec: {e!r}"[:500]
             store.update(scoring)
             return None
+
+        try:
+            if plugin.get("loadPlugin"):
+                score = str(fn(url, plugin.get("parameters")))
+                details = None
+            else:
+                result = score_endpoint(url, probes=probes, timeout=self.timeout)
+                score, details = result["score"], result["details"]
         except Exception as e:  # endpoint not ready / transient — retry
             scoring.status["lastError"] = str(e)[:500]
             store.update(scoring)

@@ -25,11 +25,17 @@ def _ngram_counts(tokens: Sequence[str], n: int) -> Counter:
 
 
 def rouge_n(candidate: str, reference: str, n: int) -> float:
+    """F1, matching the reference's rouge_chinese F-score semantics
+    (recall-only inflates scores for long generations)."""
     c, r = _ngram_counts(_tokens(candidate), n), _ngram_counts(_tokens(reference), n)
     if not r:
         return 0.0
     overlap = sum((c & r).values())
-    return overlap / max(sum(r.values()), 1)
+    if overlap == 0:
+        return 0.0
+    p = overlap / max(sum(c.values()), 1)
+    rec = overlap / max(sum(r.values()), 1)
+    return 2 * p * rec / (p + rec)
 
 
 def _lcs(a: Sequence[str], b: Sequence[str]) -> int:

@@ -97,12 +97,31 @@ class InferenceEngine:
         if lora:
             from datatunerx_tpu.models.lora import lora_scaling, merge_lora
 
-            # scaling travels in the manifest; default alpha/r = 32/8 matches
-            # the reference defaults (cmd/tuning/parser.py:138-145)
             rank = next(iter(lora["layers"].values()))["a"].shape[-1]
-            self.params = merge_lora(self.params, lora, lora_scaling(32.0, rank))
+            scaling = self._manifest_lora_scaling(root)
+            if scaling is None:
+                # manifest absent (ad-hoc checkpoint dir): fall back to the
+                # reference defaults alpha=32 / r (cmd/tuning/parser.py:138-145)
+                scaling = lora_scaling(32.0, rank)
+            self.params = merge_lora(self.params, lora, scaling)
         elif state.get("params"):
             self.params = state["params"]
+
+    @staticmethod
+    def _manifest_lora_scaling(ckpt_root: str):
+        """The completion manifest (written next to the checkpoints dir by
+        tuning/train.py) records the trained adapter's alpha/rank scaling;
+        merging with any other value serves a silently-wrong model."""
+        from datatunerx_tpu.training.checkpoint import read_manifest
+
+        run_dir = os.path.dirname(ckpt_root.rstrip("/"))
+        try:
+            manifest = read_manifest(os.path.dirname(run_dir),
+                                     os.path.basename(run_dir))
+            val = (manifest or {}).get("lora_scaling")
+            return float(val) if val is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
 
     # ------------------------------------------------------------ generate
     def _prefill_impl(self, params, tokens, mask, positions, cache, prompt_len):

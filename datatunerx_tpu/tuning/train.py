@@ -270,6 +270,18 @@ def run(args: TrainArgs) -> dict:
             extra={
                 "model": args.model_name_or_path,
                 "finetuning_type": args.finetuning_type,
+                # serving merges the adapter with THIS scaling (alpha/rank);
+                # without it a non-default --lora_alpha run would be merged
+                # at the wrong scale at serve time
+                "lora_scaling": (
+                    trainer.scaling if tcfg.finetuning_type == "lora" else None
+                ),
+                "lora_alpha": (
+                    args.lora_alpha if tcfg.finetuning_type == "lora" else None
+                ),
+                "lora_rank": (
+                    args.lora_rank if tcfg.finetuning_type == "lora" else None
+                ),
                 "template": args.template,
                 "mesh": dict(zip(("dp", "fsdp", "tp", "sp"), shape)),
                 "steps": step,
