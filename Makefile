@@ -26,7 +26,7 @@ docker-build:    ## operator + trainer images
 	docker build -t $(IMG_TRAINER) -f Dockerfile.trainer .
 
 deploy:          ## apply operator manifests to the current cluster
-	kubectl apply -f deploy/rbac.yaml -f deploy/operator.yaml
+	kubectl apply -f deploy/crds/ -f deploy/rbac.yaml -f deploy/operator.yaml
 
 undeploy:
 	kubectl delete -f deploy/operator.yaml -f deploy/rbac.yaml

@@ -40,18 +40,22 @@ class CsvDataset:
 
     @staticmethod
     def _load(path: str) -> List[Dict[str, Any]]:
-        if not os.path.exists(path):
+        """Local paths or object-store URIs (gs://, s3://, memory://…) — the
+        Dataset CR file contract is S3 URIs in the reference
+        (finetune_controller.go:466-470); here any fsspec scheme works."""
+        from datatunerx_tpu.utils import storage
+
+        if not storage.exists(path):
             raise FileNotFoundError(path)
         records: List[Dict[str, Any]] = []
         if path.endswith(".jsonl") or path.endswith(".json"):
-            with open(path) as f:
-                text = f.read().strip()
+            text = storage.read_text(path).strip()
             if text.startswith("["):
                 records = json.loads(text)
             else:
                 records = [json.loads(line) for line in text.splitlines() if line.strip()]
         else:
-            with open(path, newline="") as f:
+            with storage.open_uri(path, "r") as f:
                 records = list(csv.DictReader(f))
         return records
 

@@ -81,7 +81,7 @@ def num_params(params: Params) -> int:
 
 
 def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
-          quant_mode=None, dims=None, use_pallas=False):
+          quant_mode=None, dims=None, use_pallas=False, lora_idx=None):
     """Dense projection with optional LoRA delta: h W + drop(h) A B * scale.
 
     The base weight is either a full-precision kernel or a quantized collection
@@ -89,6 +89,11 @@ def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
     (reference bnb int4/int8 + peft, cmd/tuning/train.py:224-280).
     LoRA dropout applies to the adapter branch input only, matching peft's
     ``lora_dropout`` (reference cmd/tuning/parser.py:146-149, default 0.1).
+
+    Multi-adapter serving: with ``lora_idx`` ([B] int32), lora_p leaves are
+    STACKED over adapters ([E, d_in, r]/[E, r, d_out], per layer) and
+    ``lora_scale`` is a vector [E]; each batch row applies its own adapter —
+    one decode program serves mixed-adapter batches (no per-adapter merge).
     """
     if "quant" in p:
         from datatunerx_tpu.ops.quant import quantized_matmul
@@ -106,7 +111,14 @@ def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
         if drop_key is not None and drop_rate > 0.0:
             keep = jax.random.bernoulli(drop_key, 1.0 - drop_rate, h.shape)
             hl = jnp.where(keep, h / (1.0 - drop_rate), 0.0).astype(h.dtype)
-        out = out + ((hl @ a) @ b) * jnp.asarray(lora_scale, h.dtype)
+        if lora_idx is not None:
+            a_sel = a[lora_idx]  # [B, d_in, r]
+            b_sel = b[lora_idx]  # [B, r, d_out]
+            scale = jnp.asarray(lora_scale, h.dtype)[lora_idx][:, None, None]
+            delta = jnp.einsum("btd,bdr->btr", hl, a_sel)
+            out = out + jnp.einsum("btr,bro->bto", delta, b_sel) * scale
+        else:
+            out = out + ((hl @ a) @ b) * jnp.asarray(lora_scale, h.dtype)
     return out
 
 
@@ -114,13 +126,18 @@ POS_SENTINEL = jnp.int32(2**30)  # marks invalid/pad cache slots: the causal
 # check kv_pos <= q_pos then masks them with no separate validity plumbing
 
 
-def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16):
+def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
+               per_slot: bool = False):
+    """KV cache. ``per_slot=True`` gives each batch row its own write cursor
+    (``len`` is [batch]) — continuous batching needs rows at different depths
+    in one decode program (serving/batched_engine.py)."""
     L = cfg.num_layers
     shape = (L, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
-        "len": jnp.zeros((), jnp.int32),
+        "len": (jnp.zeros((batch,), jnp.int32) if per_slot
+                else jnp.zeros((), jnp.int32)),
         # rope position of each written slot (slots ≠ positions under
         # left-padded prefill); sentinel = unwritten or pad
         "pos": jnp.full((batch, max_len), POS_SENTINEL, jnp.int32),
@@ -137,6 +154,7 @@ def forward(
     segment_ids: Optional[jnp.ndarray] = None,  # [B, T] for packed sequences
     cache: Optional[dict] = None,
     lora: Optional[tuple[Params, float]] = None,
+    lora_adapter_idx: Optional[jnp.ndarray] = None,  # [B] — stacked adapters
     compute_dtype=None,
     lora_dropout: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
@@ -182,9 +200,16 @@ def forward(
         if attention_mask is not None:
             pos_update = jnp.where(attention_mask.astype(bool), positions,
                                    POS_SENTINEL)
-        cache_pos = jax.lax.dynamic_update_slice(
-            cache["pos"], pos_update, (0, cache["len"])
-        )
+        if cache["len"].ndim == 0:
+            cache_pos = jax.lax.dynamic_update_slice(
+                cache["pos"], pos_update, (0, cache["len"])
+            )
+        else:
+            # per-slot cursors: scatter each row at its own depth (OOB writes
+            # for exhausted slots are dropped by the default scatter mode)
+            rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+            idx = cache["len"][:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            cache_pos = cache["pos"].at[rows, idx].set(pos_update)
         kv_positions = cache_pos
         kv_valid = None  # sentinel positions handle both unwritten and pads
         kv_seg = None
@@ -237,11 +262,11 @@ def forward(
 
         h = rms_norm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
         q = _proj(h, lp["q_proj"], lget("q_proj"), lora_scale, kget(0), drop,
-                  qm, (D, cfg.q_dim), qp)
+                  qm, (D, cfg.q_dim), qp, lora_adapter_idx)
         k = _proj(h, lp["k_proj"], lget("k_proj"), lora_scale, kget(1), drop,
-                  qm, (D, cfg.kv_dim), qp)
+                  qm, (D, cfg.kv_dim), qp, lora_adapter_idx)
         v = _proj(h, lp["v_proj"], lget("v_proj"), lora_scale, kget(2), drop,
-                  qm, (D, cfg.kv_dim), qp)
+                  qm, (D, cfg.kv_dim), qp, lora_adapter_idx)
         q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -250,12 +275,18 @@ def forward(
 
         if ck is not None:
             start = cache["len"]
-            ck = jax.lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, start, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, start, 0, 0)
-            )
+            if start.ndim == 0:
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, start, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, start, 0, 0)
+                )
+            else:
+                rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+                idx = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+                ck = ck.at[rows, idx].set(k.astype(ck.dtype))
+                cv = cv.at[rows, idx].set(v.astype(cv.dtype))
             k_att, v_att = ck.astype(q.dtype), cv.astype(q.dtype)
         else:
             k_att, v_att = k, v
@@ -264,16 +295,16 @@ def forward(
                          segment_ids=segment_ids if att_impl == "flash" else None)
         attn = attn.reshape(B, T, cfg.q_dim)
         x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3),
-                      drop, qm, (cfg.q_dim, D), qp)
+                      drop, qm, (cfg.q_dim, D), qp, lora_adapter_idx)
 
         h = rms_norm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
         gate = _proj(h, lp["gate_proj"], lget("gate_proj"), lora_scale, kget(4),
-                     drop, qm, (D, F), qp)
+                     drop, qm, (D, F), qp, lora_adapter_idx)
         up = _proj(h, lp["up_proj"], lget("up_proj"), lora_scale, kget(5),
-                   drop, qm, (D, F), qp)
+                   drop, qm, (D, F), qp, lora_adapter_idx)
         mlp = _proj(
             jax.nn.silu(gate) * up, lp["down_proj"], lget("down_proj"),
-            lora_scale, kget(6), drop, qm, (F, D), qp,
+            lora_scale, kget(6), drop, qm, (F, D), qp, lora_adapter_idx,
         )
         x = x + mlp
         return x, (ck, cv)

@@ -88,6 +88,38 @@ class ApiHandler(BaseHTTPRequestHandler):
             return self._send(200, {"status": "ok"})
         if not self._authorized():
             return self._send(401, {"error": "unauthorized"})
+        if url.path in ("/", "/ui", "/ui/"):
+            # single-file web UI (reference datatunerx-ui equivalent)
+            import os
+
+            try:
+                with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       "ui.html"), "rb") as f:
+                    body = f.read()
+            except OSError:
+                return self._send(404, {"error": "ui.html not bundled"})
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path.startswith("/trainermetrics/"):
+            # /trainermetrics/{ns}/{name}: trainer/eval jsonl curves for the UI
+            parts = [p for p in url.path.split("/")[2:] if p]
+            if len(parts) != 2:
+                return self._send(400, {"error": "use /trainermetrics/{namespace}/{name}"})
+            ns, name = parts
+            if not re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name):
+                return self._send(400, {"error": "invalid job name"})
+            if self.store.try_get("Finetune", name, ns) is None:
+                return self._send(404, {"error": f"Finetune {ns}/{name} not found"})
+            backend = getattr(self.manager, "training_backend", None) if self.manager else None
+            series = getattr(backend, "metrics_series", None)
+            if series is None:
+                return self._send(
+                    501, {"error": "metrics series not supported by this backend"})
+            return self._send(200, {"name": name, **series(name)})
         if url.path == "/metrics":
             n_err = len(self.manager.errors) if self.manager else 0
             lines = [

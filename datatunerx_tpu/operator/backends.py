@@ -160,6 +160,21 @@ class LocalProcessBackend:
             except subprocess.TimeoutExpired:
                 proc.kill()
 
+    def metrics_series(self, name: str, max_points: int = 2000) -> dict:
+        """Parsed trainer/eval jsonl curves for the UI (the data the reference
+        surfaces via Prometheus + its web frontend, SURVEY.md §3.5)."""
+        out = {"train": [], "eval": []}
+        for key, fname in (("train", "trainer_log.jsonl"),
+                           ("eval", "eval_log.jsonl")):
+            path = os.path.join(self.workdir, name, "result", "watch", fname)
+            try:
+                with open(path) as f:
+                    rows = [json.loads(line) for line in f if line.strip()]
+                out[key] = rows[-max_points:]
+            except (OSError, ValueError):
+                pass
+        return out
+
     def log_tail(self, name: str, n: int = 40, max_bytes: int = 256 * 1024) -> str:
         path = os.path.join(self.workdir, name, "log.txt")
         try:
@@ -174,6 +189,42 @@ class LocalProcessBackend:
 
 
 # -------------------------------------------------------------- manifests
+
+def jobset_state(status: dict) -> str:
+    """JobSet status → backend state vocabulary (the feedback loop the
+    reference runs on RayJob JobDeploymentStatus,
+    finetune_controller.go:169-199). A 'Completed'=True condition is terminal
+    success, 'Failed'=True terminal failure; any active/ready replicated job
+    counts as Running; otherwise Pending."""
+    for cond in status.get("conditions") or []:
+        if str(cond.get("status")) != "True":
+            continue
+        t = cond.get("type", "")
+        if t == "Completed":
+            return "Succeeded"
+        if t in ("Failed", "FailurePolicyComplete"):
+            return "Failed"
+    for rj in status.get("replicatedJobsStatus") or []:
+        if (rj.get("active", 0) or 0) > 0 or (rj.get("ready", 0) or 0) > 0:
+            return "Running"
+    return "Pending"
+
+
+def deployment_state(status: dict) -> str:
+    for cond in status.get("conditions") or []:
+        if (cond.get("type") == "ReplicaFailure"
+                and str(cond.get("status")) == "True"):
+            return "FAILED"
+        # crash-looping pods never set ReplicaFailure; the deployment's
+        # progress deadline (default 600s) is the terminal signal for them
+        if (cond.get("type") == "Progressing"
+                and str(cond.get("status")) == "False"
+                and cond.get("reason") == "ProgressDeadlineExceeded"):
+            return "FAILED"
+    if (status.get("availableReplicas") or 0) >= 1:
+        return "HEALTHY"
+    return "PENDING"
+
 
 class ManifestBackend:
     """Renders k8s manifests for GKE TPU node pools instead of submitting them.
@@ -298,11 +349,28 @@ class ManifestBackend:
             json.dump(manifest, f, indent=1)
 
     def status(self, name):
-        return "Pending" if name in self._submitted else "NotFound"
+        """Render-only mode has no apiserver to poll; the feedback loop is a
+        status file (`<name>-status.json`) dropped next to the manifest by
+        whatever applied it — either `{"state": "Running"}` directly or a raw
+        JobSet status object (mapped via jobset_state). Absent file = Pending.
+        For a live apiserver loop use KubeTrainingBackend (kubebackends.py).
+        """
+        if name not in self._submitted:
+            return "NotFound"
+        path = os.path.join(self.out_dir, f"{name}-status.json")
+        try:
+            with open(path) as f:
+                status = json.load(f)
+        except (OSError, ValueError):
+            return "Pending"
+        if isinstance(status, dict) and isinstance(status.get("state"), str):
+            return status["state"]
+        return jobset_state(status if isinstance(status, dict) else {})
 
     def delete(self, name):
         self._submitted.pop(name, None)
-        try:
-            os.remove(os.path.join(self.out_dir, f"{name}-jobset.json"))
-        except OSError:
-            pass
+        for suffix in ("-jobset.json", "-status.json"):
+            try:
+                os.remove(os.path.join(self.out_dir, f"{name}{suffix}"))
+            except OSError:
+                pass

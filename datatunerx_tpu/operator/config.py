@@ -31,6 +31,28 @@ def get_s3_secure() -> bool:
     return _get("S3_SECURE", "false").lower() in ("true", "1")
 
 
+def object_store_options(uri: str) -> dict:
+    """fsspec storage options for a dataset/checkpoint URI, from the same env
+    surface the reference binds via viper (S3_ENDPOINT/S3_ACCESSKEYID/
+    S3_SECRETACCESSKEY/S3_SECURE, reference pkg/config/config.go:29-55).
+    Consumed by utils/storage when opening s3:// URIs; gs:// relies on
+    workload identity / application-default credentials."""
+    if not uri.startswith("s3://"):
+        return {}
+    opts: dict = {}
+    if get_s3_access_key():
+        opts["key"] = get_s3_access_key()
+    if get_s3_secret_key():
+        opts["secret"] = get_s3_secret_key()
+    if get_s3_endpoint():
+        scheme = "https" if get_s3_secure() else "http"
+        endpoint = get_s3_endpoint()
+        if "://" not in endpoint:
+            endpoint = f"{scheme}://{endpoint}"
+        opts["client_kwargs"] = {"endpoint_url": endpoint}
+    return opts
+
+
 def get_registry_url() -> str:
     return _get("REGISTRY_URL")
 

@@ -210,6 +210,18 @@ def generate_builtin_scoring(job: FinetuneJob, inference_url: str) -> Scoring:
     }
     if job.spec.get("scoringProbes"):
         spec["probes"] = job.spec["scoringProbes"]
+    # dataset-driven scoring: evaluate over the Dataset CR's test/validate
+    # split instead of probes ("auto" = the job's own training dataset)
+    ds_ref = job.spec.get("scoringDatasetRef")
+    if ds_ref:
+        if ds_ref == "auto":
+            ds_ref = (job.spec.get("finetune", {})
+                      .get("finetuneSpec", {}).get("dataset"))
+        spec["datasetRef"] = ds_ref
+        if job.spec.get("scoringMetric"):
+            spec["metric"] = job.spec["scoringMetric"]
+        if job.spec.get("scoringMaxExamples"):
+            spec["maxExamples"] = job.spec["scoringMaxExamples"]
     sc = Scoring(
         metadata=ObjectMeta(
             name=job.metadata.name,

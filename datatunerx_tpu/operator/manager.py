@@ -82,10 +82,15 @@ def main(argv=None):
     # TPU-native options
     p.add_argument("--persist-dir", default=None,
                    help="JSON object store directory (durable CRs)")
-    p.add_argument("--backend", choices=["local", "manifest", "fake"],
+    p.add_argument("--backend", choices=["local", "manifest", "kube", "fake"],
                    default="local")
     p.add_argument("--workdir", default="/tmp/dtx-operator")
     p.add_argument("--storage-path", default=None)
+    # kube mode: CRs + workloads through a real apiserver (in-cluster config
+    # is auto-detected when --kube-url is omitted)
+    p.add_argument("--kube-url", default=None,
+                   help="apiserver base URL (default: in-cluster config)")
+    p.add_argument("--kube-namespace", default="default")
     args = p.parse_args(argv)
 
     if args.storage_path:
@@ -95,6 +100,25 @@ def main(argv=None):
         import os
 
         os.environ["STORAGE_PATH"] = args.storage_path
+
+    if args.backend == "kube":
+        from datatunerx_tpu.operator.kubebackends import (
+            KubeServingBackend,
+            KubeTrainingBackend,
+        )
+        from datatunerx_tpu.operator.kubeclient import KubeClient
+        from datatunerx_tpu.operator.kubestore import KubeObjectStore
+
+        client = KubeClient(base_url=args.kube_url,
+                            namespace=args.kube_namespace)
+        store = AdmittingStore(KubeObjectStore(client))
+        training = KubeTrainingBackend(client, namespace=args.kube_namespace,
+                                       out_dir=args.workdir)
+        serving = KubeServingBackend(client, namespace=args.kube_namespace,
+                                     out_dir=args.workdir)
+        mgr = build_manager(store, training, serving,
+                            storage_path=args.storage_path)
+        return _run_manager(args, store, mgr)
 
     store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
     if args.backend == "local":
@@ -109,7 +133,10 @@ def main(argv=None):
         training, serving = FakeTrainingBackend(), FakeServingBackend()
 
     mgr = build_manager(store, training, serving, storage_path=args.storage_path)
+    return _run_manager(args, store, mgr)
 
+
+def _run_manager(args, store, mgr: Manager) -> int:
     # REST API (kubectl-shaped user surface + metrics) on the metrics address,
     # plain health probes on the probe address — mirroring the reference's
     # :8080/:8081 split (options.go:13-14)

@@ -15,6 +15,10 @@ from datatunerx_tpu.operator.api import Scoring
 from datatunerx_tpu.operator.reconciler import Result
 from datatunerx_tpu.operator.store import ObjectStore
 from datatunerx_tpu.scoring.builtin import score_endpoint, validate_probes
+from datatunerx_tpu.scoring.dataset_scoring import (
+    DEFAULT_MAX_EXAMPLES,
+    score_dataset,
+)
 from datatunerx_tpu.scoring.plugin import resolve_plugin
 
 RETRY_S = 10.0
@@ -39,6 +43,8 @@ class ScoringController:
             return None
 
         plugin = scoring.spec.get("plugin") or {}
+        dataset_ref = scoring.spec.get("datasetRef")
+        metric = scoring.spec.get("metric") or "generation"
         # Validate the spec BEFORE any endpoint traffic — this is the only
         # permanent-error branch. Endpoint failures (including a warming
         # server returning a 200 with a non-OpenAI body, which surfaces as
@@ -46,6 +52,13 @@ class ScoringController:
         try:
             if plugin.get("loadPlugin"):
                 fn = resolve_plugin(plugin.get("name", ""))
+            elif dataset_ref:
+                if metric not in ("generation", "perplexity"):
+                    raise ValueError(f"unknown scoring metric {metric!r}")
+                max_examples = int(scoring.spec.get("maxExamples")
+                                   or DEFAULT_MAX_EXAMPLES)
+                if max_examples <= 0:
+                    raise ValueError("maxExamples must be positive")
             else:
                 # built-in scorer accepts CR-supplied probes
                 # (spec.probes: [{prompt, reference}]); defaults otherwise
@@ -61,6 +74,19 @@ class ScoringController:
             if plugin.get("loadPlugin"):
                 score = str(fn(url, plugin.get("parameters")))
                 details = None
+            elif dataset_ref:
+                from datatunerx_tpu.operator.api import Dataset
+
+                ds = store.try_get(Dataset, dataset_ref,
+                                   scoring.metadata.namespace)
+                if ds is None:  # may be created later — retry
+                    scoring.status["lastError"] = f"Dataset/{dataset_ref} not found"
+                    store.update(scoring)
+                    return Result(requeue_after=RETRY_S)
+                result = score_dataset(url, ds.spec, metric=metric,
+                                       max_examples=max_examples,
+                                       timeout=self.timeout)
+                score, details = result["score"], result["details"]
             else:
                 result = score_endpoint(url, probes=probes, timeout=self.timeout)
                 score, details = result["score"], result["details"]

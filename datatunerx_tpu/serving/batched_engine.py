@@ -1,0 +1,465 @@
+"""Continuous-batching inference engine (JetStream-style decode, SURVEY §7.1).
+
+The round-1 engine decoded one request at a time (batch=1, LoRA merged at
+load). This engine runs a SINGLE jitted decode program over S cache slots and
+admits new requests into free slots between decode chunks — the serving tier
+the reference buys from Ray Serve (reference pkg/util/generate/
+generate.go:160-329 deploys LlamaDeployment replicas), rebuilt TPU-first:
+
+- per-slot KV cache cursors (models/llama.py ``init_cache(per_slot=True)``):
+  rows sit at different depths inside one program; sentinel rope positions
+  mask free/garbage slots, so no per-slot programs and no re-batching pauses;
+- decode runs in CHUNKS of K tokens per program (``lax.scan`` over the
+  single-token step): K amortizes dispatch latency (fatal over a tunneled
+  accelerator at K=1) while keeping admission latency bounded at K tokens;
+- UNMERGED multi-adapter LoRA: adapters are stacked ([L, E, d, r]) and each
+  slot indexes its own adapter inside the matmul (models/llama.py _proj
+  lora_idx) — one base model serves many tuned jobs concurrently;
+- streaming: each emitted token lands on the request's queue as soon as its
+  chunk completes (SSE transport in serving/server.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datatunerx_tpu.data.templates import Template, get_template
+from datatunerx_tpu.models.llama import forward, init_cache
+from datatunerx_tpu.models.lora import LORA_TARGETS, lora_scaling
+from datatunerx_tpu.serving.engine import _sample_jit
+from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
+
+MAX_STOP = 8  # static per-slot stop-token capacity
+
+
+class Request:
+    def __init__(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                 temperature: float, top_p: float, seed: int,
+                 stop_ids: Sequence[int], adapter: int):
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_p = top_p
+        self.seed = seed
+        self.stop_ids = list(stop_ids)[:MAX_STOP]
+        self.adapter = adapter
+        self.tokens: List[int] = []
+        self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+
+    def push(self, token: int):
+        self.tokens.append(token)
+        self.stream.put(token)
+
+    def finish(self, error: Optional[str] = None):
+        self.error = error
+        self.stream.put(None)
+        self.done.set()
+
+
+def load_checkpoint_state(checkpoint_path: str) -> dict:
+    """Load an Orbax TrainState checkpoint dir (…/checkpoints[/<step>]) and
+    return its raw state dict ({"lora": …} and/or {"params": …}), plus the
+    recorded manifest lora scaling under "_scaling" when available."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from datatunerx_tpu.serving.engine import InferenceEngine
+
+    root = checkpoint_path.rstrip("/")
+    step: Optional[int] = None
+    if os.path.basename(root).isdigit():
+        step = int(os.path.basename(root))
+        root = os.path.dirname(root)
+    mngr = ocp.CheckpointManager(root)
+    step = step if step is not None else mngr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {checkpoint_path}")
+    restored = mngr.restore(step)
+    mngr.close()
+    state = restored if isinstance(restored, dict) else dict(restored)
+    state["_scaling"] = InferenceEngine._manifest_lora_scaling(root)
+    return state
+
+
+class BatchedEngine:
+    def __init__(
+        self,
+        model_path: str,
+        checkpoint_path: Optional[str] = None,
+        adapters: Optional[Dict[str, str]] = None,  # name -> checkpoint path
+        template: str = "llama2",
+        max_seq_len: int = 1024,
+        slots: int = 4,
+        decode_chunk: int = 8,
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
+            model_path, dtype=dtype
+        )
+        self.template: Template = get_template(template, self.tokenizer)
+        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
+        self.slots = slots
+        self.chunk = max(1, decode_chunk)
+
+        # ---- adapters: checkpoint_path becomes adapter "default" (unmerged);
+        # full-param checkpoints swap the base instead
+        named: Dict[str, str] = dict(adapters or {})
+        if checkpoint_path:
+            state = load_checkpoint_state(checkpoint_path)
+            if state.get("lora"):
+                named.setdefault("default", checkpoint_path)
+            elif state.get("params"):
+                self.params = jax.device_put(state["params"])
+        self.adapter_ids: Dict[str, int] = {"": 0}  # 0 = base (zero adapter)
+        self.lora_stack: Optional[tuple] = None
+        if named:
+            self._build_adapter_stack(named)
+
+        self._cache = init_cache(self.cfg, slots, self.max_seq_len,
+                                 dtype=jnp.bfloat16, per_slot=True)
+        V = self.cfg.vocab_size
+        self._logits = jnp.zeros((slots, V), jnp.float32)
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        self._remaining = jnp.zeros((slots,), jnp.int32)
+        self._active = jnp.zeros((slots,), bool)
+        self._rng = jnp.stack([jax.random.PRNGKey(i) for i in range(slots)])
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._top_ps = jnp.ones((slots,), jnp.float32)
+        self._stops = jnp.full((slots, MAX_STOP), -1, jnp.int32)
+        self._adapter_idx = jnp.zeros((slots,), jnp.int32)
+
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._waiting: "queue.Queue[Request]" = queue.Queue()
+        self._wake = threading.Event()
+        self._shutdown = False
+
+        self._prefill = jax.jit(self._prefill_impl,
+                                static_argnames=("prompt_len",))
+        self._insert = jax.jit(self._insert_impl)
+        self._decode = jax.jit(self._decode_impl, static_argnames=("K",))
+
+        self._thread = threading.Thread(target=self._scheduler, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- adapters
+    def _build_adapter_stack(self, named: Dict[str, str]):
+        """Stack named adapter checkpoints into [L, E, …] leaves (entry 0 is
+        the all-zero base adapter). Mixed ranks are padded to the max rank
+        (zero cols/rows leave the delta unchanged); mixed target sets take
+        the union with zeros where an adapter lacks a target."""
+        from datatunerx_tpu.models.lora import target_dims
+
+        loaded: List[Tuple[str, dict, float]] = []
+        for name, path in named.items():
+            state = load_checkpoint_state(path)
+            lora = state.get("lora")
+            if not lora:
+                raise ValueError(f"adapter {name!r}: no lora tree in {path}")
+            layers = lora["layers"]
+            rank = next(iter(layers.values()))["a"].shape[-1]
+            scaling = state.get("_scaling")
+            if scaling is None:
+                scaling = lora_scaling(32.0, rank)
+            loaded.append((name, layers, float(scaling)))
+
+        targets = sorted({t for _, layers, _ in loaded for t in layers}
+                         & set(LORA_TARGETS))
+        max_rank = max(
+            layers[t]["a"].shape[-1]
+            for _, layers, _ in loaded for t in layers
+        )
+        L = self.cfg.num_layers
+        E = len(loaded) + 1  # + base zero adapter
+        stack: Dict[str, dict] = {}
+        for t in targets:
+            d_in, d_out = target_dims(self.cfg, t)
+            a = np.zeros((L, E, d_in, max_rank), np.float32)
+            b = np.zeros((L, E, max_rank, d_out), np.float32)
+            for e, (_, layers, _) in enumerate(loaded, start=1):
+                if t not in layers:
+                    continue
+                ar = np.asarray(layers[t]["a"], np.float32)  # [L, d_in, r]
+                br = np.asarray(layers[t]["b"], np.float32)
+                r = ar.shape[-1]
+                a[:, e, :, :r] = ar
+                b[:, e, :r, :] = br
+            stack[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+        scales = jnp.asarray([0.0] + [s for _, _, s in loaded], jnp.float32)
+        self.lora_stack = ({"layers": stack}, scales)
+        for e, (name, _, _) in enumerate(loaded, start=1):
+            self.adapter_ids[name] = e
+
+    def _lora_args(self):
+        if self.lora_stack is None:
+            return {"lora": None}
+        tree, scales = self.lora_stack
+        return {"lora": (tree, scales)}
+
+    # --------------------------------------------------------------- jitted
+    def _prefill_impl(self, params, tokens, mask, positions, adapter_idx, *,
+                      prompt_len: int):
+        cache = init_cache(self.cfg, 1, self.max_seq_len, dtype=jnp.bfloat16)
+        logits, cache = forward(
+            params, tokens, self.cfg, positions=positions,
+            attention_mask=mask, cache=cache,
+            lora_adapter_idx=(adapter_idx[None]
+                              if self.lora_stack is not None else None),
+            compute_dtype=jnp.bfloat16, **self._lora_args(),
+        )
+        return logits[0, prompt_len - 1], cache
+
+    def _insert_impl(self, cache, logits_all, pos, remaining, active, temps,
+                     top_ps, stops, adapter_idx, rng,
+                     slot, row_cache, row_logits, plen, n_prompt, max_new,
+                     temp, top_p, stop_row, adapter, seed):
+        cache = dict(cache)
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], row_cache["k"], (0, slot, 0, 0, 0))
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], row_cache["v"], (0, slot, 0, 0, 0))
+        cache["pos"] = jax.lax.dynamic_update_slice(
+            cache["pos"], row_cache["pos"], (slot, 0))
+        cache["len"] = cache["len"].at[slot].set(plen)
+        return (
+            cache,
+            logits_all.at[slot].set(row_logits),
+            pos.at[slot].set(n_prompt),
+            remaining.at[slot].set(max_new),
+            active.at[slot].set(True),
+            temps.at[slot].set(temp),
+            top_ps.at[slot].set(top_p),
+            stops.at[slot].set(stop_row),
+            adapter_idx.at[slot].set(adapter),
+            rng.at[slot].set(jax.random.PRNGKey(seed)),
+        )
+
+    def _decode_impl(self, params, cache, logits, pos, remaining, active, rng,
+                     temps, top_ps, stops, adapter_idx, *, K: int):
+        lora_kw = self._lora_args()
+
+        def step(carry, _):
+            logits, cache, pos, remaining, active, rng = carry
+            split = jax.vmap(jax.random.split)(rng)
+            rng, sub = split[:, 0], split[:, 1]
+            nxt = jax.vmap(_sample_jit)(logits, temps, top_ps, sub)
+            is_stop = jnp.any(nxt[:, None] == stops, axis=1)
+            emit = active & ~is_stop & (remaining > 0)
+            emitted = jnp.where(emit, nxt, -1)
+            new_active = emit & (remaining > 1)
+            remaining = remaining - emit.astype(jnp.int32)
+
+            prev_len = cache["len"]
+            tok = jnp.where(emit, nxt, 0)[:, None]
+            logits2, cache = forward(
+                params, tok, self.cfg, positions=pos[:, None],
+                attention_mask=emit[:, None].astype(jnp.int32), cache=cache,
+                lora_adapter_idx=(adapter_idx
+                                  if self.lora_stack is not None else None),
+                compute_dtype=jnp.bfloat16, **lora_kw,
+            )
+            # forward advances every cursor; only emitting slots really moved
+            cache = dict(cache)
+            cache["len"] = prev_len + emit.astype(jnp.int32)
+            pos = pos + emit.astype(jnp.int32)
+            return (logits2[:, -1], cache, pos, remaining, new_active, rng), emitted
+
+        (logits, cache, pos, remaining, active, rng), emitted = jax.lax.scan(
+            step, (logits, cache, pos, remaining, active, rng), None, length=K
+        )
+        return emitted, logits, cache, pos, remaining, active, rng
+
+    # ------------------------------------------------------------ scheduler
+    def _admit(self, req: Request, slot: int):
+        from datatunerx_tpu.utils.decoding import prepare_prompt
+
+        ids, mask, positions, plen, n_prompt, max_new, _ = prepare_prompt(
+            req.prompt_ids, self.tokenizer.eos_token_id,
+            self.max_seq_len, req.max_new_tokens,
+        )
+        max_new = min(max_new, self.max_seq_len - plen)
+        row_logits, row_cache = self._prefill(
+            self.params, jnp.asarray([ids], jnp.int32),
+            jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
+            jnp.asarray(req.adapter, jnp.int32), prompt_len=plen,
+        )
+        stop_row = np.full((MAX_STOP,), -1, np.int32)
+        stop_row[: len(req.stop_ids)] = req.stop_ids
+        (self._cache, self._logits, self._pos, self._remaining, self._active,
+         self._temps, self._top_ps, self._stops, self._adapter_idx,
+         self._rng) = self._insert(
+            self._cache, self._logits, self._pos, self._remaining, self._active,
+            self._temps, self._top_ps, self._stops, self._adapter_idx, self._rng,
+            jnp.asarray(slot, jnp.int32), row_cache, row_logits,
+            jnp.asarray(plen, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
+            jnp.asarray(max_new, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(stop_row), jnp.asarray(req.adapter, jnp.int32),
+            jnp.asarray(req.seed, jnp.uint32),
+        )
+        self._slot_req[slot] = req
+
+    def _scheduler(self):
+        while not self._shutdown:
+            admitted = False
+            for slot in range(self.slots):
+                if self._slot_req[slot] is not None:
+                    continue
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    self._admit(req, slot)
+                    admitted = True
+                except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+                    req.finish(error=str(e))
+
+            if not any(r is not None for r in self._slot_req):
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+
+            try:
+                (emitted, self._logits, self._cache, self._pos,
+                 self._remaining, self._active, self._rng) = self._decode(
+                    self.params, self._cache, self._logits, self._pos,
+                    self._remaining, self._active, self._rng, self._temps,
+                    self._top_ps, self._stops, self._adapter_idx, K=self.chunk,
+                )
+                emitted_np = np.asarray(emitted)          # [K, S]
+                active_np = np.asarray(self._active)      # [S]
+            except Exception as e:  # noqa: BLE001 — device fault: fail all in-flight
+                for slot, req in enumerate(self._slot_req):
+                    if req is not None:
+                        req.finish(error=str(e))
+                        self._slot_req[slot] = None
+                continue
+
+            for k in range(emitted_np.shape[0]):
+                for slot in range(self.slots):
+                    t = int(emitted_np[k, slot])
+                    req = self._slot_req[slot]
+                    if t >= 0 and req is not None:
+                        req.push(t)
+            for slot in range(self.slots):
+                req = self._slot_req[slot]
+                if req is not None and not bool(active_np[slot]):
+                    req.finish()
+                    self._slot_req[slot] = None
+            # `admitted` intentionally unused beyond debugging
+            del admitted
+
+    # ---------------------------------------------------------------- API
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        stop_ids: Optional[set] = None,
+        adapter: str = "",
+    ) -> Request:
+        if adapter not in self.adapter_ids:
+            raise KeyError(
+                f"unknown adapter {adapter!r}; loaded: "
+                f"{sorted(n for n in self.adapter_ids if n)}"
+            )
+        stops = {int(s) for s in (stop_ids or set())}
+        stops.add(int(self.tokenizer.eos_token_id))
+        req = Request(prompt_ids, max_new_tokens, temperature, top_p, seed,
+                      sorted(stops), self.adapter_ids[adapter])
+        self._waiting.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt_ids, timeout: float = 300.0, **kw) -> List[int]:
+        req = self.submit(prompt_ids, **kw)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        return req.tokens
+
+    def _encode_chat(self, messages: List[dict]):
+        from datatunerx_tpu.serving.engine import encode_chat_messages
+
+        return encode_chat_messages(self.template, self.tokenizer, messages)
+
+    def perplexity(self, prompt_ids: Sequence[int],
+                   completion_ids: Sequence[int], adapter: str = "") -> dict:
+        """Mean completion NLL under the (optionally adapter-indexed) model —
+        the unmerged stack scores through the same lora_idx path decode uses."""
+        from datatunerx_tpu.serving.engine import (
+            nll_impl,
+            nll_result,
+            prepare_nll_inputs,
+        )
+
+        if adapter not in self.adapter_ids:
+            raise KeyError(f"unknown adapter {adapter!r}")
+        if not hasattr(self, "_nll"):
+            def impl(params, tokens, mask, aidx):
+                return nll_impl(
+                    params, self.cfg, tokens, mask,
+                    lora_adapter_idx=(aidx[None] if self.lora_stack is not None
+                                      else None),
+                    **self._lora_args(),
+                )
+
+            self._nll = jax.jit(impl)
+        tokens, mask, _ = prepare_nll_inputs(
+            list(prompt_ids), list(completion_ids),
+            self.tokenizer.eos_token_id, self.max_seq_len,
+        )
+        nll_sum, n_tok = self._nll(
+            self.params, tokens, mask,
+            jnp.asarray(self.adapter_ids[adapter], jnp.int32),
+        )
+        return nll_result(float(nll_sum), int(n_tok))
+
+    def chat(self, messages: List[dict], max_new_tokens: int = 128,
+             temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
+             adapter: str = "") -> str:
+        prompt_ids, stop_ids = self._encode_chat(messages)
+        out = self.generate(prompt_ids, max_new_tokens=max_new_tokens,
+                            temperature=temperature, top_p=top_p, seed=seed,
+                            stop_ids=stop_ids, adapter=adapter)
+        return self.tokenizer.decode(out, skip_special_tokens=True)
+
+    def chat_stream(self, messages: List[dict], max_new_tokens: int = 128,
+                    temperature: float = 0.0, top_p: float = 1.0,
+                    seed: int = 0, adapter: str = ""):
+        """Yields text deltas as tokens stream off the decode chunks."""
+        prompt_ids, stop_ids = self._encode_chat(messages)
+        req = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                          temperature=temperature, top_p=top_p, seed=seed,
+                          stop_ids=stop_ids, adapter=adapter)
+        sent = ""
+        acc: List[int] = []
+        while True:
+            t = req.stream.get()
+            if t is None:
+                break
+            acc.append(t)
+            text = self.tokenizer.decode(acc, skip_special_tokens=True)
+            if len(text) > len(sent) and not text.endswith("�"):
+                yield text[len(sent):]
+                sent = text
+        if req.error:
+            raise RuntimeError(req.error)
+
+    def close(self):
+        self._shutdown = True
+        self._wake.set()
+        self._thread.join(timeout=10)

@@ -207,6 +207,20 @@ class InferenceEngine:
         n = int(n)
         return np.asarray(out).tolist()[:n]  # ONE device->host fetch
 
+    def perplexity(self, prompt_ids: List[int], completion_ids: List[int]) -> dict:
+        """Mean NLL of the completion given the prompt (LoRA already merged
+        at load for this engine)."""
+        if not hasattr(self, "_nll"):
+            self._nll = jax.jit(
+                lambda params, tokens, mask: nll_impl(params, self.cfg, tokens, mask)
+            )
+        tokens, mask, _ = prepare_nll_inputs(
+            prompt_ids, completion_ids, self.tokenizer.eos_token_id,
+            self.max_seq_len,
+        )
+        nll_sum, n_tok = self._nll(self.params, tokens, mask)
+        return nll_result(float(nll_sum), int(n_tok))
+
     def chat(
         self,
         messages: List[dict],
@@ -216,36 +230,84 @@ class InferenceEngine:
         seed: int = 0,
     ) -> str:
         """OpenAI-ish messages → templated prompt → completion text."""
-        system = None
-        history: List[tuple] = []
-        query = ""
-        pending_user: Optional[str] = None
-        for m in messages:
-            role, content = m.get("role"), m.get("content", "")
-            if role == "system":
-                system = content
-            elif role == "user":
-                if pending_user is not None:
-                    history.append((pending_user, ""))
-                pending_user = content
-            elif role == "assistant" and pending_user is not None:
-                history.append((pending_user, content))
-                pending_user = None
-        query = pending_user or ""
-
-        prompt_ids, _ = self.template.encode_oneturn(
-            self.tokenizer, query, "", history or None, system
+        prompt_ids, stop_ids = encode_chat_messages(
+            self.template, self.tokenizer, messages
         )
-        stop_ids = {self.tokenizer.eos_token_id}
-        for w in self.template.stop_words:
-            tid = self.tokenizer.convert_tokens_to_ids(w)
-            if isinstance(tid, int):  # no-unk fast tokenizers return None
-                stop_ids.add(tid)
         out_ids = self.generate(
             prompt_ids, max_new_tokens=max_new_tokens, temperature=temperature,
             top_p=top_p, seed=seed, stop_ids=stop_ids,
         )
         return self.tokenizer.decode(out_ids, skip_special_tokens=True)
+
+
+def encode_chat_messages(template: Template, tokenizer, messages: List[dict]):
+    """OpenAI-ish messages → (prompt_ids, stop_ids) via the chat template.
+    Shared by the single-request and continuous-batching engines so template
+    semantics can never diverge between them."""
+    system = None
+    history: List[tuple] = []
+    pending: Optional[str] = None
+    for m in messages:
+        role, content = m.get("role"), m.get("content", "")
+        if role == "system":
+            system = content
+        elif role == "user":
+            if pending is not None:
+                history.append((pending, ""))
+            pending = content
+        elif role == "assistant" and pending is not None:
+            history.append((pending, content))
+            pending = None
+    prompt_ids, _ = template.encode_oneturn(
+        tokenizer, pending or "", "", history or None, system
+    )
+    stop_ids = {tokenizer.eos_token_id}
+    for w in template.stop_words:
+        tid = tokenizer.convert_tokens_to_ids(w)
+        if isinstance(tid, int):  # no-unk fast tokenizers return None
+            stop_ids.add(tid)
+    return prompt_ids, stop_ids
+
+
+def nll_result(nll_sum: float, n_tok: int) -> dict:
+    import math
+
+    mean = nll_sum / max(n_tok, 1)
+    return {"nll_sum": nll_sum, "num_tokens": n_tok,
+            "mean_nll": mean, "perplexity": math.exp(mean)}
+
+
+def nll_impl(params, cfg, tokens, target_mask, **fw_kwargs):
+    """Sum of -log p(token) over masked target positions + token count.
+
+    ``target_mask`` marks completion tokens in the ORIGINAL index space;
+    column j of the shifted targets corresponds to token j+1, so the mask is
+    sliced accordingly. Backs the serving /perplexity endpoint (dataset-driven
+    perplexity scoring, scoring/dataset_scoring.py)."""
+    logits, _ = forward(params, tokens, cfg, compute_dtype=jnp.bfloat16,
+                        **fw_kwargs)
+    logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    ll = jnp.take_along_axis(logprobs, tgt[..., None], axis=-1)[..., 0]
+    w = target_mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(-ll * w), jnp.sum(w)
+
+
+def prepare_nll_inputs(prompt_ids, completion_ids, eos_id, max_seq_len,
+                       bucket: int = 64):
+    """Right-pad prompt+completion to a compile bucket; completion tokens get
+    mask 1. Long inputs truncate from the LEFT, keeping the completion."""
+    ids = list(prompt_ids) + list(completion_ids)
+    if len(ids) > max_seq_len:
+        ids = ids[-max_seq_len:]
+    n_completion = min(len(completion_ids), len(ids) - 1)
+    total = len(ids)
+    padded = min(-(-total // bucket) * bucket, max_seq_len)
+    mask = [0] * (total - n_completion) + [1] * n_completion
+    ids = ids + [eos_id] * (padded - total)
+    mask = mask + [0] * (padded - total)
+    return (jnp.asarray([ids], jnp.int32), jnp.asarray([mask], jnp.int32),
+            n_completion)
 
 
 def _sample_jit(logits: jnp.ndarray, temperature, top_p, rng) -> jnp.ndarray:

@@ -55,6 +55,9 @@ class Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "not found"})
 
     def do_POST(self):
+        if self.path == "/perplexity":
+            self._perplexity()
+            return
         if self.path not in ("/chat/completions", "/v1/chat/completions"):
             self._json(404, {"error": "not found"})
             return
@@ -72,12 +75,26 @@ class Handler(BaseHTTPRequestHandler):
             if not isinstance(messages, list) or not messages:
                 self._json(400, {"error": "messages must be a non-empty list"})
                 return
-            text = STATE.engine.chat(
-                messages,
+            kwargs = dict(
                 max_new_tokens=int(req.get("max_tokens", 128)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_p=float(req.get("top_p", 1.0)),
             )
+            # "model" routes to a named LoRA adapter on batched engines
+            # (multi-tenant serving; unknown names 400 rather than silently
+            # serving the base)
+            adapter = req.get("model") or ""
+            if adapter and getattr(STATE.engine, "adapter_ids", None) is not None:
+                if adapter == STATE.model_path:
+                    adapter = ""
+                elif adapter not in STATE.engine.adapter_ids:
+                    self._json(400, {"error": f"unknown model/adapter {adapter!r}"})
+                    return
+                kwargs["adapter"] = adapter
+            if req.get("stream"):
+                self._stream_chat(messages, kwargs)
+                return
+            text = STATE.engine.chat(messages, **kwargs)
             self._json(200, {
                 "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
                 "object": "chat.completion",
@@ -92,27 +109,133 @@ class Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - serving must answer, not die
             self._json(500, {"error": str(e)})
 
+    def _perplexity(self):
+        """POST {"prompt": str, "completion": str[, "model": adapter]} →
+        completion NLL/perplexity under the served model. Backs the
+        perplexity metric of dataset-driven scoring."""
+        if STATE.engine is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt = req.get("prompt") or ""
+            completion = req.get("completion") or ""
+            if not completion:
+                self._json(400, {"error": "completion is required"})
+                return
+            tok = STATE.engine.tokenizer
+            p_ids = tok.encode(prompt) if prompt else []
+            try:
+                c_ids = tok.encode(completion, add_special_tokens=False)
+            except TypeError:  # tokenizers without the kwarg
+                c_ids = tok.encode(completion)
+            kwargs = {}
+            adapter = req.get("model") or ""
+            if adapter and getattr(STATE.engine, "adapter_ids", None) is not None:
+                if adapter not in STATE.engine.adapter_ids:
+                    self._json(400, {"error": f"unknown model/adapter {adapter!r}"})
+                    return
+                kwargs["adapter"] = adapter
+            self._json(200, STATE.engine.perplexity(p_ids, c_ids, **kwargs))
+        except Exception as e:  # noqa: BLE001
+            self._json(500, {"error": str(e)})
+
+    def _stream_chat(self, messages, kwargs):
+        """SSE: one ``data: {chat.completion.chunk}`` event per text delta,
+        then ``data: [DONE]`` (OpenAI stream shape)."""
+        stream_fn = getattr(STATE.engine, "chat_stream", None)
+        if stream_fn is None:  # single-slot engine: one terminal delta
+            def stream_fn(msgs, **kw):
+                yield STATE.engine.chat(msgs, **kw)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+
+        def event(payload: dict):
+            self.wfile.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
+            self.wfile.flush()
+
+        try:
+            try:
+                for delta in stream_fn(messages, **kwargs):
+                    event({
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": int(time.time()), "model": STATE.model_path,
+                        "choices": [{"index": 0,
+                                     "delta": {"content": delta},
+                                     "finish_reason": None}],
+                    })
+                event({
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": int(time.time()), "model": STATE.model_path,
+                    "choices": [{"index": 0, "delta": {},
+                                 "finish_reason": "stop"}],
+                })
+            except Exception as e:  # noqa: BLE001 — headers already sent:
+                # a second HTTP response would corrupt the stream, so errors
+                # become a terminal SSE event instead
+                event({"error": {"message": str(e)}})
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def log_message(self, *a):
         pass
 
 
 def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
-                      quantization=None):
+                      quantization=None, slots=4, decode_chunk=8,
+                      adapters=None):
     def _load():
         try:
-            from datatunerx_tpu.serving.engine import InferenceEngine
-
             STATE.model_path = model_path
-            STATE.engine = InferenceEngine(
-                model_path, checkpoint_path or None, template=template,
-                max_seq_len=max_seq_len, quantization=quantization or None,
-            )
+            if adapters and (slots <= 1 or quantization):
+                # refusing beats silently serving the base model under a
+                # tenant's adapter name
+                raise ValueError(
+                    "--adapters requires the batched engine "
+                    "(--slots > 1, no --quantization)"
+                )
+            if slots > 1 and not quantization:
+                from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+                STATE.engine = BatchedEngine(
+                    model_path, checkpoint_path or None, adapters=adapters,
+                    template=template, max_seq_len=max_seq_len,
+                    slots=slots, decode_chunk=decode_chunk,
+                )
+            else:
+                # single-slot path also carries serve-time quantization
+                from datatunerx_tpu.serving.engine import InferenceEngine
+
+                STATE.engine = InferenceEngine(
+                    model_path, checkpoint_path or None, template=template,
+                    max_seq_len=max_seq_len, quantization=quantization or None,
+                )
         except Exception as e:  # noqa: BLE001
             STATE.error = str(e)
 
     t = threading.Thread(target=_load, daemon=True)
     t.start()
     return t
+
+
+def parse_adapters(spec: str) -> dict:
+    """--adapters name=ckpt_path[,name=path…]"""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, path = part.partition("=")
+        if not name or not path:
+            raise ValueError(f"bad adapter spec {part!r}; want name=path")
+        out[name] = path
+    return out
 
 
 def main(argv=None):
@@ -125,10 +248,19 @@ def main(argv=None):
     p.add_argument("--quantization", default="",
                    choices=["", "int8", "int4", "nf4"],
                    help="serve-time base-weight quantization")
+    p.add_argument("--slots", type=int, default=4,
+                   help="continuous-batching cache slots (1 = single-request engine)")
+    p.add_argument("--decode_chunk", type=int, default=8,
+                   help="tokens per decode program (admission latency bound)")
+    p.add_argument("--adapters", default="",
+                   help="named LoRA adapters: name=ckpt[,name=ckpt…]; "
+                        "requests select one via the 'model' field")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
-                      args.max_seq_len, quantization=args.quantization)
+                      args.max_seq_len, quantization=args.quantization,
+                      slots=args.slots, decode_chunk=args.decode_chunk,
+                      adapters=parse_adapters(args.adapters))
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

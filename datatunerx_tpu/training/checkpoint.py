@@ -39,14 +39,24 @@ class CheckpointManager:
     ):
         import orbax.checkpoint as ocp
 
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        from datatunerx_tpu.utils import storage
+
+        if storage.is_uri(directory):
+            # object-store checkpoint dir (gs://…): tensorstore handles the
+            # scheme natively, no local mkdir (SURVEY.md §5.4 async-to-GCS)
+            self.directory = directory
+        else:
+            self.directory = os.path.abspath(directory)
+            os.makedirs(self.directory, exist_ok=True)
         self.save_interval_steps = save_interval_steps
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
-                enable_async_checkpointing=False,
+                # periodic saves overlap the step loop; barriers only on the
+                # final save / restore / close (at 7B a synchronous save
+                # stalls training for the full serialization time)
+                enable_async_checkpointing=True,
             ),
         )
 
@@ -60,8 +70,14 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self._mngr.save(step, args=ocp.args.StandardSave(state))
-        self._mngr.wait_until_finished()
+        if force:
+            # the final save gates the completion manifest: anything reading
+            # the manifest may immediately load the checkpoint
+            self._mngr.wait_until_finished()
         return True
+
+    def wait(self):
+        self._mngr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -70,6 +86,7 @@ class CheckpointManager:
         """Restore into the structure/shardings of `state_template`."""
         import orbax.checkpoint as ocp
 
+        self._mngr.wait_until_finished()  # in-flight async saves must land
         step = step if step is not None else self.latest_step()
         if step is None:
             return None, None
@@ -94,9 +111,13 @@ def write_manifest(
     extra: Optional[dict] = None,
 ) -> str:
     """Write the completion manifest at the deterministic key
-    ``<storage_path>/<run_name>/manifest.json`` and the legacy path file."""
-    run_dir = os.path.join(storage_path, run_name)
-    os.makedirs(run_dir, exist_ok=True)
+    ``<storage_path>/<run_name>/manifest.json`` and the legacy path file.
+    ``storage_path`` may be a local path or an object-store URI — the
+    controller reads the same key (no pod-exec, SURVEY.md §5.4)."""
+    from datatunerx_tpu.utils import storage
+
+    run_dir = storage.join(storage_path, run_name)
+    storage.makedirs(run_dir)
     manifest = {
         "run": run_name,
         "checkpoint": checkpoint_uri,
@@ -105,20 +126,19 @@ def write_manifest(
     }
     if extra:
         manifest.update(extra)
-    path = os.path.join(run_dir, MANIFEST_NAME)
-    with open(path, "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
-    with open(os.path.join(run_dir, LEGACY_PATH_FILE), "w") as f:
-        f.write(checkpoint_uri)
+    path = storage.join(run_dir, MANIFEST_NAME)
+    storage.write_text(path, json.dumps(manifest, indent=1, sort_keys=True))
+    storage.write_text(storage.join(run_dir, LEGACY_PATH_FILE), checkpoint_uri)
     return path
 
 
 def read_manifest(storage_path: str, run_name: str) -> Optional[dict]:
-    path = os.path.join(storage_path, run_name, MANIFEST_NAME)
-    if not os.path.exists(path):
+    from datatunerx_tpu.utils import storage
+
+    path = storage.join(storage_path, run_name, MANIFEST_NAME)
+    if not storage.exists(path):
         return None
-    with open(path) as f:
-        return json.load(f)
+    return json.loads(storage.read_text(path))
 
 
 def export_merged_model(params, cfg, export_dir: str, lora=None, scaling: float = 1.0) -> str:

@@ -155,8 +155,10 @@ def run(args: TrainArgs) -> dict:
     trainer = Trainer(cfg, tcfg, mesh=mesh)
     state = trainer.init_state(params, jax.random.PRNGKey(args.seed))
 
+    from datatunerx_tpu.utils import storage
+
     run_name = args.uid or os.path.basename(args.output_dir.rstrip("/")) or "run"
-    ckpt_dir = os.path.join(args.storage_path, run_name, "checkpoints")
+    ckpt_dir = storage.join(args.storage_path, run_name, "checkpoints")
     ckpt = CheckpointManager(ckpt_dir, save_interval_steps=args.save_steps)
     start_step = 0
     if args.resume and ckpt.latest_step() is not None:
@@ -263,7 +265,7 @@ def run(args: TrainArgs) -> dict:
 
     manifest_path = None
     if is_main:
-        checkpoint_uri = os.path.join(ckpt_dir, str(step))
+        checkpoint_uri = storage.join(ckpt_dir, str(step))
         manifest_path = write_manifest(
             args.storage_path, run_name, checkpoint_uri,
             metrics=final_metrics,

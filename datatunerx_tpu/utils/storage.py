@@ -1,0 +1,90 @@
+"""Object-storage plane: URI-aware file IO for datasets, manifests, exports.
+
+The reference ingests S3 datasets via Ray Data (reference cmd/tuning/
+train.py:339) and persists checkpoints under an S3 storage_path
+(train.py:369-376; S3 env config pkg/config/config.go:29-55). TPU-native
+equivalent: every dataset/manifest/storage path may be a plain local path or
+an fsspec URI (``gs://``, ``s3://``, ``memory://``, ``file://`` …) — GKE
+deployments point STORAGE_PATH at a bucket; tests use ``memory://``.
+
+Orbax checkpoints go through tensorstore, which speaks ``gs://`` natively, so
+checkpoint directories pass through unchanged; everything else funnels through
+these helpers.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+from typing import List
+
+_URI_MARK = "://"
+
+
+def is_uri(path: str) -> bool:
+    return _URI_MARK in str(path)
+
+
+def join(base: str, *parts: str) -> str:
+    """os.path.join for local paths, posix join for URIs (so Windows-style
+    separators can never corrupt an object key)."""
+    if is_uri(base):
+        return posixpath.join(base, *parts)
+    return os.path.join(base, *parts)
+
+
+def _storage_options(path: str) -> dict:
+    from datatunerx_tpu.operator.config import object_store_options
+
+    return object_store_options(str(path))
+
+
+def _fs(path: str):
+    import fsspec
+
+    fs, _, _ = fsspec.get_fs_token_paths(
+        path, storage_options=_storage_options(path)
+    )
+    return fs
+
+
+def exists(path: str) -> bool:
+    if not is_uri(path):
+        return os.path.exists(path)
+    return _fs(path).exists(path)
+
+
+def makedirs(path: str) -> None:
+    if not is_uri(path):
+        os.makedirs(path, exist_ok=True)
+        return
+    _fs(path).makedirs(path, exist_ok=True)
+
+
+def open_uri(path: str, mode: str = "r"):
+    """Open a local path or URI for reading/writing."""
+    if not is_uri(path):
+        return open(path, mode, newline="" if "r" in mode and "b" not in mode else None)
+    import fsspec
+
+    return fsspec.open(path, mode, **_storage_options(path)).open()
+
+
+def read_text(path: str) -> str:
+    with open_uri(path, "r") as f:
+        return f.read()
+
+
+def write_text(path: str, content: str) -> None:
+    parent = posixpath.dirname(path) if is_uri(path) else os.path.dirname(path)
+    if parent:
+        makedirs(parent)
+    with open_uri(path, "w") as f:
+        f.write(content)
+
+
+def listdir(path: str) -> List[str]:
+    if not is_uri(path):
+        return sorted(os.listdir(path))
+    fs = _fs(path)
+    return sorted(posixpath.basename(p.rstrip("/")) for p in fs.ls(path))

@@ -1,0 +1,337 @@
+"""In-process fake Kubernetes apiserver — the envtest stand-in.
+
+The reference's Makefile test target runs reconcilers against envtest (a real
+kube-apiserver + etcd, reference Makefile:115-117); this sandbox has no k8s
+binaries, so this module implements the apiserver REST semantics the
+controllers + KubeObjectStore depend on, with high fidelity:
+
+- group/version/plural endpoints for ANY resource (CRDs and e.g. JobSet alike)
+- optimistic concurrency via metadata.resourceVersion (409 Conflict)
+- the status subresource (PUT …/status writes only .status)
+- finalizer-gated deletion (DELETE sets deletionTimestamp while finalizers
+  remain; removal of the last finalizer completes the delete)
+- ownerReference cascade GC on actual deletion
+- label-selector list filtering (equality terms)
+- watch streams (?watch=true) with resourceVersion resume + initial-state
+  ADDED events, one JSON object per line
+
+Single global revision counter (etcd-style); resourceVersions are digit
+strings as on a real cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.rv = 0
+        # (group, plural, namespace, name) -> object dict
+        self.objects: dict = {}
+        # append-only: (seq, group, plural, namespace, type, snapshot)
+        self.events: list = []
+
+    def bump(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def emit(self, group, plural, ns, ev_type, obj):
+        self.events.append((self.rv, group, plural, ns, ev_type,
+                            json.loads(json.dumps(obj))))
+        self.cond.notify_all()
+
+
+class FakeKubeApiServer:
+    def __init__(self):
+        self.state = _State()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _status_err(self, code, reason, message):
+                self._send(code, {
+                    "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                    "reason": reason, "message": message, "code": code,
+                })
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                outer._get(self)
+
+            def do_POST(self):
+                outer._post(self)
+
+            def do_PUT(self):
+                outer._put(self)
+
+            def do_DELETE(self):
+                outer._delete(self)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    # ------------------------------------------------------------- routing
+    @staticmethod
+    def _parse(path: str):
+        """→ (group, version, plural, namespace, name, subresource, query)"""
+        parsed = urllib.parse.urlparse(path)
+        q = urllib.parse.parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        group = version = plural = ns = name = sub = None
+        if not parts:
+            return None
+        if parts[0] == "api":  # core
+            group, rest = "", parts[2:] if len(parts) > 2 else []
+            version = parts[1] if len(parts) > 1 else "v1"
+        elif parts[0] == "apis" and len(parts) >= 3:
+            group, version, rest = parts[1], parts[2], parts[3:]
+        else:
+            return None
+        if rest[:1] == ["namespaces"] and len(rest) >= 2:
+            ns, rest = rest[1], rest[2:]
+        if rest:
+            plural, rest = rest[0], rest[1:]
+        if rest:
+            name, rest = rest[0], rest[1:]
+        if rest:
+            sub = rest[0]
+        return group, version, plural, ns, name, sub, q
+
+    # --------------------------------------------------------------- verbs
+    def _get(self, h):
+        r = self._parse(h.path)
+        if not r or not r[2]:
+            return h._status_err(404, "NotFound", "unrecognized path")
+        group, version, plural, ns, name, sub, q = r
+        st = self.state
+        if name:
+            with st.lock:
+                obj = st.objects.get((group, plural, ns, name))
+            if obj is None:
+                return h._status_err(404, "NotFound", f"{plural} {ns}/{name}")
+            return h._send(200, obj)
+        if q.get("watch", ["false"])[0] == "true":
+            return self._watch(h, group, plural, ns, q)
+        # list
+        selector = q.get("labelSelector", [None])[0]
+        terms = {}
+        if selector:
+            for t in selector.split(","):
+                k, _, v = t.partition("=")
+                terms[k] = v
+        with st.lock:
+            items = [
+                o for (g, p, n, _), o in st.objects.items()
+                if g == group and p == plural and (ns is None or n == ns)
+                and all((o["metadata"].get("labels") or {}).get(k) == v
+                        for k, v in terms.items())
+            ]
+            rv = st.rv
+        return h._send(200, {
+            "kind": "List", "apiVersion": f"{group}/{version}" if group else "v1",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": json.loads(json.dumps(items)),
+        })
+
+    def _post(self, h):
+        r = self._parse(h.path)
+        if not r or not r[2] or r[4]:
+            return h._status_err(404, "NotFound", "bad create path")
+        group, version, plural, ns, _, _, _ = r
+        body = h._read_body()
+        name = (body.get("metadata") or {}).get("name")
+        if not name:
+            return h._status_err(422, "Invalid", "metadata.name required")
+        ns = ns or (body.get("metadata") or {}).get("namespace") or "default"
+        st = self.state
+        with st.lock:
+            key = (group, plural, ns, name)
+            if key in st.objects:
+                return h._status_err(409, "AlreadyExists",
+                                     f"{plural} {ns}/{name} already exists")
+            meta = body.setdefault("metadata", {})
+            meta["namespace"] = ns
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta["creationTimestamp"] = _now()
+            meta["generation"] = 1
+            meta.pop("deletionTimestamp", None)
+            body["status"] = {}  # status subresource: not settable on create
+            rv = st.bump()
+            meta["resourceVersion"] = str(rv)
+            st.objects[key] = body
+            st.emit(group, plural, ns, "ADDED", body)
+            return h._send(201, body)
+
+    def _put(self, h):
+        r = self._parse(h.path)
+        if not r or not r[4]:
+            return h._status_err(404, "NotFound", "bad update path")
+        group, version, plural, ns, name, sub, _ = r
+        body = h._read_body()
+        st = self.state
+        with st.lock:
+            key = (group, plural, ns, name)
+            cur = st.objects.get(key)
+            if cur is None:
+                return h._status_err(404, "NotFound", f"{plural} {ns}/{name}")
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != cur["metadata"]["resourceVersion"]:
+                return h._status_err(
+                    409, "Conflict",
+                    f"rv {sent_rv} != {cur['metadata']['resourceVersion']}")
+            new = json.loads(json.dumps(cur))
+            if sub == "status":
+                new["status"] = body.get("status", {})
+            else:
+                # main resource write: spec + mutable metadata; status immutable
+                new["spec"] = body.get("spec", {})
+                m, bm = new["metadata"], body.get("metadata") or {}
+                for f in ("labels", "annotations", "finalizers",
+                          "ownerReferences"):
+                    if f in bm:
+                        m[f] = bm[f]
+                    else:
+                        m.pop(f, None)
+                if new["spec"] != cur["spec"]:
+                    m["generation"] = int(m.get("generation", 1)) + 1
+            if new == cur:
+                return h._send(200, cur)  # no-op: no rv bump, no event
+            rv = st.bump()
+            new["metadata"]["resourceVersion"] = str(rv)
+            st.objects[key] = new
+            st.emit(group, plural, ns, "MODIFIED", new)
+            # finalizer-gated deletion completes when finalizers empty out
+            if (new["metadata"].get("deletionTimestamp")
+                    and not new["metadata"].get("finalizers")):
+                self._finalize_delete(key)
+            return h._send(200, new)
+
+    def _delete(self, h):
+        r = self._parse(h.path)
+        if not r or not r[4]:
+            return h._status_err(404, "NotFound", "bad delete path")
+        group, version, plural, ns, name, _, _ = r
+        st = self.state
+        with st.lock:
+            key = (group, plural, ns, name)
+            cur = st.objects.get(key)
+            if cur is None:
+                return h._status_err(404, "NotFound", f"{plural} {ns}/{name}")
+            if cur["metadata"].get("finalizers"):
+                if not cur["metadata"].get("deletionTimestamp"):
+                    cur = json.loads(json.dumps(cur))
+                    cur["metadata"]["deletionTimestamp"] = _now()
+                    cur["metadata"]["resourceVersion"] = str(st.bump())
+                    st.objects[key] = cur
+                    st.emit(group, plural, ns, "MODIFIED", cur)
+                return h._send(200, cur)
+            self._finalize_delete(key)
+            return h._send(200, {"kind": "Status", "status": "Success"})
+
+    def _finalize_delete(self, key):
+        """Caller holds the lock. Removes + emits DELETED + GC cascade."""
+        st = self.state
+        obj = st.objects.pop(key, None)
+        if obj is None:
+            return
+        group, plural, ns, _ = key
+        st.bump()
+        st.emit(group, plural, ns, "DELETED", obj)
+        uid = obj["metadata"].get("uid")
+        # ownerReference cascade (the GC controller on a real cluster)
+        for ckey, child in list(st.objects.items()):
+            for ref in child["metadata"].get("ownerReferences") or []:
+                if ref.get("uid") == uid:
+                    cg, cp, cns, cname = ckey
+                    if child["metadata"].get("finalizers"):
+                        if not child["metadata"].get("deletionTimestamp"):
+                            child = json.loads(json.dumps(child))
+                            child["metadata"]["deletionTimestamp"] = _now()
+                            child["metadata"]["resourceVersion"] = str(st.bump())
+                            st.objects[ckey] = child
+                            st.emit(cg, cp, cns, "MODIFIED", child)
+                    else:
+                        self._finalize_delete(ckey)
+                    break
+
+    # --------------------------------------------------------------- watch
+    def _watch(self, h, group, plural, ns, q):
+        st = self.state
+        since = q.get("resourceVersion", [None])[0]
+        since = int(since) if since and since.isdigit() else None
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def write_event(ev_type, obj):
+            line = json.dumps({"type": ev_type, "object": obj}).encode() + b"\n"
+            h.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            h.wfile.flush()
+
+        try:
+            with st.lock:
+                if since is None:
+                    # initial-state snapshot (k8s "send initial events")
+                    for (g, p, n, _), o in list(st.objects.items()):
+                        if g == group and p == plural and (ns is None or n == ns):
+                            write_event("ADDED", json.loads(json.dumps(o)))
+                    cursor = len(st.events)
+                else:
+                    cursor = 0
+                while True:
+                    while cursor < len(st.events):
+                        seq, g, p, n, ev_type, obj = st.events[cursor]
+                        cursor += 1
+                        if g != group or p != plural:
+                            continue
+                        if ns is not None and n != ns:
+                            continue
+                        if since is not None and seq <= since:
+                            continue
+                        write_event(ev_type, obj)
+                    if not st.cond.wait(timeout=30):
+                        return  # idle timeout: client reconnects
+        except (BrokenPipeError, ConnectionResetError):
+            return

@@ -218,3 +218,47 @@ def test_metrics_reconcile_counters(tmp_path):
         assert 'dtx_operator_reconciles_total{kind="Finetune"}' in text
     finally:
         srv.shutdown()
+
+
+# ------------------------------------------------------------------- web UI
+
+def test_ui_served_and_trainermetrics(tmp_path):
+    """The single-file UI + the jsonl metrics-series endpoint behind it
+    (reference datatunerx-ui equivalent, README.md:30-32)."""
+    import json as _json
+    import os
+    import urllib.request
+
+    from datatunerx_tpu.operator.api import Finetune, ObjectMeta
+    from datatunerx_tpu.operator.backends import LocalProcessBackend
+    from datatunerx_tpu.operator.manager import build_manager
+    from datatunerx_tpu.operator.backends import FakeServingBackend
+
+    store = ObjectStore()
+    backend = LocalProcessBackend(str(tmp_path / "work"))
+    mgr = build_manager(store, backend, FakeServingBackend(),
+                        storage_path=str(tmp_path / "s"), with_scoring=False)
+    store.create(Finetune(metadata=ObjectMeta(name="run-ui"),
+                          spec={"llm": "x", "dataset": "y"}))
+    # fabricate the jsonl the trainer would write
+    watch = tmp_path / "work" / "run-ui" / "result" / "watch"
+    os.makedirs(watch)
+    with open(watch / "trainer_log.jsonl", "w") as f:
+        for i in range(3):
+            f.write(_json.dumps({"current_steps": i + 1, "total_steps": 3,
+                                 "loss": 2.0 - i * 0.5, "lr": 1e-4}) + "\n")
+    srv, port = serve_api(store, manager=mgr, port=0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10) as r:
+            html = r.read().decode()
+        assert "datatunerx-tpu" in html and "trainermetrics" in html
+        assert r.headers.get("Content-Type", "").startswith("text/html")
+
+        code, body = _req("GET", f"http://127.0.0.1:{port}/trainermetrics/default/run-ui")
+        assert code == 200
+        assert [row["loss"] for row in body["train"]] == [2.0, 1.5, 1.0]
+
+        code, _ = _req("GET", f"http://127.0.0.1:{port}/trainermetrics/default/nope")
+        assert code == 404
+    finally:
+        srv.shutdown()

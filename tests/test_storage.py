@@ -1,0 +1,85 @@
+"""Object-storage plane: URI-aware dataset ingest + manifests (VERDICT
+round-1 item 4). ``memory://`` (in-process fsspec filesystem) stands in for
+gs://; the code path is identical — only the scheme's backend differs."""
+
+import json
+
+import pytest
+
+from datatunerx_tpu.data.loader import CsvDataset
+from datatunerx_tpu.training.checkpoint import read_manifest, write_manifest
+from datatunerx_tpu.utils import storage
+
+
+@pytest.fixture(autouse=True)
+def clean_memory_fs():
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    yield
+    for p in list(fs.store):
+        fs.store.pop(p, None)
+
+
+def test_uri_helpers():
+    assert storage.is_uri("gs://b/k") and storage.is_uri("memory://x")
+    assert not storage.is_uri("/tmp/x")
+    assert storage.join("gs://b", "a", "c.json") == "gs://b/a/c.json"
+    assert storage.join("/tmp", "a") == "/tmp/a"
+
+
+def test_read_write_roundtrip_memory():
+    storage.write_text("memory://bucket/dir/file.txt", "hello")
+    assert storage.exists("memory://bucket/dir/file.txt")
+    assert storage.read_text("memory://bucket/dir/file.txt") == "hello"
+    assert not storage.exists("memory://bucket/dir/nope.txt")
+
+
+def test_csv_dataset_from_uri():
+    storage.write_text(
+        "memory://data/train.csv",
+        "instruction,response\nhello,world\nfoo,bar\n",
+    )
+    ds = CsvDataset("memory://data/train.csv")
+    assert len(ds) == 2
+    assert ds.records[0]["instruction"] == "hello"
+
+
+def test_jsonl_dataset_from_uri():
+    rows = [{"instruction": "a", "response": "b"},
+            {"instruction": "c", "response": "d"}]
+    storage.write_text("memory://data/train.jsonl",
+                       "\n".join(json.dumps(r) for r in rows))
+    ds = CsvDataset("memory://data/train.jsonl")
+    assert len(ds) == 2 and ds.records[1]["response"] == "d"
+
+
+def test_dataset_uri_missing_raises():
+    with pytest.raises(FileNotFoundError):
+        CsvDataset("memory://data/absent.csv")
+
+
+def test_manifest_roundtrip_over_uri():
+    path = write_manifest("memory://runs", "uid-1", "gs://ckpts/uid-1/7",
+                          metrics={"loss": 1.25}, extra={"lora_scaling": 2.0})
+    assert path == "memory://runs/uid-1/manifest.json"
+    m = read_manifest("memory://runs", "uid-1")
+    assert m["checkpoint"] == "gs://ckpts/uid-1/7"
+    assert m["metrics"]["loss"] == 1.25 and m["lora_scaling"] == 2.0
+    # legacy path file (reference train.py:383-389 contract)
+    assert storage.read_text("memory://runs/uid-1/checkpoint_path") == (
+        "gs://ckpts/uid-1/7")
+    assert read_manifest("memory://runs", "uid-2") is None
+
+
+def test_s3_storage_options_from_env(monkeypatch):
+    from datatunerx_tpu.operator.config import object_store_options
+
+    monkeypatch.setenv("S3_ENDPOINT", "minio.ns.svc:9000")
+    monkeypatch.setenv("S3_ACCESSKEYID", "ak")
+    monkeypatch.setenv("S3_SECRETACCESSKEY", "sk")
+    monkeypatch.setenv("S3_SECURE", "false")
+    opts = object_store_options("s3://bucket/key.csv")
+    assert opts["key"] == "ak" and opts["secret"] == "sk"
+    assert opts["client_kwargs"]["endpoint_url"] == "http://minio.ns.svc:9000"
+    assert object_store_options("gs://bucket/key.csv") == {}
