@@ -133,6 +133,10 @@ class ApiHandler(BaseHTTPRequestHandler):
             for kind, n in sorted(counts.items()):
                 lines.append(
                     f'dtx_operator_reconciles_total{{kind="{kind}"}} {n}')
+            probe = getattr(self.manager, "health_probe", None) if self.manager else None
+            if probe is not None:
+                lines.append("# TYPE dtx_device_healthy gauge")
+                lines.append(f"dtx_device_healthy {int(bool(probe.healthy))}")
             body = ("\n".join(lines) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")

@@ -160,6 +160,12 @@ class LocalProcessBackend:
             except subprocess.TimeoutExpired:
                 proc.kill()
 
+    def has_active_jobs(self) -> bool:
+        """True while any trainer subprocess is live (the device health probe
+        must not contend with a running job for the single-client TPU)."""
+        with self._lock:
+            return any(p.poll() is None for p in self._procs.values())
+
     def metrics_series(self, name: str, max_points: int = 2000) -> dict:
         """Parsed trainer/eval jsonl curves for the UI (the data the reference
         surfaces via Prometheus + its web frontend, SURVEY.md §3.5)."""

@@ -43,9 +43,13 @@ RUNNING_POLL_S = 30.0  # reference :171,190
 class FinetuneController:
     kind = Finetune
 
-    def __init__(self, backend, storage_path: Optional[str] = None):
+    def __init__(self, backend, storage_path: Optional[str] = None,
+                 health_probe=None):
         self.backend = backend
         self.storage_path = storage_path or config.get_storage_path()
+        # optional DeviceHealthProbe (operator/health.py): while unhealthy,
+        # hold new submissions instead of queueing onto a wedged device
+        self.health_probe = health_probe
 
     # ------------------------------------------------------------ reconcile
     def reconcile(self, store: ObjectStore, ft: Finetune) -> Optional[Result]:
@@ -90,6 +94,17 @@ class FinetuneController:
 
         job_status = self.backend.status(meta.name)
         if job_status == "NotFound":
+            if self.health_probe is not None and not self.health_probe.healthy:
+                reason = self.health_probe.last_error or "device unhealthy"
+                if ft.status.get("state") != Finetune.STATE_PENDING or (
+                        ft.status.get("backendUnavailable") != reason):
+                    ft.status["state"] = Finetune.STATE_PENDING
+                    ft.status["backendUnavailable"] = reason
+                    store.update(ft)
+                return Result(requeue_after=RUNNING_POLL_S)
+            # recovered: drop the hold note (persisted by the post-submit
+            # update below — no extra write)
+            ft.status.pop("backendUnavailable", None)
             params = merge_hyperparameters(
                 hyperparameter.spec.get("parameters", {}),
                 hp_ref.get("overrides"),

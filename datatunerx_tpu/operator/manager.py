@@ -40,10 +40,13 @@ def build_manager(
     serving_backend,
     storage_path: str | None = None,
     with_scoring: bool = True,
+    health_probe=None,
 ) -> Manager:
     mgr = Manager(store)
     mgr.training_backend = training_backend  # exposed for the /logs endpoint
-    mgr.register(FinetuneController(training_backend, storage_path=storage_path))
+    mgr.health_probe = health_probe  # exposed for /metrics
+    mgr.register(FinetuneController(training_backend, storage_path=storage_path,
+                                    health_probe=health_probe))
     mgr.register(FinetuneJobController(serving_backend))
     mgr.register(FinetuneExperimentController())
     if with_scoring:
@@ -91,7 +94,17 @@ def main(argv=None):
     p.add_argument("--kube-url", default=None,
                    help="apiserver base URL (default: in-cluster config)")
     p.add_argument("--kube-namespace", default="default")
+    p.add_argument("--device-health-interval", type=float, default=0.0,
+                   help="seconds between local-device health probes (0 = off; "
+                        "--backend local only — cluster backends rely on "
+                        "kubelet/JobSet health); while unhealthy, new "
+                        "Finetunes hold in Pending instead of submitting "
+                        "onto a wedged device")
     args = p.parse_args(argv)
+    if args.device_health_interval > 0 and args.backend != "local":
+        print("[controller-manager] warning: --device-health-interval only "
+              f"applies to --backend local (got {args.backend!r}); ignored",
+              flush=True)
 
     if args.storage_path:
         # one source of truth: generate.py renders --storage_path for trainers
@@ -121,18 +134,27 @@ def main(argv=None):
         return _run_manager(args, store, mgr)
 
     store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
+    probe = None
     if args.backend == "local":
         training = LocalProcessBackend(args.workdir)
         from datatunerx_tpu.serving.local_backend import LocalServingBackend
 
         serving = LocalServingBackend(args.workdir)
+        if args.device_health_interval > 0:
+            from datatunerx_tpu.operator.health import DeviceHealthProbe
+
+            probe = DeviceHealthProbe(
+                interval_s=args.device_health_interval,
+                idle_check=lambda: not training.has_active_jobs(),
+            ).start()
     elif args.backend == "manifest":
         training = ManifestBackend(args.workdir)
         serving = FakeServingBackend()
     else:
         training, serving = FakeTrainingBackend(), FakeServingBackend()
 
-    mgr = build_manager(store, training, serving, storage_path=args.storage_path)
+    mgr = build_manager(store, training, serving, storage_path=args.storage_path,
+                        health_probe=probe)
     return _run_manager(args, store, mgr)
 
 

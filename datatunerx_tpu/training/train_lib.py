@@ -41,6 +41,19 @@ _ATTN_MODULES = ("q_proj", "k_proj", "v_proj", "o_proj")
 _MLP_MODULES = ("gate_proj", "up_proj", "down_proj")
 
 
+def _freeze_selected_modules(train_cfg) -> tuple:
+    """The trainable module group for freeze tuning (reference
+    ``--name_module_trainable``, cmd/tuning/parser.py:125-137). Single source
+    of truth for BOTH the optimizer labels and the gradient mask."""
+    return (_MLP_MODULES if train_cfg.name_module_trainable in ("mlp",)
+            else _ATTN_MODULES)
+
+
+def _in_freeze_group(path, modules) -> bool:
+    names = [getattr(p, "key", p) for p in path]
+    return "layers" in names and any(m in names for m in modules)
+
+
 @dataclasses.dataclass
 class TrainConfig:
     finetuning_type: str = "lora"  # lora | freeze | full | none
@@ -109,17 +122,12 @@ class Trainer:
             # leaves is handled by the gradient mask in _train_step_impl.
             import optax
 
-            modules = (
-                _MLP_MODULES
-                if train_cfg.name_module_trainable in ("mlp",)
-                else _ATTN_MODULES
-            )
+            modules = _freeze_selected_modules(train_cfg)
 
             def labels(params):
                 def lab(path, x):
-                    names = [getattr(p, "key", p) for p in path]
-                    in_group = "layers" in names and any(m in names for m in modules)
-                    return "train" if in_group else "frozen"
+                    return ("train" if _in_freeze_group(path, modules)
+                            else "frozen")
 
                 return jax.tree_util.tree_map_with_path(lab, params)
 
@@ -191,16 +199,11 @@ class Trainer:
         """Per-leaf multiplicative masks for freeze tuning."""
         L = self.model_cfg.num_layers
         n = self.cfg.num_layer_trainable
-        modules = (
-            _MLP_MODULES
-            if self.cfg.name_module_trainable in ("mlp",)
-            else _ATTN_MODULES
-        )
+        modules = _freeze_selected_modules(self.cfg)
         layer_ok = (jnp.arange(L) >= L - n).astype(jnp.float32)
 
         def mask_for(path, x):
-            names = [getattr(p, "key", p) for p in path]
-            if "layers" in names and any(m in names for m in modules):
+            if _in_freeze_group(path, modules):
                 return layer_ok.reshape((L,) + (1,) * (x.ndim - 1))
             return jnp.zeros((), jnp.float32)
 

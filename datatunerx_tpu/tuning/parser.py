@@ -80,6 +80,7 @@ class TrainArgs:
     predict_with_generate: bool = False
     max_new_tokens: int = 64
     generate_examples: int = 32
+    generate_eval_steps: int = 0  # 0 = end-of-run only; N = also every N steps
     # TPU additions
     profile_steps: int = 0  # capture a jax.profiler trace for N steps
     mesh: Optional[str] = None  # e.g. "dp=4,fsdp=2,tp=1,sp=1"

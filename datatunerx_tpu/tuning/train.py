@@ -216,6 +216,17 @@ def run(args: TrainArgs) -> dict:
             if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
                 _run_eval(trainer, state, eval_examples, args, pad_id, logger,
                           step, is_main, dist)
+            if (args.predict_with_generate and eval_records
+                    and args.generate_eval_steps > 0
+                    and step % args.generate_eval_steps == 0
+                    and step < total_steps  # final step gets the full pass below
+                    and dist["num_processes"] == 1 and is_main):
+                # in-training generative eval: a small sample at step
+                # intervals so rouge/bleu CURVES exist, not just a final
+                # point (reference only evaluates at the end)
+                _generative_eval_step(trainer, state, cfg, tokenizer, template,
+                                      eval_records, args, logger, step,
+                                      tcfg.finetuning_type)
         if (eval_examples and args.eval_steps == 0 and not done
                 and step < total_steps):
             # eval_steps=0 → once per epoch (final epoch's eval happens below)
@@ -313,6 +324,27 @@ def run(args: TrainArgs) -> dict:
         "manifest": manifest_path,
         "checkpoint_dir": ckpt_dir,
     }
+
+
+def _generative_eval_step(trainer, state, cfg, tokenizer, template,
+                          eval_records, args, logger, step, finetuning_type):
+    from datatunerx_tpu.training.generate import generative_eval
+
+    gen_lora = (state.lora, trainer.scaling) if finetuning_type == "lora" else None
+    try:
+        m = generative_eval(
+            state.params, cfg, tokenizer, template, eval_records,
+            args.output_dir, lora=gen_lora,
+            max_new_tokens=args.max_new_tokens,
+            # keep interval evals cheap: a handful of examples per point
+            max_examples=min(args.generate_examples, 8),
+            columns=args.columns_map,
+        )
+    except Exception as e:  # noqa: BLE001 — never kill training for an eval
+        print(f"[generate@{step}] failed (training continues): {e}", flush=True)
+        return
+    if m:
+        logger.log_eval(step, m)
 
 
 def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,

@@ -255,3 +255,25 @@ def test_model_family_smoke(tmp_path, preset):
         assert "loss" in r["metrics"]
     finally:
         del PRESETS[f"tiny-{preset}"]
+
+
+def test_generate_eval_at_step_intervals(tmp_path):
+    """--generate_eval_steps N: rouge/bleu points land in the eval log DURING
+    training, not just at the end (VERDICT round-1 item 9)."""
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    argv, out, storage = _flags(
+        tmp_path, template="vanilla", max_steps="3", bf16="false",
+        remat="none", quantization="", predict_with_generate="true",
+        max_new_tokens="8", generate_examples="4", generate_eval_steps="1",
+    )
+    args = parse_train_args(argv)
+    r = run(args)
+    assert r["steps"] == 3
+    eval_log = [json.loads(l) for l in
+                open(os.path.join(out, "watch", "eval_log.jsonl"))]
+    gen_rows = [(e["current_steps"], e) for e in eval_log if "rouge-l" in e]
+    # interval points at steps 1 and 2 plus the full end-of-run pass at 3
+    steps = sorted(s for s, _ in gen_rows)
+    assert steps == [1, 2, 3], eval_log

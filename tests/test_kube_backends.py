@@ -135,23 +135,37 @@ def test_finetune_transitions_from_jobset_conditions(cluster):
         "node": 2,
     })
     store.create(ft)
-    mgr.run_until_idle()
-    assert store.get(Finetune, "mft").status["state"] == Finetune.STATE_PENDING
+
+    def wait_state(state, timeout=20.0):
+        # watch-driven enqueues are async with the kube store: poll the
+        # reconcile loop until the state lands instead of asserting after one
+        # run_until_idle
+        import time as _t
+
+        deadline = _t.time() + timeout
+        while _t.time() < deadline:
+            mgr.run_until_idle()
+            mgr.drain_scheduled()
+            if store.get(Finetune, "mft").status.get("state") == state:
+                return
+            _t.sleep(0.05)
+        raise AssertionError(
+            f"never reached {state}; at "
+            f"{store.get(Finetune, 'mft').status.get('state')!r}")
+
+    wait_state(Finetune.STATE_PENDING)
 
     _set_jobset_status(client, "mft", {"replicatedJobsStatus": [{"active": 2}]})
     mgr.enqueue("Finetune", "default", "mft")
-    mgr.run_until_idle()
-    assert store.get(Finetune, "mft").status["state"] == Finetune.STATE_RUNNING
+    wait_state(Finetune.STATE_RUNNING)
 
     uid = store.get(Finetune, "mft").metadata.uid
     write_manifest(storage, uid, "/storage/ckpt/9", metrics={"loss": 0.9})
     _set_jobset_status(client, "mft",
                        {"conditions": [{"type": "Completed", "status": "True"}]})
     mgr.enqueue("Finetune", "default", "mft")
-    mgr.run_until_idle()
-    mgr.drain_scheduled()
+    wait_state(Finetune.STATE_SUCCESSFUL)
     obj = store.get(Finetune, "mft")
-    assert obj.status["state"] == Finetune.STATE_SUCCESSFUL
     assert obj.status["llmCheckpoint"]["checkpointPath"] == "/storage/ckpt/9"
     store.stop()
 
