@@ -169,6 +169,38 @@ class BatchIterator:
         return self.epoch(0)
 
 
+class PreferenceBatchIterator:
+    """Preference-pair batches for DPO: the BatchIterator contract
+    (deterministic shuffles, host slicing, grad-accum reshape, static shapes)
+    applied to chosen/rejected pairs. Both sides ride two internal
+    BatchIterators with the SAME seed over equal-length lists, so their
+    per-epoch permutations are identical and pairs stay aligned."""
+
+    def __init__(self, examples: Sequence[Dict[str, List[int]]], **kw):
+        kw.pop("pack", None)  # packing crosses pair boundaries: not for DPO
+        chosen = [{"input_ids": e["chosen_ids"], "labels": e["chosen_labels"]}
+                  for e in examples]
+        rejected = [{"input_ids": e["rejected_ids"],
+                     "labels": e["rejected_labels"]} for e in examples]
+        self._c = BatchIterator(chosen, **kw)
+        self._r = BatchIterator(rejected, **kw)
+
+    def steps_per_epoch(self) -> int:
+        return self._c.steps_per_epoch()
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+        for bc, br in zip(self._c.epoch(epoch), self._r.epoch(epoch)):
+            yield {
+                "chosen_ids": bc["input_ids"],
+                "chosen_labels": bc["labels"],
+                "rejected_ids": br["input_ids"],
+                "rejected_labels": br["labels"],
+            }
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
 def _pad_rows(batch: Dict[str, np.ndarray], target_rows: int) -> Dict[str, np.ndarray]:
     out = {}
     for k, v in batch.items():

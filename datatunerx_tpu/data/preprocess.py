@@ -102,6 +102,44 @@ def preprocess_records(
     return out
 
 
+def preprocess_preference_records(
+    records: Iterable[Dict[str, Any]],
+    template: Template,
+    tokenizer,
+    cutoff_len: int = 1024,
+    columns: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, List[int]]]:
+    """DPO preference pairs: records carry ``instruction`` + ``chosen`` +
+    ``rejected`` (canonical names; the Dataset CR column map applies as for
+    SFT). Each side is encoded exactly like an SFT example — prompt masked,
+    response labeled — so sequence log-probs cover response tokens only.
+
+    The reference reserves ``--stage dpo`` in its schema
+    (cmd/tuning/parser.py:117-120, dpo knobs :170-185) but ships no runtime
+    for it; this is new capability."""
+    out = []
+    for rec in records:
+        rec = map_columns(rec, columns)
+        query = rec.get("instruction")
+        chosen, rejected = rec.get("chosen"), rec.get("rejected")
+        if not all(isinstance(v, str) and v != ""
+                   for v in (query, chosen, rejected)):
+            continue
+        if rec.get("query"):
+            query = query + "\n" + rec["query"]
+        pair = {}
+        for side, response in (("chosen", chosen), ("rejected", rejected)):
+            ids, labels = encode_supervised_example(
+                template, tokenizer, query, response,
+                history=rec.get("history"), system=rec.get("system"),
+                cutoff_len=cutoff_len,
+            )
+            pair[f"{side}_ids"] = ids
+            pair[f"{side}_labels"] = labels
+        out.append(pair)
+    return out
+
+
 def pad_to_block(
     examples: Sequence[Dict[str, List[int]]],
     block_size: int,

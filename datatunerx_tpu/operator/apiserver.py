@@ -137,6 +137,12 @@ class ApiHandler(BaseHTTPRequestHandler):
             if probe is not None:
                 lines.append("# TYPE dtx_device_healthy gauge")
                 lines.append(f"dtx_device_healthy {int(bool(probe.healthy))}")
+            pool = getattr(self.manager, "slice_pool", None) if self.manager else None
+            if pool is not None:
+                lines.append("# TYPE dtx_slices_free gauge")
+                lines.append(f"dtx_slices_free {pool.free_count()}")
+                lines.append("# TYPE dtx_slices_total gauge")
+                lines.append(f"dtx_slices_total {len(pool.slices())}")
             body = ("\n".join(lines) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")

@@ -252,6 +252,14 @@ class ManifestBackend:
         hosts = int(spec.get("num_hosts", 1))
         image = spec.get("image", "datatunerx-tpu/trainer:latest")
         args = [str(a) for a in spec["args"]]
+        # per-job placement overrides (operator/placement.py SlicePool):
+        # concurrent jobs land on disjoint sub-slices/node pools
+        topology = spec.get("topology") or self.topology
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": self.accelerator,
+            "cloud.google.com/gke-tpu-topology": topology,
+            **(spec.get("node_selector") or {}),
+        }
         return {
             "apiVersion": "jobset.x-k8s.io/v1alpha2",
             "kind": "JobSet",
@@ -269,10 +277,7 @@ class ManifestBackend:
                                 "metadata": {"labels": spec.get("labels", {})},
                                 "spec": {
                                     "restartPolicy": "Never",
-                                    "nodeSelector": {
-                                        "cloud.google.com/gke-tpu-accelerator": self.accelerator,
-                                        "cloud.google.com/gke-tpu-topology": self.topology,
-                                    },
+                                    "nodeSelector": node_selector,
                                     "containers": [{
                                         "name": "trainer",
                                         "image": image,

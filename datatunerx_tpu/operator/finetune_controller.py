@@ -44,12 +44,15 @@ class FinetuneController:
     kind = Finetune
 
     def __init__(self, backend, storage_path: Optional[str] = None,
-                 health_probe=None):
+                 health_probe=None, slice_pool=None):
         self.backend = backend
         self.storage_path = storage_path or config.get_storage_path()
         # optional DeviceHealthProbe (operator/health.py): while unhealthy,
         # hold new submissions instead of queueing onto a wedged device
         self.health_probe = health_probe
+        # optional SlicePool (operator/placement.py): concurrent jobs onto
+        # disjoint sub-slices; no pool = single-tenant, no gating
+        self.slice_pool = slice_pool
 
     # ------------------------------------------------------------ reconcile
     def reconcile(self, store: ObjectStore, ft: Finetune) -> Optional[Result]:
@@ -58,6 +61,8 @@ class FinetuneController:
         # deletion: tear down the training job, drop finalizer (reference :98-113)
         if meta.deletion_timestamp:
             self.backend.delete(meta.name)
+            if self.slice_pool is not None:
+                self.slice_pool.release(meta.name)
             if FINETUNE_GROUP_FINALIZER in meta.finalizers:
                 meta.finalizers.remove(FINETUNE_GROUP_FINALIZER)
                 store.update(ft)
@@ -70,7 +75,11 @@ class FinetuneController:
 
         state = ft.status.get("state", "")
         if state in (Finetune.STATE_SUCCESSFUL, Finetune.STATE_FAILED):
-            return None  # terminal states are sticky (reference :115-123)
+            # terminal states are sticky (reference :115-123); the slice goes
+            # back to the pool for the next queued job
+            if self.slice_pool is not None:
+                self.slice_pool.release(meta.name)
+            return None
 
         if state == "":
             ft.status["state"] = Finetune.STATE_INIT
@@ -105,12 +114,37 @@ class FinetuneController:
             # recovered: drop the hold note (persisted by the post-submit
             # update below — no extra write)
             ft.status.pop("backendUnavailable", None)
+            placement = None
+            hosts = None
+            if self.slice_pool is not None:
+                # controller-owned placement (SURVEY §7.4#3): every job gets
+                # a DISJOINT sub-slice; none free -> hold in Pending
+                placement = self.slice_pool.acquire(
+                    meta.name, min_chips=int(ft.spec.get("node", 1) or 1) * 4)
+                if placement is None:
+                    if (ft.status.get("state") != Finetune.STATE_PENDING
+                            or not ft.status.get("placementPending")):
+                        ft.status["state"] = Finetune.STATE_PENDING
+                        ft.status["placementPending"] = "no free TPU slice"
+                        store.update(ft)
+                    return Result(requeue_after=RUNNING_POLL_S)
+                # hosts must match the ASSIGNED slice (4 chips per v5e host):
+                # a multi-host podslice expects exactly its host count of
+                # workers or TPU init hangs
+                hosts = max(1, placement.chips // 4)
             params = merge_hyperparameters(
                 hyperparameter.spec.get("parameters", {}),
                 hp_ref.get("overrides"),
             )
-            args = build_trainer_args(ft, dataset.spec, params, uid=meta.uid)
-            self.backend.submit(meta.name, generate_training_spec(ft, args))
+            args = build_trainer_args(ft, dataset.spec, params, uid=meta.uid,
+                                      num_workers=hosts)
+            spec = generate_training_spec(ft, args, num_hosts=hosts)
+            if placement is not None:
+                ft.status.pop("placementPending", None)
+                ft.status["placement"] = placement.to_dict()
+                spec["topology"] = placement.topology
+                spec["node_selector"] = placement.node_selector
+            self.backend.submit(meta.name, spec)
             ft.status["state"] = Finetune.STATE_PENDING
             ft.status["jobInfo"] = {"jobName": meta.name, "backend": type(self.backend).__name__}
             store.update(ft)

@@ -88,6 +88,7 @@ def build_trainer_args(
     dataset_spec: dict,
     parameters: dict,
     uid: Optional[str] = None,
+    num_workers: Optional[int] = None,  # slice placement overrides spec.node
 ) -> List[str]:
     """The trainer CLI flag list (replaces getRayJobEntrypoint,
     finetune_controller.go:457-514). Same contract, three reference bugs fixed:
@@ -115,7 +116,8 @@ def build_trainer_args(
     columns = {
         f["mapTo"]: f["name"]
         for f in features
-        if f.get("mapTo") and f.get("name") in ("instruction", "response")
+        if f.get("mapTo") and f.get("name") in ("instruction", "response",
+                                                "chosen", "rejected")
     }
     if columns:
         import json as _json
@@ -133,6 +135,11 @@ def build_trainer_args(
         args += ["--quantization", "int8"]
     elif _truthy(parameters.get("int4")):
         args += ["--quantization", "int4"]
+
+    # trainerType selects the training stage (Hyperparameter CR field the
+    # reference carries but never consumes): sft (default) | dpo
+    if str(parameters.get("trainerType", "")).lower() == "dpo":
+        args += ["--stage", "dpo"]
 
     peft = str(parameters.get("PEFT", "true")).lower() in ("true", "1", "")
     args += ["--finetuning_type", "lora" if peft else "full"]
@@ -160,7 +167,7 @@ def build_trainer_args(
         args += ["--pack_sequences", "true"]
 
     node = int(finetune.spec.get("node", 1) or 1)
-    args += ["--num_workers", str(max(node, 1))]
+    args += ["--num_workers", str(num_workers or max(node, 1))]
     args += ["--storage_path", config.get_storage_path()]
     if config.get_metrics_export_address():
         args += ["--metrics_export_address", config.get_metrics_export_address()]
@@ -172,11 +179,14 @@ def _truthy(v) -> bool:
     return str(v).lower() in ("true", "1", "yes")
 
 
-def generate_training_spec(finetune: Finetune, args: List[str]) -> dict:
+def generate_training_spec(finetune: Finetune, args: List[str],
+                           num_hosts: Optional[int] = None) -> dict:
     node = int(finetune.spec.get("node", 1) or 1)
     return {
         "args": args,
-        "num_hosts": max(node, 1),
+        # with slice placement, host count must match the ASSIGNED slice —
+        # a multi-host podslice expects exactly its host count of workers
+        "num_hosts": num_hosts or max(node, 1),
         "image": finetune.spec.get("image", {}).get("name"),
         "labels": generate_instance_label(finetune.metadata.name),
         "env": {},

@@ -41,12 +41,17 @@ def build_manager(
     storage_path: str | None = None,
     with_scoring: bool = True,
     health_probe=None,
+    slice_pool=None,
 ) -> Manager:
     mgr = Manager(store)
     mgr.training_backend = training_backend  # exposed for the /logs endpoint
     mgr.health_probe = health_probe  # exposed for /metrics
+    mgr.slice_pool = slice_pool  # exposed for /metrics
+    if slice_pool is not None:
+        _restore_placements(store, slice_pool)
     mgr.register(FinetuneController(training_backend, storage_path=storage_path,
-                                    health_probe=health_probe))
+                                    health_probe=health_probe,
+                                    slice_pool=slice_pool))
     mgr.register(FinetuneJobController(serving_backend))
     mgr.register(FinetuneExperimentController())
     if with_scoring:
@@ -54,6 +59,38 @@ def build_manager(
 
         mgr.register(ScoringController())
     return mgr
+
+
+def _restore_placements(store, slice_pool, attempts: int = 5):
+    """Rebuild slice assignments from Finetune.status.placement so restarts
+    (and leadership takeovers) don't double-book sub-slices. A transient
+    apiserver error must NOT silently skip restore — double-booked slices
+    wedge both jobs — so this retries briefly and then raises (crash →
+    pod restart → clean retry)."""
+    import time as _time
+
+    from datatunerx_tpu.operator.api import Finetune
+
+    finetunes = None
+    for i in range(attempts):
+        try:
+            finetunes = store.list(Finetune, namespace=None)
+            break
+        except Exception as e:  # noqa: BLE001
+            print(f"[controller-manager] placement restore list failed "
+                  f"({i + 1}/{attempts}): {e}", flush=True)
+            if i == attempts - 1:
+                raise
+            _time.sleep(3)
+    for ft in finetunes:
+        placement = ft.status.get("placement")
+        state = ft.status.get("state", "")
+        if placement and state not in (Finetune.STATE_SUCCESSFUL,
+                                       Finetune.STATE_FAILED):
+            try:
+                slice_pool.restore(ft.metadata.name, placement.get("name", ""))
+            except ValueError as e:
+                print(f"[controller-manager] placement restore: {e}", flush=True)
 
 
 class _HealthHandler(BaseHTTPRequestHandler):
@@ -80,7 +117,12 @@ def main(argv=None):
     # reference options.go:38-48
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
-    p.add_argument("--leader-elect", default="false")  # accepted no-op
+    p.add_argument("--leader-elect", default="false",
+                   help="lease-based leader election (kube backend; no-op "
+                        "for in-process stores, which are single-replica "
+                        "by construction)")
+    p.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p.add_argument("--leader-renew-period", type=float, default=5.0)
     p.add_argument("--enable-cert-rotator", default="false")  # accepted no-op
     # TPU-native options
     p.add_argument("--persist-dir", default=None,
@@ -124,14 +166,31 @@ def main(argv=None):
 
         client = KubeClient(base_url=args.kube_url,
                             namespace=args.kube_namespace)
+        from datatunerx_tpu.operator.placement import pool_from_env
+
         store = AdmittingStore(KubeObjectStore(client))
         training = KubeTrainingBackend(client, namespace=args.kube_namespace,
                                        out_dir=args.workdir)
         serving = KubeServingBackend(client, namespace=args.kube_namespace,
                                      out_dir=args.workdir)
         mgr = build_manager(store, training, serving,
-                            storage_path=args.storage_path)
-        return _run_manager(args, store, mgr)
+                            storage_path=args.storage_path,
+                            slice_pool=pool_from_env())
+        elector = None
+        if str(args.leader_elect).lower() in ("true", "1", "yes"):
+            import os as _os
+
+            from datatunerx_tpu.operator.leaderelection import LeaderElector
+
+            # lost leadership = exit; the Deployment restarts the replica,
+            # which re-enters the election (controller-runtime's contract)
+            elector = LeaderElector(
+                client, namespace=args.kube_namespace,
+                lease_duration_s=args.leader_lease_duration,
+                renew_period_s=args.leader_renew_period,
+                on_stopped_leading=lambda: _os._exit(1),
+            )
+        return _run_manager(args, store, mgr, elector=elector)
 
     store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
     probe = None
@@ -153,12 +212,14 @@ def main(argv=None):
     else:
         training, serving = FakeTrainingBackend(), FakeServingBackend()
 
+    from datatunerx_tpu.operator.placement import pool_from_env
+
     mgr = build_manager(store, training, serving, storage_path=args.storage_path,
-                        health_probe=probe)
+                        health_probe=probe, slice_pool=pool_from_env())
     return _run_manager(args, store, mgr)
 
 
-def _run_manager(args, store, mgr: Manager) -> int:
+def _run_manager(args, store, mgr: Manager, elector=None) -> int:
     # REST API (kubectl-shaped user surface + metrics) on the metrics address,
     # plain health probes on the probe address — mirroring the reference's
     # :8080/:8081 split (options.go:13-14)
@@ -175,13 +236,32 @@ def _run_manager(args, store, mgr: Manager) -> int:
     srv = ThreadingHTTPServer(("0.0.0.0", health_port), _HealthHandler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
 
-    mgr.sync_all()
-    mgr.start()
-    print(
-        f"[controller-manager] running; api+metrics on :{api_port}, "
-        f"health on :{health_port}",
-        flush=True,
-    )
+    if elector is not None:
+        def lead():
+            print(f"[controller-manager] became leader as {elector.identity}",
+                  flush=True)
+            if getattr(mgr, "slice_pool", None) is not None:
+                # re-read assignments at takeover: the boot-time snapshot of
+                # a standby predates jobs the previous leader placed
+                _restore_placements(mgr.store, mgr.slice_pool)
+            mgr.sync_all()
+            mgr.start()
+
+        elector.on_started_leading = lead
+        elector.start()
+        print(
+            f"[controller-manager] standing by for leadership; api+metrics on "
+            f":{api_port}, health on :{health_port}",
+            flush=True,
+        )
+    else:
+        mgr.sync_all()
+        mgr.start()
+        print(
+            f"[controller-manager] running; api+metrics on :{api_port}, "
+            f"health on :{health_port}",
+            flush=True,
+        )
     try:
         while True:
             time.sleep(3600)

@@ -64,6 +64,16 @@ def validate_hyperparameter(obj: CustomResource):
         for t in str(p["loRATarget"]).split(","):
             _require(t.strip() in LORA_TARGETS,
                      f"invalid lora target {t.strip()!r}")
+    if p.get("trainerType"):
+        tt = str(p["trainerType"]).lower()
+        _require(tt in ("sft", "dpo"),
+                 "trainerType must be sft or dpo (rm/ppo reserved)")
+        if tt == "dpo":
+            # catch the unrunnable combo at admission, not after the JobSet
+            # burned its retries: DPO requires the LoRA policy/reference trick
+            _require(str(p.get("PEFT", "true")).lower() not in ("false", "0"),
+                     "trainerType dpo requires PEFT (LoRA) — the reference "
+                     "policy is the adapter-free base model")
 
 
 def validate_dataset(obj: CustomResource):

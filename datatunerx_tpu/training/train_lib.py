@@ -34,7 +34,7 @@ from datatunerx_tpu.models.lora import (
     lora_scaling,
 )
 from datatunerx_tpu.parallel.sharding import batch_shardings, shard_tree
-from datatunerx_tpu.training.loss import causal_lm_loss
+from datatunerx_tpu.training.loss import IGNORE_INDEX, causal_lm_loss
 from datatunerx_tpu.training.optimizer import make_optimizer, make_schedule
 
 _ATTN_MODULES = ("q_proj", "k_proj", "v_proj", "o_proj")
@@ -76,9 +76,22 @@ class TrainConfig:
     grad_accum: int = 1
     neftune_alpha: float = 0.0
     compute_dtype: Any = jnp.bfloat16
+    # stage: sft (default) | dpo. DPO is LoRA-only by design: the frozen
+    # reference policy is the BASE model with the adapter switched off — one
+    # weight tree serves both policies, no second 7B copy in HBM (the
+    # reference reserves --stage dpo but has no runtime for it).
+    stage: str = "sft"
+    dpo_beta: float = 0.1
 
     def __post_init__(self):
         assert self.finetuning_type in ("lora", "freeze", "full", "none")
+        assert self.stage in ("sft", "dpo")
+        if self.stage == "dpo" and self.finetuning_type != "lora":
+            raise ValueError(
+                "stage dpo requires finetuning_type lora (the reference "
+                "policy is the adapter-free base; full/freeze would need a "
+                "second copy of the weights)"
+            )
 
 
 class TrainState(struct.PyTreeNode):
@@ -210,7 +223,47 @@ class Trainer:
         return jax.tree_util.tree_map_with_path(mask_for, params)
 
     # ----------------------------------------------------------------- loss
+    def _sequence_logps(self, params, lora, ids, labels, rng, train: bool):
+        """Per-sequence sum of response-token log-probs ([B]); response
+        positions are where the (shifted) label is not IGNORE_INDEX."""
+        logits, _ = forward(
+            params, ids, self.model_cfg,
+            lora=(lora, self.scaling) if lora is not None else None,
+            compute_dtype=self.cfg.compute_dtype,
+            lora_dropout=self.cfg.lora_dropout if (train and lora is not None) else 0.0,
+            dropout_rng=rng if (train and lora is not None) else None,
+        )
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = ids[:, 1:]
+        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        mask = (labels[:, 1:] != IGNORE_INDEX).astype(jnp.float32)
+        return jnp.sum(ll * mask, axis=-1)
+
+    def _dpo_loss(self, trainable, state: TrainState, batch, rng, train: bool):
+        """DPO (Rafailov et al. 2023): -log σ(β[(π_c − ref_c) − (π_r − ref_r)]).
+        Policy = base + adapter; reference = same base, adapter OFF
+        (stop-gradient) — both sides in the same program, chosen and rejected
+        concatenated so each policy is ONE forward."""
+        ids = jnp.concatenate([batch["chosen_ids"], batch["rejected_ids"]], 0)
+        labels = jnp.concatenate([batch["chosen_labels"],
+                                  batch["rejected_labels"]], 0)
+        pol = self._sequence_logps(state.params, trainable, ids, labels, rng, train)
+        ref = jax.lax.stop_gradient(
+            self._sequence_logps(state.params, None, ids, labels, None, False)
+        )
+        B = batch["chosen_ids"].shape[0]
+        margin = (pol[:B] - ref[:B]) - (pol[B:] - ref[B:])
+        loss = -jax.nn.log_sigmoid(self.cfg.dpo_beta * margin)
+        # padding pairs (all-IGNORE labels, from eval tail padding) would
+        # each contribute ln2: mask them out of sum AND count
+        valid = jnp.any(batch["chosen_labels"][:, 1:] != IGNORE_INDEX,
+                        axis=-1).astype(jnp.float32)
+        # (sum, count) contract shared with the token-NLL path: count = pairs
+        return jnp.sum(loss * valid), jnp.sum(valid).astype(jnp.int32)
+
     def _forward_loss(self, trainable, state: TrainState, batch, rng, train: bool):
+        if self.cfg.stage == "dpo":
+            return self._dpo_loss(trainable, state, batch, rng, train)
         if self.cfg.finetuning_type == "lora":
             params, lora = state.params, trainable
         else:

@@ -37,7 +37,7 @@ class TrainArgs:
     checkpoint_dir: Optional[str] = None  # resume/merge adapters
     export_dir: Optional[str] = None
     # finetuning (reference cmd/tuning/parser.py:112-221)
-    stage: str = "sft"  # pt | sft (rm/ppo/dpo reserved)
+    stage: str = "sft"  # pt | sft | dpo (rm/ppo reserved)
     finetuning_type: str = "lora"  # lora | freeze | full | none
     num_layer_trainable: int = 3
     name_module_trainable: str = "mlp"
@@ -46,6 +46,7 @@ class TrainArgs:
     lora_dropout: float = 0.1
     lora_target: str = "q_proj,v_proj"
     neft_alpha: float = 0.0
+    dpo_beta: float = 0.1  # reference reserves dpo knobs (parser.py:170-185)
     num_workers: int = 1
     storage_path: Optional[str] = None
     metrics_export_address: Optional[str] = None
@@ -92,11 +93,13 @@ class TrainArgs:
     def __post_init__(self):
         if self.stage not in ("pt", "sft", "rm", "ppo", "dpo"):
             raise ValueError(f"invalid --stage {self.stage}")
-        if self.stage not in ("pt", "sft"):
+        if self.stage not in ("pt", "sft", "dpo"):
             raise NotImplementedError(
-                f"stage {self.stage!r} is reserved (reference implements sft only; "
-                "cmd/tuning/train.py has no rm/ppo/dpo path either)"
+                f"stage {self.stage!r} is reserved (reference implements sft "
+                "only; rm/ppo have no runtime there either)"
             )
+        if self.stage == "dpo" and self.finetuning_type != "lora":
+            raise ValueError("--stage dpo requires --finetuning_type lora")
         if self.finetuning_type not in ("lora", "freeze", "full", "none"):
             raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
         if self.quantization not in (None, "int4", "int8"):

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from datatunerx_tpu.data import BatchIterator, CsvDataset, get_template
+from datatunerx_tpu.data.preprocess import preprocess_preference_records
 from datatunerx_tpu.models.config import ModelConfig
 from datatunerx_tpu.parallel.distributed import maybe_initialize_distributed
 from datatunerx_tpu.parallel.mesh import make_mesh, mesh_shape_for
@@ -85,16 +86,30 @@ def run(args: TrainArgs) -> dict:
     template = get_template(args.template, tokenizer)
     pad_id = tokenizer.pad_token_id or 0
     train_ds = CsvDataset(args.train_path, columns=args.columns_map)
-    train_examples = train_ds.encode(template, tokenizer, cutoff_len=args.block_size)
+    if args.stage == "dpo":
+        train_examples = preprocess_preference_records(
+            train_ds.records, template, tokenizer,
+            cutoff_len=args.block_size, columns=args.columns_map,
+        )
+    else:
+        train_examples = train_ds.encode(template, tokenizer,
+                                         cutoff_len=args.block_size)
     if not train_examples:
         raise RuntimeError("Empty dataset!")
     eval_examples = None
     eval_records = None
     if args.evaluation_path:
         eval_ds = CsvDataset(args.evaluation_path, columns=args.columns_map)
-        eval_records = eval_ds.records
-        eval_examples = eval_ds.encode(template, tokenizer,
-                                       cutoff_len=args.block_size)
+        if args.stage == "dpo":
+            # preference eval: mean DPO loss over held-out pairs
+            eval_examples = preprocess_preference_records(
+                eval_ds.records, template, tokenizer,
+                cutoff_len=args.block_size, columns=args.columns_map,
+            )
+        else:
+            eval_records = eval_ds.records
+            eval_examples = eval_ds.encode(template, tokenizer,
+                                           cutoff_len=args.block_size)
 
     # ----- mesh --------------------------------------------------------
     n_dev = len(jax.devices())
@@ -110,7 +125,12 @@ def run(args: TrainArgs) -> dict:
     data_par = shape[0] * shape[1]
 
     global_batch = args.per_device_train_batch_size * data_par * args.gradient_accumulation_steps
-    it = BatchIterator(
+    iterator_cls = BatchIterator
+    if args.stage == "dpo":
+        from datatunerx_tpu.data.loader import PreferenceBatchIterator
+
+        iterator_cls = PreferenceBatchIterator
+    it = iterator_cls(
         train_examples,
         global_batch=global_batch,
         block_size=args.block_size,
@@ -151,6 +171,8 @@ def run(args: TrainArgs) -> dict:
         grad_accum=args.gradient_accumulation_steps,
         neftune_alpha=args.neft_alpha,
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
+        stage="dpo" if args.stage == "dpo" else "sft",
+        dpo_beta=args.dpo_beta,
     )
     trainer = Trainer(cfg, tcfg, mesh=mesh)
     state = trainer.init_state(params, jax.random.PRNGKey(args.seed))
@@ -352,7 +374,12 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
     data_par = 1
     if trainer.mesh is not None:
         data_par = trainer.mesh.shape["dp"] * trainer.mesh.shape["fsdp"]
-    eval_it = BatchIterator(
+    iterator_cls = BatchIterator
+    if args.stage == "dpo":
+        from datatunerx_tpu.data.loader import PreferenceBatchIterator
+
+        iterator_cls = PreferenceBatchIterator
+    eval_it = iterator_cls(
         eval_examples,
         global_batch=args.per_device_eval_batch_size * data_par,
         block_size=args.block_size,
@@ -364,6 +391,10 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
     )
     m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
                                  for b in eval_it.epoch(0)))
+    if args.stage == "dpo":
+        # eval_loss IS the mean DPO loss over held-out pairs; exp(loss) is
+        # not a perplexity in this stage
+        m.pop("perplexity", None)
     if is_main:
         logger.log_eval(step, m)
     return m

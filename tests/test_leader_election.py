@@ -1,0 +1,120 @@
+"""Lease-based leader election (VERDICT round-1 missing item 3: controller
+HA): single winner, renewal holds the lease, takeover after expiry,
+lost-leadership callback."""
+
+import threading
+import time
+
+from datatunerx_tpu.operator.kubeclient import KubeClient
+from datatunerx_tpu.operator.leaderelection import (
+    LEASE_GROUP,
+    LEASE_PLURAL,
+    LEASE_VERSION,
+    LeaderElector,
+)
+from tests.fake_apiserver import FakeKubeApiServer
+
+
+def _cluster():
+    srv = FakeKubeApiServer().start()
+    return srv, KubeClient(base_url=srv.url)
+
+
+def test_single_winner_and_renewal():
+    srv, client = _cluster()
+    try:
+        a = LeaderElector(client, identity="a", lease_duration_s=2,
+                          renew_period_s=0.05)
+        b = LeaderElector(client, identity="b", lease_duration_s=2,
+                          renew_period_s=0.05)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False  # held and fresh
+        assert a.try_acquire_or_renew() is True   # renewal succeeds
+        lease = client.get(LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL,
+                           "default", a.lease_name)
+        assert lease["spec"]["holderIdentity"] == "a"
+        assert lease["spec"]["leaseTransitions"] == 0
+    finally:
+        srv.stop()
+
+
+def test_takeover_after_expiry():
+    srv, client = _cluster()
+    try:
+        a = LeaderElector(client, identity="a", lease_duration_s=0.2,
+                          renew_period_s=0.05)
+        b = LeaderElector(client, identity="b", lease_duration_s=0.2,
+                          renew_period_s=0.05)
+        assert a.try_acquire_or_renew()
+        time.sleep(0.4)  # a stops renewing; lease expires
+        assert b.try_acquire_or_renew() is True
+        lease = client.get(LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL,
+                           "default", b.lease_name)
+        assert lease["spec"]["holderIdentity"] == "b"
+        assert lease["spec"]["leaseTransitions"] == 1
+        # a's next renew discovers the loss
+        assert a.try_acquire_or_renew() is False
+    finally:
+        srv.stop()
+
+
+def test_run_loop_callbacks_on_loss():
+    srv, client = _cluster()
+    try:
+        events = []
+        a = LeaderElector(
+            client, identity="a", lease_duration_s=0.3, renew_period_s=0.05,
+            on_started_leading=lambda: events.append("started"),
+            on_stopped_leading=lambda: events.append("stopped"),
+        )
+        a.start()
+        deadline = time.time() + 5
+        while "started" not in events and time.time() < deadline:
+            time.sleep(0.02)
+        assert a.is_leader and events == ["started"]
+
+        # usurper grabs the lease by force (simulates this replica pausing
+        # past the lease duration, e.g. a long GC or network partition)
+        lease = client.get(LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL,
+                           "default", a.lease_name)
+        lease["spec"]["holderIdentity"] = "b"
+        lease["spec"]["renewTime"] = "2099-01-01T00:00:00.000000Z"
+        client.replace(LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, "default",
+                       a.lease_name, lease)
+        deadline = time.time() + 5
+        while "stopped" not in events and time.time() < deadline:
+            time.sleep(0.02)
+        assert events == ["started", "stopped"]
+        assert not a.is_leader
+    finally:
+        a.stop()
+        srv.stop()
+
+
+def test_two_elector_failover_end_to_end():
+    """Replica A leads; A dies; replica B takes over within a lease window."""
+    srv, client = _cluster()
+    try:
+        stop_a = threading.Event()
+        a = LeaderElector(client, identity="a", lease_duration_s=0.4,
+                          renew_period_s=0.1)
+        b = LeaderElector(client, identity="b", lease_duration_s=0.4,
+                          renew_period_s=0.1)
+        ta = threading.Thread(target=a.run, args=(stop_a,), daemon=True)
+        ta.start()
+        deadline = time.time() + 5
+        while not a.is_leader and time.time() < deadline:
+            time.sleep(0.02)
+        assert a.is_leader
+        b.start()  # joins the election second; must NOT grab the held lease
+        time.sleep(0.3)
+        assert not b.is_leader
+
+        stop_a.set()  # replica A dies (stops renewing)
+        deadline = time.time() + 5
+        while not b.is_leader and time.time() < deadline:
+            time.sleep(0.02)
+        assert b.is_leader
+    finally:
+        b.stop()
+        srv.stop()

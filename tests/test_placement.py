@@ -1,0 +1,147 @@
+"""Multi-tenant slice scheduling (SURVEY §7.4 hard part #3): concurrent
+Finetunes map to DISJOINT sub-slices; exhausted pool holds jobs in Pending;
+terminal states release slices; restarts rebuild assignments."""
+
+import json
+
+import pytest
+
+from datatunerx_tpu.operator.api import Finetune, ObjectMeta
+from datatunerx_tpu.operator.backends import (
+    FakeServingBackend,
+    FakeTrainingBackend,
+    ManifestBackend,
+)
+from datatunerx_tpu.operator.manager import build_manager
+from datatunerx_tpu.operator.placement import Slice, SlicePool, pool_from_env
+from datatunerx_tpu.operator.store import ObjectStore
+from tests.test_operator import _seed_deps
+
+
+def _pool(n=2, chips=8):
+    return SlicePool([
+        Slice(f"slice-{i}", topology="2x4", chips=chips,
+              node_selector={"cloud.google.com/gke-nodepool": f"pool-{i}"})
+        for i in range(n)
+    ])
+
+
+def _finetune(name, node=1):
+    return Finetune(metadata=ObjectMeta(name=name), spec={
+        "llm": "llama2-7b", "dataset": "ds-a",
+        "hyperparameter": {"hyperparameterRef": "hp-a"},
+        "image": {"path": "/m"}, "node": node,
+    })
+
+
+# ------------------------------------------------------------------- pool
+
+def test_pool_acquire_release_semantics():
+    pool = _pool(2)
+    a = pool.acquire("job-a")
+    b = pool.acquire("job-b")
+    assert a.name != b.name
+    assert pool.acquire("job-c") is None  # exhausted
+    assert pool.acquire("job-a").name == a.name  # idempotent
+    pool.release("job-a")
+    assert pool.acquire("job-c") is not None
+    assert pool.free_count() == 0
+
+
+def test_pool_smallest_fit_and_min_chips():
+    pool = SlicePool([Slice("big", chips=32), Slice("small", chips=8)])
+    assert pool.acquire("j1", min_chips=4).name == "small"  # smallest fit
+    assert pool.acquire("j2", min_chips=16).name == "big"
+    pool.release("j1")
+    assert pool.acquire("j3", min_chips=64) is None  # nothing big enough
+
+
+def test_pool_from_env(monkeypatch):
+    monkeypatch.delenv("TPU_SLICE_POOL", raising=False)
+    assert pool_from_env() is None
+    monkeypatch.setenv("TPU_SLICE_POOL", json.dumps([
+        {"name": "a", "topology": "4x4", "chips": 16,
+         "nodeSelector": {"pool": "x"}},
+        {"name": "b"},
+    ]))
+    pool = pool_from_env()
+    assert [s.name for s in pool.slices()] == ["a", "b"]
+    assert pool.slices()[0].chips == 16
+    with pytest.raises(ValueError):
+        SlicePool([Slice("dup"), Slice("dup")])
+
+
+# ------------------------------------------------------------- controller
+
+def test_controller_places_jobs_on_disjoint_slices():
+    store = ObjectStore()
+    training = FakeTrainingBackend()
+    pool = _pool(2)
+    mgr = build_manager(store, training, FakeServingBackend(),
+                        storage_path="/tmp/x", with_scoring=False,
+                        slice_pool=pool)
+    _seed_deps(store)
+    for n in ("p1", "p2", "p3"):
+        store.create(_finetune(n))
+    mgr.run_until_idle()
+
+    s1 = store.get(Finetune, "p1").status.get("placement")
+    s2 = store.get(Finetune, "p2").status.get("placement")
+    assert s1 and s2 and s1["name"] != s2["name"]
+    assert training.jobs["p1"]["node_selector"] == s1["nodeSelector"]
+    assert training.jobs["p1"]["topology"] == "2x4"
+    # hosts + --num_workers must match the ASSIGNED slice (8 chips = 2 hosts),
+    # not spec.node — a multi-host podslice needs exactly its host count
+    assert training.jobs["p1"]["num_hosts"] == 2
+    args = training.jobs["p1"]["args"]
+    assert args[args.index("--num_workers") + 1] == "2"
+
+    # third job: pool exhausted → Pending with a reason, NOT submitted
+    p3 = store.get(Finetune, "p3")
+    assert p3.status["state"] == Finetune.STATE_PENDING
+    assert p3.status["placementPending"] == "no free TPU slice"
+    assert "p3" not in training.jobs
+
+    # p1 finishes → slice freed → p3 gets placed on requeue
+    training.set_state("p1", "Failed")
+    mgr.enqueue("Finetune", "default", "p1")
+    mgr.drain_scheduled()
+    assert store.get(Finetune, "p1").status["state"] == Finetune.STATE_FAILED
+    mgr.enqueue("Finetune", "default", "p3")
+    mgr.drain_scheduled()
+    p3 = store.get(Finetune, "p3")
+    assert "p3" in training.jobs
+    assert p3.status["placement"]["name"] == s1["name"]  # reused freed slice
+    assert "placementPending" not in p3.status
+
+
+def test_placement_restored_after_operator_restart(tmp_path):
+    store = ObjectStore()
+    training = FakeTrainingBackend()
+    pool = _pool(2)
+    mgr = build_manager(store, training, FakeServingBackend(),
+                        storage_path="/tmp/x", with_scoring=False,
+                        slice_pool=pool)
+    _seed_deps(store)
+    store.create(_finetune("r1"))
+    mgr.run_until_idle()
+    taken = store.get(Finetune, "r1").status["placement"]["name"]
+
+    # "restart": fresh pool + manager over the same store
+    pool2 = _pool(2)
+    build_manager(store, FakeTrainingBackend(), FakeServingBackend(),
+                  storage_path="/tmp/x", with_scoring=False, slice_pool=pool2)
+    assert pool2.assignment("r1").name == taken
+    assert pool2.free_count() == 1
+
+
+def test_manifest_render_uses_placement_selector(tmp_path):
+    backend = ManifestBackend(str(tmp_path))
+    manifest = backend.render_training("j", {
+        "args": ["--x"], "num_hosts": 1, "topology": "4x4",
+        "node_selector": {"cloud.google.com/gke-nodepool": "pool-9"},
+    })
+    pod = (manifest["spec"]["replicatedJobs"][0]["template"]["spec"]
+           ["template"]["spec"])
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+    assert pod["nodeSelector"]["cloud.google.com/gke-nodepool"] == "pool-9"
