@@ -127,21 +127,47 @@ POS_SENTINEL = jnp.int32(2**30)  # marks invalid/pad cache slots: the causal
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
-               per_slot: bool = False):
+               per_slot: bool = False, quantize: Optional[str] = None):
     """KV cache. ``per_slot=True`` gives each batch row its own write cursor
     (``len`` is [batch]) — continuous batching needs rows at different depths
-    in one decode program (serving/batched_engine.py)."""
+    in one decode program (serving/batched_engine.py).
+
+    ``quantize="int8"`` stores k/v as int8 with a per-vector (over head_dim)
+    scale — half the cache HBM of bf16, so double the slot × context budget
+    for serving; dequantized on read inside the same program."""
     L = cfg.num_layers
     shape = (L, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
+    cache = {
         "len": (jnp.zeros((batch,), jnp.int32) if per_slot
                 else jnp.zeros((), jnp.int32)),
         # rope position of each written slot (slots ≠ positions under
         # left-padded prefill); sentinel = unwritten or pad
         "pos": jnp.full((batch, max_len), POS_SENTINEL, jnp.int32),
     }
+    if quantize == "int8":
+        cache["k"] = jnp.zeros(shape, jnp.int8)
+        cache["v"] = jnp.zeros(shape, jnp.int8)
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    elif quantize:
+        raise ValueError(f"unsupported cache quantization {quantize!r}")
+    else:
+        cache["k"] = jnp.zeros(shape, dtype)
+        cache["v"] = jnp.zeros(shape, dtype)
+    return cache
+
+
+def _kv_quantize(x: jnp.ndarray):
+    """[..., head_dim] → (int8 values, per-vector scale)."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def forward(
@@ -249,7 +275,7 @@ def forward(
     )
 
     def block(x, scanned):
-        lp, ll, ck, cv, layer_idx = scanned
+        lp, ll, ck, cv, cks, cvs, layer_idx = scanned
         lget = (lambda name: ll.get(name)) if ll else (lambda name: None)
         if drop > 0.0:
             lkey = jax.random.fold_in(dropout_rng, layer_idx)
@@ -275,19 +301,30 @@ def forward(
 
         if ck is not None:
             start = cache["len"]
+            if cks is not None:  # int8 cache: quantize new k/v on write
+                k_w, ks_w = _kv_quantize(k)
+                v_w, vs_w = _kv_quantize(v)
+            else:
+                k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
             if start.ndim == 0:
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, start, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, start, 0, 0)
-                )
+                ck = jax.lax.dynamic_update_slice(ck, k_w, (0, start, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v_w, (0, start, 0, 0))
+                if cks is not None:
+                    cks = jax.lax.dynamic_update_slice(cks, ks_w, (0, start, 0))
+                    cvs = jax.lax.dynamic_update_slice(cvs, vs_w, (0, start, 0))
             else:
                 rows = jnp.arange(B, dtype=jnp.int32)[:, None]
                 idx = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-                ck = ck.at[rows, idx].set(k.astype(ck.dtype))
-                cv = cv.at[rows, idx].set(v.astype(cv.dtype))
-            k_att, v_att = ck.astype(q.dtype), cv.astype(q.dtype)
+                ck = ck.at[rows, idx].set(k_w)
+                cv = cv.at[rows, idx].set(v_w)
+                if cks is not None:
+                    cks = cks.at[rows, idx].set(ks_w)
+                    cvs = cvs.at[rows, idx].set(vs_w)
+            if cks is not None:
+                k_att = _kv_dequantize(ck, cks, q.dtype)
+                v_att = _kv_dequantize(cv, cvs, q.dtype)
+            else:
+                k_att, v_att = ck.astype(q.dtype), cv.astype(q.dtype)
         else:
             k_att, v_att = k, v
 
@@ -307,7 +344,7 @@ def forward(
             lora_scale, kget(6), drop, qm, (F, D), qp, lora_adapter_idx,
         )
         x = x + mlp
-        return x, (ck, cv)
+        return x, (ck, cv, cks, cvs)
 
     if cfg.remat == "full":
         block = jax.checkpoint(block)
@@ -316,14 +353,17 @@ def forward(
             block, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
         )
 
+    quant_kv = cache is not None and "k_scale" in cache
     xs = (
         params["layers"],
         lora_layers,
         cache["k"] if cache is not None else None,
         cache["v"] if cache is not None else None,
+        cache["k_scale"] if quant_kv else None,
+        cache["v_scale"] if quant_kv else None,
         jnp.arange(cfg.num_layers, dtype=jnp.int32),
     )
-    x, (new_k, new_v) = jax.lax.scan(block, x, xs)
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(block, x, xs)
 
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
     if cfg.tie_word_embeddings or "lm_head" not in params:
@@ -336,4 +376,7 @@ def forward(
     if cache is not None:
         new_cache = {"k": new_k, "v": new_v, "len": cache["len"] + T,
                      "pos": cache_pos}
+        if quant_kv:
+            new_cache["k_scale"] = new_ks
+            new_cache["v_scale"] = new_vs
     return logits, new_cache

@@ -160,7 +160,10 @@ def build_trainer_args(
     if parameters.get("FP16") is not None:
         args += ["--fp16", str(_truthy(parameters["FP16"])).lower()]
     if parameters.get("meshShape"):
-        args += ["--mesh", str(parameters["meshShape"])]
+        ms = parameters["meshShape"]
+        if isinstance(ms, dict):  # CRD object form {dcn, dp, fsdp, tp, sp}
+            ms = ",".join(f"{k}={v}" for k, v in ms.items())
+        args += ["--mesh", str(ms)]
     if parameters.get("attention"):
         args += ["--attention", str(parameters["attention"])]
     if _truthy(parameters.get("packSequences")):

@@ -139,13 +139,26 @@ class LeaderElector:
     def run(self, stop: Optional[threading.Event] = None):
         """Blocking election loop: waits for leadership, fires
         on_started_leading, renews until leadership is lost (fires
-        on_stopped_leading) or ``stop`` is set."""
+        on_stopped_leading) or ``stop`` is set.
+
+        A leader that cannot RENEW for a full lease duration must abdicate —
+        another replica will rightfully take the expired lease, and holding
+        ``is_leader`` through an apiserver partition means split-brain
+        (client-go's renew-deadline contract)."""
         stop = stop or self._stop
+        last_renew_ok = time.time()
         while not stop.is_set():
             try:
                 leading = self.try_acquire_or_renew()
+                if leading:
+                    last_renew_ok = time.time()
             except ApiError:
-                leading = self.is_leader  # transient apiserver error: hold state
+                # transient apiserver error: hold state only while the lease
+                # we hold could still be valid
+                leading = self.is_leader
+                if (leading
+                        and time.time() - last_renew_ok > self.lease_duration_s):
+                    leading = False
             if leading and not self.is_leader:
                 self.is_leader = True
                 if self.on_started_leading:

@@ -82,6 +82,9 @@ def _restore_placements(store, slice_pool, attempts: int = 5):
             if i == attempts - 1:
                 raise
             _time.sleep(3)
+    # full rebuild, never a merge: a boot-time snapshot in a standby can
+    # record holds released (and re-assigned) by the old leader since
+    slice_pool.reset()
     for ft in finetunes:
         placement = ft.status.get("placement")
         state = ft.status.get("state", "")

@@ -97,6 +97,12 @@ class SlicePool:
                 if holder == job:
                     del self._held[sname]
 
+    def reset(self) -> None:
+        """Drop all assignments (before a full rebuild from CR statuses —
+        merging into a stale snapshot can double-book a slice)."""
+        with self._lock:
+            self._held.clear()
+
     def restore(self, job: str, slice_name: str) -> None:
         """Rebuild an assignment recorded in Finetune.status.placement (used
         at operator startup so restarts don't double-book slices)."""

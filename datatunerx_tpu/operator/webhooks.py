@@ -70,8 +70,11 @@ def validate_hyperparameter(obj: CustomResource):
                  "trainerType must be sft or dpo (rm/ppo reserved)")
         if tt == "dpo":
             # catch the unrunnable combo at admission, not after the JobSet
-            # burned its retries: DPO requires the LoRA policy/reference trick
-            _require(str(p.get("PEFT", "true")).lower() not in ("false", "0"),
+            # burned its retries: DPO requires the LoRA policy/reference
+            # trick. Truthiness MUST mirror generate.py's PEFT test — any
+            # value generate would render as --finetuning_type full is
+            # rejected here.
+            _require(str(p.get("PEFT", "true")).lower() in ("true", "1", ""),
                      "trainerType dpo requires PEFT (LoRA) — the reference "
                      "policy is the adapter-free base model")
 
@@ -83,8 +86,10 @@ def validate_dataset(obj: CustomResource):
     train = subsets[0].get("splits", {}).get("train", {})
     _require(bool(train.get("file")), "subsets[0].splits.train.file is required")
     for f in info.get("features", []) or []:
-        _require(f.get("name") in ("instruction", "response"),
-                 "feature name must be 'instruction' or 'response'")
+        _require(f.get("name") in ("instruction", "response",
+                                   "chosen", "rejected"),
+                 "feature name must be one of instruction/response (SFT) "
+                 "or chosen/rejected (DPO preference datasets)")
         _require(bool(f.get("mapTo")), "feature mapTo is required")
 
 

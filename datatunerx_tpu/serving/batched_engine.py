@@ -101,6 +101,7 @@ class BatchedEngine:
         slots: int = 4,
         decode_chunk: int = 8,
         dtype=jnp.bfloat16,
+        kv_quant: Optional[str] = None,  # "int8" halves cache HBM
     ):
         self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
             model_path, dtype=dtype
@@ -124,8 +125,10 @@ class BatchedEngine:
         if named:
             self._build_adapter_stack(named)
 
+        self.kv_quant = kv_quant or None
         self._cache = init_cache(self.cfg, slots, self.max_seq_len,
-                                 dtype=jnp.bfloat16, per_slot=True)
+                                 dtype=jnp.bfloat16, per_slot=True,
+                                 quantize=self.kv_quant)
         V = self.cfg.vocab_size
         self._logits = jnp.zeros((slots, V), jnp.float32)
         self._pos = jnp.zeros((slots,), jnp.int32)
@@ -207,7 +210,8 @@ class BatchedEngine:
     # --------------------------------------------------------------- jitted
     def _prefill_impl(self, params, tokens, mask, positions, adapter_idx, *,
                       prompt_len: int):
-        cache = init_cache(self.cfg, 1, self.max_seq_len, dtype=jnp.bfloat16)
+        cache = init_cache(self.cfg, 1, self.max_seq_len, dtype=jnp.bfloat16,
+                           quantize=self.kv_quant)
         logits, cache = forward(
             params, tokens, self.cfg, positions=positions,
             attention_mask=mask, cache=cache,
@@ -226,6 +230,11 @@ class BatchedEngine:
             cache["k"], row_cache["k"], (0, slot, 0, 0, 0))
         cache["v"] = jax.lax.dynamic_update_slice(
             cache["v"], row_cache["v"], (0, slot, 0, 0, 0))
+        if "k_scale" in cache:
+            cache["k_scale"] = jax.lax.dynamic_update_slice(
+                cache["k_scale"], row_cache["k_scale"], (0, slot, 0, 0))
+            cache["v_scale"] = jax.lax.dynamic_update_slice(
+                cache["v_scale"], row_cache["v_scale"], (0, slot, 0, 0))
         cache["pos"] = jax.lax.dynamic_update_slice(
             cache["pos"], row_cache["pos"], (slot, 0))
         cache["len"] = cache["len"].at[slot].set(plen)

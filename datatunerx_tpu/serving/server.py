@@ -189,7 +189,7 @@ class Handler(BaseHTTPRequestHandler):
 
 def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
-                      adapters=None):
+                      adapters=None, kv_quant=None):
     def _load():
         try:
             STATE.model_path = model_path
@@ -200,6 +200,13 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     "--adapters requires the batched engine "
                     "(--slots > 1, no --quantization)"
                 )
+            if kv_quant and (slots <= 1 or quantization):
+                # refusing beats silently running a full-size cache the
+                # operator budgeted HBM against
+                raise ValueError(
+                    "--kv_quant requires the batched engine "
+                    "(--slots > 1, no --quantization)"
+                )
             if slots > 1 and not quantization:
                 from datatunerx_tpu.serving.batched_engine import BatchedEngine
 
@@ -207,6 +214,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     model_path, checkpoint_path or None, adapters=adapters,
                     template=template, max_seq_len=max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
+                    kv_quant=kv_quant or None,
                 )
             else:
                 # single-slot path also carries serve-time quantization
@@ -255,12 +263,16 @@ def main(argv=None):
     p.add_argument("--adapters", default="",
                    help="named LoRA adapters: name=ckpt[,name=ckpt…]; "
                         "requests select one via the 'model' field")
+    p.add_argument("--kv_quant", default="", choices=["", "int8"],
+                   help="int8-quantized KV cache: half the cache HBM, double "
+                        "the slots×context budget (batched engine only)")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
                       args.max_seq_len, quantization=args.quantization,
                       slots=args.slots, decode_chunk=args.decode_chunk,
-                      adapters=parse_adapters(args.adapters))
+                      adapters=parse_adapters(args.adapters),
+                      kv_quant=args.kv_quant)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

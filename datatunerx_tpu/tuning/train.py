@@ -113,7 +113,8 @@ def run(args: TrainArgs) -> dict:
 
     # ----- mesh --------------------------------------------------------
     n_dev = len(jax.devices())
-    dims = args.mesh_dims or {}
+    dims = dict(args.mesh_dims or {})
+    dcn_dp = int(dims.pop("dcn", 1) or 1)  # multi-slice: dp's major dim on DCN
     shape = mesh_shape_for(
         n_dev,
         dp=dims.get("dp"),
@@ -121,7 +122,7 @@ def run(args: TrainArgs) -> dict:
         tp=dims.get("tp", 1),
         sp=dims.get("sp", 1),
     )
-    mesh = make_mesh(shape)
+    mesh = make_mesh(shape, dcn_dp=dcn_dp)
     data_par = shape[0] * shape[1]
 
     global_batch = args.per_device_train_batch_size * data_par * args.gradient_accumulation_steps

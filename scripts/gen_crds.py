@@ -55,7 +55,7 @@ HYPERPARAMETERS = obj({
     "trainerType": STR, "PEFT": STR, "FP16": STR,
     # TPU additions (SURVEY.md §7.1 Hyperparameter row)
     "topology": STR,
-    "meshShape": obj({"dp": INT, "fsdp": INT, "tp": INT, "sp": INT}),
+    "meshShape": obj({"dcn": INT, "dp": INT, "fsdp": INT, "tp": INT, "sp": INT}),
     "packSequences": STR,
 })
 

@@ -118,3 +118,27 @@ def test_two_elector_failover_end_to_end():
     finally:
         b.stop()
         srv.stop()
+
+
+def test_leader_abdicates_when_apiserver_unreachable():
+    """Renew failures past the lease duration must fire on_stopped_leading —
+    holding leadership through a partition is split-brain."""
+    srv, client = _cluster()
+    events = []
+    a = LeaderElector(
+        client, identity="a", lease_duration_s=0.3, renew_period_s=0.05,
+        on_started_leading=lambda: events.append("started"),
+        on_stopped_leading=lambda: events.append("stopped"),
+    )
+    a.start()
+    deadline = time.time() + 5
+    while "started" not in events and time.time() < deadline:
+        time.sleep(0.02)
+    assert a.is_leader
+    srv.stop()  # apiserver partition: every renew now errors
+    deadline = time.time() + 5
+    while "stopped" not in events and time.time() < deadline:
+        time.sleep(0.02)
+    a.stop()
+    assert events == ["started", "stopped"]
+    assert not a.is_leader

@@ -1,0 +1,86 @@
+"""Multi-slice (DCN) meshes: dp's major dimension crosses slices; the full
+training step compiles and matches single-slice results on the virtual CPU
+mesh (ROADMAP §4; SURVEY §5.8 marks DCN as the multi-slice extension)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models import get_config, init_params
+from datatunerx_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from datatunerx_tpu.training import TrainConfig, Trainer
+
+
+def test_hybrid_mesh_device_order_groups_slices():
+    devices = jax.devices()
+    assert len(devices) >= 8
+    mesh = make_mesh((4, 2, 1, 1), devices=devices[:8], dcn_dp=2)
+    assert mesh.shape == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1}
+    arr = mesh.devices  # [dp, fsdp, tp, sp]
+    # dp-major crosses "slices": first half of dp rows = first device chunk
+    first_slice = {d.id for d in np.asarray(arr)[:2].flatten()}
+    second_slice = {d.id for d in np.asarray(arr)[2:].flatten()}
+    assert first_slice == {d.id for d in devices[:4]}
+    assert second_slice == {d.id for d in devices[4:8]}
+
+
+def test_dcn_dp_must_divide_dp():
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh((3, 2, 1, 1), devices=jax.devices()[:6], dcn_dp=2)
+
+
+def test_train_step_matches_single_slice():
+    """Same data, same init: the 2-'slice' hybrid mesh must produce the same
+    loss as the flat mesh (the hierarchy changes collective ROUTING, not
+    math)."""
+    cfg = get_config("debug", num_heads=4, num_kv_heads=2, hidden_size=64,
+                     intermediate_size=128)
+    shape = mesh_shape_for(8, fsdp=2, tp=1, sp=1)  # dp=4, fsdp=2
+
+    def run(dcn_dp):
+        mesh = make_mesh(shape, dcn_dp=dcn_dp)
+        tr = Trainer(cfg, TrainConfig(
+            finetuning_type="full", learning_rate=1e-3, total_steps=4,
+            compute_dtype=None), mesh=mesh)
+        state = tr.init_state(init_params(cfg, jax.random.PRNGKey(0)),
+                              jax.random.PRNGKey(1))
+        toks = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0,
+                                  cfg.vocab_size, jnp.int32)
+        batch = {"input_ids": toks, "labels": toks}
+        losses = []
+        for _ in range(2):
+            state, m = tr.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    flat = run(dcn_dp=1)
+    hybrid = run(dcn_dp=2)
+    np.testing.assert_allclose(hybrid, flat, rtol=1e-5, atol=1e-6)
+
+
+def test_cli_mesh_accepts_dcn(tmp_path):
+    """--mesh dcn=2,fsdp=2 runs end-to-end through the trainer CLI."""
+    import json
+
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    data = tmp_path / "t.csv"
+    with open(data, "w") as f:
+        f.write("instruction,response\n")
+        for i in range(40):
+            f.write(f"q {i},a {i}\n")
+    args = parse_train_args([
+        "--model_name_or_path", "preset:debug",
+        "--train_path", str(data), "--output_dir", str(tmp_path / "out"),
+        "--storage_path", str(tmp_path / "s"), "--uid", "dcn-run",
+        "--template", "vanilla", "--max_steps", "2", "--bf16", "false",
+        "--remat", "none", "--per_device_train_batch_size", "4",
+        "--block_size", "64", "--mesh", "dcn=2,fsdp=2",
+    ])
+    r = run(args)
+    assert r["steps"] == 2
+    mf = json.load(open(tmp_path / "s" / "dcn-run" / "manifest.json"))
+    assert mf["mesh"] == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1}

@@ -145,3 +145,32 @@ def test_manifest_render_uses_placement_selector(tmp_path):
            ["template"]["spec"])
     assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
     assert pod["nodeSelector"]["cloud.google.com/gke-nodepool"] == "pool-9"
+
+
+def test_takeover_restore_rebuilds_not_merges():
+    """A standby's stale boot snapshot must be DROPPED at takeover: jobs
+    finished/re-placed by the old leader otherwise double-book slices."""
+    from datatunerx_tpu.operator.manager import _restore_placements
+
+    store = ObjectStore()
+    _seed_deps(store)
+    # standby's boot snapshot: job A held slice-0
+    pool = _pool(2)
+    pool.acquire("A")  # slice-0 (smallest-fit order is by chips, equal here)
+    held_by_a = pool.assignment("A").name
+    # meanwhile the old leader: A finished, B got that slice
+    b = _finetune("B")
+    b.status = {"state": Finetune.STATE_RUNNING,
+                "placement": {"name": held_by_a}}
+    store.create(b)
+    a = _finetune("A")
+    a.status = {"state": Finetune.STATE_SUCCESSFUL,
+                "placement": {"name": held_by_a}}
+    store.create(a)
+
+    _restore_placements(store, pool)  # takeover rebuild
+    assert pool.assignment("B").name == held_by_a
+    assert pool.assignment("A") is None
+    # terminal A's release must NOT free B's slice
+    pool.release("A")
+    assert pool.assignment("B").name == held_by_a
