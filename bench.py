@@ -46,6 +46,7 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         model, B, T, steps = "tinyllama-1.1b", 8, 1024, 20
+        B = int(os.environ.get("DTX_BENCH_BATCH", B))
     else:  # CPU smoke fallback so bench never hard-fails
         model, B, T, steps = "debug", 8, 128, 5
 
@@ -93,6 +94,7 @@ def main():
     )
     tag = (f",{attention}" if attention != "xla" else "") + (
         f",remat={remat}" if remat != "dots" else "")
+    tag += f",B{B}" if B != 8 else ""
     print(
         json.dumps(
             {

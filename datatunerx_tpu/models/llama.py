@@ -185,8 +185,10 @@ def forward(
     lora_dropout: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     neftune_alpha: float = 0.0,
+    return_hidden: bool = False,
 ):
-    """Returns (logits [B, T, V] float32, new_cache | None)."""
+    """Returns (logits [B, T, V] float32, new_cache | None); with
+    ``return_hidden`` also the final-norm hidden states [B, T, D]."""
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
@@ -379,4 +381,7 @@ def forward(
         if quant_kv:
             new_cache["k_scale"] = new_ks
             new_cache["v_scale"] = new_vs
+    if return_hidden:
+        # final-norm hidden states, for value heads (reward modelling)
+        return logits, new_cache, x
     return logits, new_cache

@@ -137,9 +137,10 @@ def build_trainer_args(
         args += ["--quantization", "int4"]
 
     # trainerType selects the training stage (Hyperparameter CR field the
-    # reference carries but never consumes): sft (default) | dpo
-    if str(parameters.get("trainerType", "")).lower() == "dpo":
-        args += ["--stage", "dpo"]
+    # reference carries but never consumes): sft (default) | dpo | rm
+    tt = str(parameters.get("trainerType", "")).lower()
+    if tt in ("dpo", "rm"):
+        args += ["--stage", tt]
 
     peft = str(parameters.get("PEFT", "true")).lower() in ("true", "1", "")
     args += ["--finetuning_type", "lora" if peft else "full"]

@@ -14,6 +14,7 @@ mount paths) and explicit base-url/token for tests against a fake apiserver.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import ssl
@@ -90,6 +91,12 @@ class KubeClient:
             raise ApiError(e.code, e.reason, e.read().decode(errors="replace"))
         except urllib.error.URLError as e:
             raise ApiError(0, str(e.reason), "")
+        except (OSError, http.client.HTTPException) as e:
+            # raw socket / HTTP-protocol failures (ConnectionResetError,
+            # RemoteDisconnected, …) are not URLError subclasses; callers —
+            # the leader elector above all — rely on every transport failure
+            # surfacing as ApiError, never a leaked socket exception
+            raise ApiError(0, repr(e), "")
         return json.loads(raw) if raw else {}
 
     # ---------------------------------------------------------- path utils

@@ -141,23 +141,28 @@ class LeaderElector:
         on_started_leading, renews until leadership is lost (fires
         on_stopped_leading) or ``stop`` is set.
 
-        A leader that cannot RENEW for a full lease duration must abdicate —
-        another replica will rightfully take the expired lease, and holding
-        ``is_leader`` through an apiserver partition means split-brain
-        (client-go's renew-deadline contract)."""
+        A leader that cannot RENEW past its renew deadline must abdicate —
+        another replica will rightfully take the lease once it expires, and
+        because that expiry clock started at the apiserver-side write of the
+        LAST successful renew, waiting the full lease duration locally leaves
+        a split-brain window of up to one renew period. client-go's contract
+        is renewDeadline < leaseDuration; mirrored here as
+        lease_duration − renew_period."""
         stop = stop or self._stop
         last_renew_ok = time.time()
+        renew_deadline_s = max(self.lease_duration_s - self.renew_period_s,
+                               self.renew_period_s)
         while not stop.is_set():
             try:
                 leading = self.try_acquire_or_renew()
                 if leading:
                     last_renew_ok = time.time()
             except ApiError:
-                # transient apiserver error: hold state only while the lease
-                # we hold could still be valid
+                # transient apiserver error: hold state only while no standby
+                # could yet have observed our lease as expired
                 leading = self.is_leader
                 if (leading
-                        and time.time() - last_renew_ok > self.lease_duration_s):
+                        and time.time() - last_renew_ok > renew_deadline_s):
                     leading = False
             if leading and not self.is_leader:
                 self.is_leader = True

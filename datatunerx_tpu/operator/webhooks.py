@@ -66,17 +66,18 @@ def validate_hyperparameter(obj: CustomResource):
                      f"invalid lora target {t.strip()!r}")
     if p.get("trainerType"):
         tt = str(p["trainerType"]).lower()
-        _require(tt in ("sft", "dpo"),
-                 "trainerType must be sft or dpo (rm/ppo reserved)")
-        if tt == "dpo":
+        _require(tt in ("sft", "dpo", "rm"),
+                 "trainerType must be sft, dpo, or rm (ppo reserved)")
+        if tt in ("dpo", "rm"):
             # catch the unrunnable combo at admission, not after the JobSet
-            # burned its retries: DPO requires the LoRA policy/reference
-            # trick. Truthiness MUST mirror generate.py's PEFT test — any
-            # value generate would render as --finetuning_type full is
-            # rejected here.
+            # burned its retries: DPO needs the LoRA policy/reference trick,
+            # RM keeps the reward model a frozen-base adapter + value head.
+            # Truthiness MUST mirror generate.py's PEFT test — any value
+            # generate would render as --finetuning_type full is rejected
+            # here.
             _require(str(p.get("PEFT", "true")).lower() in ("true", "1", ""),
-                     "trainerType dpo requires PEFT (LoRA) — the reference "
-                     "policy is the adapter-free base model")
+                     f"trainerType {tt} requires PEFT (LoRA) — the frozen "
+                     "base serves as DPO reference policy / RM backbone")
 
 
 def validate_dataset(obj: CustomResource):

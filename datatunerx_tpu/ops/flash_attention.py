@@ -25,6 +25,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _LANES = 128  # stats tiles padded to the TPU lane width
+_SUBLANES = 8  # segment-id tiles padded to the TPU sublane width
+
+# Mosaic requires the last two block dims to be (8k, 128k) or match the array,
+# so [B, T] segment ids can't block as (1, block_q). Broadcast them instead:
+# q ids ride the lane dim ([B, T, 128]), kv ids the sublane dim ([B, 8, S]) —
+# inside the kernel a (bq, 1) column of the former against a (1, bk) row of
+# the latter recovers the [bq, bk] pairwise mask.
+
+
+def _seg3d(q_seg: jnp.ndarray, kv_seg: jnp.ndarray):
+    B, T = q_seg.shape
+    S = kv_seg.shape[1]
+    q3 = jnp.broadcast_to(q_seg[:, :, None], (B, T, _LANES))
+    kv3 = jnp.broadcast_to(kv_seg[:, None, :], (B, _SUBLANES, S))
+    return q3, kv3
 
 
 def _interpret() -> bool:
@@ -58,7 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = k_pos <= q_pos
         # packed-segment isolation (all-equal ids = plain causal)
-        mask &= qseg_ref[0][:, None] == kseg_ref[0][None, :]
+        mask &= qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :]
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, 0:1]
@@ -100,6 +115,7 @@ def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
     )
     kv_idx = _kv_index(H, G)
+    q_seg3, kv_seg3 = _seg3d(q_seg, kv_seg)
     out, lse = pl.pallas_call(
         kernel,
         grid=(BH, T // block_q, S // block_k),
@@ -107,8 +123,8 @@ def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_idx),
             pl.BlockSpec((1, block_k, d), kv_idx),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // H, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H, j)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b // H, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda b, i, j: (b // H, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -124,7 +140,7 @@ def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, q_seg, kv_seg)
+    )(q, k, v, q_seg3, kv_seg3)
     return out, lse[:, :, 0]
 
 
@@ -150,7 +166,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (k_pos <= q_pos) & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
+        mask = (k_pos <= q_pos) & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)
 
         do = do_ref[0].astype(jnp.float32)
@@ -190,7 +206,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (k_pos <= q_pos) & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
+        mask = (k_pos <= q_pos) & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)  # [bq, bk]
 
         do = do_ref[0].astype(jnp.float32)  # [bq, d]
@@ -230,6 +246,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
     dsum = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse_b = jnp.broadcast_to(lse[:, :, None], (BH, T, _LANES))
     dsum_b = jnp.broadcast_to(dsum[:, :, None], (BH, T, _LANES))
+    q_seg3, kv_seg3 = _seg3d(q_seg, kv_seg)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -242,14 +259,14 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // H_, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H_, j)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b // H_, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda b, i, j: (b // H_, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_b, dsum_b, q_seg, kv_seg)
+    )(q, k, v, do, lse_b, dsum_b, q_seg3, kv_seg3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -262,8 +279,8 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b // H_, i)),
-            pl.BlockSpec((1, block_k), lambda b, j, i: (b // H_, j)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b // H_, i, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda b, j, i: (b // H_, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -278,7 +295,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_b, dsum_b, q_seg, kv_seg)
+    )(q, k, v, do, lse_b, dsum_b, q_seg3, kv_seg3)
     if G > 1:
         dk = dk.reshape(BKV, G, S, d).sum(axis=1)
         dv = dv.reshape(BKV, G, S, d).sum(axis=1)

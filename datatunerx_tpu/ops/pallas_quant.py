@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from datatunerx_tpu.ops.quant import NF4_CODE
 
@@ -72,38 +73,80 @@ def pallas_matmul_int8(
 
 # ------------------------------------------------------------------ nf4
 
-def _nf4_kernel(x_ref, packed_ref, scales_ref, code_ref, o_ref, *, block_size: int):
-    # packed_ref: [bn, K // block, block // 2] uint8 (channel-major blocks)
-    # scales_ref: [bn, K // block] f32; code_ref: [1, 16] nf4 codebook
-    packed = packed_ref[:]
+def _nf4_kernel(x_ref, packed_ref, scales_ref, o_ref, w_vmem, acc_ref,
+                *, block_size: int, nk: int):
+    # One K-chunk of ck = nb·block weights per grid step (chunk-major inputs:
+    # x_ref [1, bm, ck], packed_ref [1, bn, nb, block/2] planar nibbles,
+    # scales_ref [1, bn, nb]).
+    #
+    # Mosaic has no >2D gather and no sublane→lane shape casts, so the unpack
+    # never materializes [bn, nb, block]: each block is dequantized in 2D
+    # ([bn, block/2] per nibble plane, 16-term select-sum codebook) and stored
+    # into its static 64-lane slice of a [bn, ck] VMEM scratch; the MXU then
+    # runs one full-depth dot per chunk, accumulating across the K grid dim.
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    packed = packed_ref[0]
     bn, nb, half = packed.shape
-    lo = (packed & 0x0F).astype(jnp.int32)
-    hi = (packed >> 4).astype(jnp.int32)
-    idx = jnp.stack([lo, hi], axis=-1).reshape(bn, nb, block_size)
-    code = code_ref[0]
-    w = code[idx] * scales_ref[:][..., None]  # [bn, nb, block]
-    w = w.reshape(bn, nb * block_size)  # [bn, K]
-    acc = jax.lax.dot_general(
-        x_ref[:], w.astype(x_ref.dtype),
+    code = np.asarray(NF4_CODE, np.float32)
+    for b in range(nb):
+        # widen before the shift: Mosaic can't legalize shrui on i8 vectors
+        pb = packed[:, b, :].astype(jnp.int32)            # [bn, block/2]
+        lo = pb & 0x0F
+        hi = (pb >> 4) & 0x0F
+        idx = jnp.concatenate([lo, hi], axis=-1)          # [bn, block] planar
+        w = jnp.zeros(idx.shape, jnp.float32)
+        for c, val in enumerate(code):
+            w = jnp.where(idx == c, jnp.float32(val), w)
+        w_vmem[:, b * block_size:(b + 1) * block_size] = (
+            w * scales_ref[0][:, b:b + 1])
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[0], w_vmem[:].astype(x_ref.dtype),
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[:] = acc.astype(o_ref.dtype)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _pick_chunk(nb_total: int, block_size: int, cap_nb: int = 16) -> int:
+    """Largest divisor of nb_total ≤ cap_nb (chunk = that many nf4 blocks).
+
+    Any divisor is Mosaic-legal: the chunk axis is hoisted to a leading array
+    dim on the host, so every BlockSpec's last-two dims EQUAL their array
+    dims regardless of nb (no 8/128-multiple requirement to satisfy)."""
+    best = 1
+    for d in range(1, cap_nb + 1):
+        if nb_total % d == 0:
+            best = d
+    return best * block_size
 
 
 def pallas_matmul_nf4(
     x: jnp.ndarray, qw: Dict[str, jnp.ndarray], shape: Tuple[int, int],
     block_m: int = 256, block_n: int = 256, block_size: int = 64,
 ) -> jnp.ndarray:
-    """x: [..., K] @ nf4-packed weights (ops/quant.py layout) → [..., N]."""
+    """x: [..., K] @ nf4-packed weights (ops/quant.py layout) → [..., N].
+
+    Inputs are rearranged chunk-major on the host ([nk, …, ck-sized tail]) so
+    the K-grid BlockSpecs index a leading dim and keep lane/sublane block
+    dims equal to the array dims — the only tiling that is legal for EVERY
+    real-model K (5632, 11008, … are not 128·64-multiples)."""
     K, N = shape
     *lead, K2 = x.shape
     assert K2 == K, (K2, K)
     nb_per_channel = K // block_size
-    packed = qw["packed"].reshape(N, nb_per_channel, block_size // 2)
-    scales = (qw["scale_q"].astype(jnp.float32) * qw["meta"][0]).reshape(
-        N, nb_per_channel
-    )
+    ck = _pick_chunk(nb_per_channel, block_size)
+    nb_chunk = ck // block_size
+    nk = K // ck
+    half = block_size // 2
 
     x2d = x.reshape(-1, K)
     x2d, m_real = _pad_rows(x2d, block_m)
@@ -111,18 +154,28 @@ def pallas_matmul_nf4(
     bn = min(block_n, N)
     assert N % bn == 0, (N, bn)
 
+    xk = x2d.reshape(M, nk, ck).transpose(1, 0, 2)  # [nk, M, ck]
+    packedk = qw["packed"].reshape(N, nk, nb_chunk, half).transpose(1, 0, 2, 3)
+    scales = (qw["scale_q"].astype(jnp.float32) * qw["meta"][0]).reshape(
+        N, nk, nb_chunk
+    )
+    scalesk = scales.transpose(1, 0, 2)  # [nk, N, nb_chunk]
+
     out = pl.pallas_call(
-        functools.partial(_nf4_kernel, block_size=block_size),
-        grid=(M // block_m, N // bn),
+        functools.partial(_nf4_kernel, block_size=block_size, nk=nk),
+        grid=(M // block_m, N // bn, nk),
         in_specs=[
-            pl.BlockSpec((block_m, K), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, nb_per_channel, block_size // 2),
-                         lambda i, j: (j, 0, 0)),
-            pl.BlockSpec((bn, nb_per_channel), lambda i, j: (j, 0)),
-            pl.BlockSpec((1, 16), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, block_m, ck), lambda i, j, kk: (kk, i, 0)),
+            pl.BlockSpec((1, bn, nb_chunk, half),
+                         lambda i, j, kk: (kk, j, 0, 0)),
+            pl.BlockSpec((1, bn, nb_chunk), lambda i, j, kk: (kk, j, 0)),
         ],
-        out_specs=pl.BlockSpec((block_m, bn), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bn, ck), jnp.float32),
+            pltpu.VMEM((block_m, bn), jnp.float32),
+        ],
         interpret=_interpret(),
-    )(x2d, packed, scales, jnp.asarray(NF4_CODE).reshape(1, 16))
+    )(xk, packedk, scalesk)
     return out[:m_real].reshape(*lead, N)

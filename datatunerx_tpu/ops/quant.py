@@ -16,7 +16,8 @@ Formats:
   NormalFloat4 codebook, two nibbles per uint8, channel-contiguous blocks.
   Double quantization: block scales stored int8 against a per-tensor meta scale
   (reference `double_quantization` default True, parser.py:48-51).
-  {"packed": uint8[n_blocks, block/2], "scale_q": int8[n_blocks], "meta": f32[1]}
+  {"packed": uint8[n_blocks, block/2], "scale_q": int8[n_blocks],
+   "meta": f32[2] = [per-tensor scale, nibble-layout version]}
 """
 
 from __future__ import annotations
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 NF4_BLOCK = 64
+NF4_LAYOUT_VERSION = 2  # 2 = planar nibble halves (Mosaic-lowerable unpack)
 
 # QLoRA NF4 codebook (16 quantiles of N(0,1), normalized to [-1, 1]).
 NF4_CODE = np.array(
@@ -86,12 +88,21 @@ def quantize_nf4(w: jnp.ndarray, block_size: int = NF4_BLOCK) -> Dict[str, jnp.n
     code = jnp.asarray(NF4_CODE)
     idx = jnp.argmin(jnp.abs(normed[..., None] - code[None, None, :]), axis=-1)
     idx = idx.astype(jnp.uint8)
-    lo, hi = idx[:, 0::2], idx[:, 1::2]
+    # planar nibble layout: lo nibbles hold the block's first half, hi the
+    # second — dequant is then a minor-dim concat instead of an interleave,
+    # which Mosaic can lower (vector shape-cast on the lane dim can't)
+    half = block_size // 2
+    lo, hi = idx[:, :half], idx[:, half:]
     packed = (lo | (hi << 4)).astype(jnp.uint8)
 
     meta = jnp.maximum(jnp.max(absmax) / 127.0, 1e-12)
     scale_q = jnp.clip(jnp.round(absmax / meta), 1, 127).astype(jnp.int8)
-    return {"packed": packed, "scale_q": scale_q, "meta": meta.reshape(1)}
+    # meta[1] is the nibble-layout version (2 = planar halves; 1, the round-1
+    # interleaved layout, shipped as shape-(1,) meta). The SHAPE change is the
+    # actual guard: a checkpoint quantized under the old layout fails Orbax
+    # restore loudly instead of silently dequantizing permuted weights.
+    meta = jnp.stack([meta, jnp.asarray(NF4_LAYOUT_VERSION, jnp.float32)])
+    return {"packed": packed, "scale_q": scale_q, "meta": meta}
 
 
 def nf4_scales(qw: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -105,7 +116,7 @@ def dequant_nf4(
     packed = qw["packed"]
     lo = (packed & 0x0F).astype(jnp.int32)
     hi = (packed >> 4).astype(jnp.int32)
-    idx = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+    idx = jnp.concatenate([lo, hi], axis=-1)
     vals = jnp.asarray(NF4_CODE)[idx] * nf4_scales(qw)[:, None]
     return vals.reshape(out_dim, in_dim).T.astype(dtype)
 
@@ -132,7 +143,7 @@ def quantize_model_params(params, mode: str):
     """Quantize the stacked [L, in, out] transformer kernels in-tree.
     Embeddings, norms, and lm_head stay full-precision (bnb's skip list).
     Array-only leaves: int8 → q [L,in,out] + scale [L,out];
-    nf4 → packed [L,nb,b/2] + scale_q [L,nb] + meta [L,1]."""
+    nf4 → packed [L,nb,b/2] + scale_q [L,nb] + meta [L,2]."""
     if mode not in ("int8", "int4", "nf4"):
         raise ValueError(f"unknown quantization mode {mode!r}")
     layers = dict(params["layers"])

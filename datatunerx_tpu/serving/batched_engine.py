@@ -38,6 +38,52 @@ from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
 MAX_STOP = 8  # static per-slot stop-token capacity
 
 
+class _PrefixCache:
+    """Host-side LRU of prefilled single-row KV caches keyed by
+    (prompt tokens, adapter). An exact hit skips prefill entirely; the longest
+    strict-prefix hit turns prefill into a (shorter) suffix extension — the
+    prefix-reuse tier of paged serving stacks (vLLM/JetStream), host-managed
+    here because rows are full-width and slots are few.
+
+    Entries: {"cache": row_cache, "logits": last-token logits,
+    "cursor": cache write depth}. Stored row caches are immutable JAX
+    arrays — inserting a row into a slot copies, and extension builds a new
+    functional cache, so shared prefixes are safe.
+    """
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+
+        self.capacity = capacity
+        self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    def get(self, key):
+        ent = self._d.get(key)
+        if ent is not None:
+            self._d.move_to_end(key)
+        return ent
+
+    def longest_prefix(self, tokens: tuple, adapter: int):
+        """Longest stored strict prefix of ``tokens`` for this adapter."""
+        best_key, best = None, None
+        for (ptoks, pad), ent in self._d.items():
+            if pad != adapter or len(ptoks) >= len(tokens):
+                continue
+            if tokens[: len(ptoks)] == ptoks and (
+                best_key is None or len(ptoks) > len(best_key[0])
+            ):
+                best_key, best = (ptoks, pad), ent
+        if best_key is not None:
+            self._d.move_to_end(best_key)
+        return best_key, best
+
+    def put(self, key, ent):
+        self._d[key] = ent
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+
 class Request:
     def __init__(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
@@ -102,6 +148,7 @@ class BatchedEngine:
         decode_chunk: int = 8,
         dtype=jnp.bfloat16,
         kv_quant: Optional[str] = None,  # "int8" halves cache HBM
+        prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
     ):
         self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
             model_path, dtype=dtype
@@ -147,8 +194,14 @@ class BatchedEngine:
 
         self._prefill = jax.jit(self._prefill_impl,
                                 static_argnames=("prompt_len",))
+        self._extend = jax.jit(self._extend_impl,
+                               static_argnames=("suffix_len",))
         self._insert = jax.jit(self._insert_impl)
         self._decode = jax.jit(self._decode_impl, static_argnames=("K",))
+
+        self._prefix = _PrefixCache(prefix_cache) if prefix_cache > 0 else None
+        # observability: how admissions were served (tests + /metrics)
+        self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
 
         self._thread = threading.Thread(target=self._scheduler, daemon=True)
         self._thread.start()
@@ -221,6 +274,20 @@ class BatchedEngine:
         )
         return logits[0, prompt_len - 1], cache
 
+    def _extend_impl(self, params, row_cache, tokens, mask, positions,
+                     adapter_idx, *, suffix_len: int):
+        """Append a (left-pad-bucketed) prompt suffix onto a cached prefix
+        row: pads get sentinel rope positions so only the real tokens exist
+        for attention, exactly as in full prefill."""
+        logits, cache = forward(
+            params, tokens, self.cfg, positions=positions,
+            attention_mask=mask, cache=row_cache,
+            lora_adapter_idx=(adapter_idx[None]
+                              if self.lora_stack is not None else None),
+            compute_dtype=jnp.bfloat16, **self._lora_args(),
+        )
+        return logits[0, suffix_len - 1], cache
+
     def _insert_impl(self, cache, logits_all, pos, remaining, active, temps,
                      top_ps, stops, adapter_idx, rng,
                      slot, row_cache, row_logits, plen, n_prompt, max_new,
@@ -287,6 +354,65 @@ class BatchedEngine:
         return emitted, logits, cache, pos, remaining, active, rng
 
     # ------------------------------------------------------------ scheduler
+    def _prefill_row(self, ids, mask, positions, plen, n_prompt, adapter,
+                     budget_needed: int = 1):
+        """Produce (last-token logits, row cache, cache cursor) for a prompt,
+        going through the prefix cache when enabled: exact hit = no compute,
+        prefix hit = suffix-only extension, miss = full prefill (+ store).
+
+        Reuse must never change the response: a cached row whose cursor sits
+        deeper than this request's own plen (extension padding accumulates)
+        is only used when it still leaves ``budget_needed`` decode room —
+        otherwise the cold path runs, so budget and output match a cache-cold
+        server exactly."""
+        from datatunerx_tpu.utils.decoding import DECODE_BUCKET
+
+        used = tuple(ids[plen - n_prompt:])
+        key = (used, adapter)
+        # the decode room the cold path would provide; reuse may not shrink
+        # the effective budget below min(requested, cold)
+        cold_budget = self.max_seq_len - plen
+        need = min(budget_needed, cold_budget)
+        if self._prefix is not None:
+            ent = self._prefix.get(key)
+            if ent is not None and self.max_seq_len - ent["cursor"] >= need:
+                self.prefill_stats["reuse"] += 1
+                return ent["logits"], ent["cache"], ent["cursor"]
+            pkey, pent = self._prefix.longest_prefix(used, adapter)
+            if pent is not None:
+                n_pref = len(pkey[0])
+                suffix = list(used[n_pref:])
+                pad = (-len(suffix)) % DECODE_BUCKET
+                stoks = [self.tokenizer.eos_token_id or 0] * pad + suffix
+                smask = [0] * pad + [1] * len(suffix)
+                spos = [0] * pad + list(range(n_pref, len(used)))
+                cursor = pent["cursor"] + len(stoks)
+                if self.max_seq_len - cursor >= need:
+                    row_logits, row_cache = self._extend(
+                        self.params, pent["cache"],
+                        jnp.asarray([stoks], jnp.int32),
+                        jnp.asarray([smask], jnp.int32),
+                        jnp.asarray([spos], jnp.int32),
+                        jnp.asarray(adapter, jnp.int32),
+                        suffix_len=len(stoks),
+                    )
+                    self.prefill_stats["extend"] += 1
+                    self._prefix.put(key, {"cache": row_cache,
+                                           "logits": row_logits,
+                                           "cursor": cursor})
+                    return row_logits, row_cache, cursor
+
+        row_logits, row_cache = self._prefill(
+            self.params, jnp.asarray([ids], jnp.int32),
+            jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
+            jnp.asarray(adapter, jnp.int32), prompt_len=plen,
+        )
+        self.prefill_stats["full"] += 1
+        if self._prefix is not None:
+            self._prefix.put(key, {"cache": row_cache, "logits": row_logits,
+                                   "cursor": plen})
+        return row_logits, row_cache, plen
+
     def _admit(self, req: Request, slot: int):
         from datatunerx_tpu.utils.decoding import prepare_prompt
 
@@ -294,12 +420,10 @@ class BatchedEngine:
             req.prompt_ids, self.tokenizer.eos_token_id,
             self.max_seq_len, req.max_new_tokens,
         )
-        max_new = min(max_new, self.max_seq_len - plen)
-        row_logits, row_cache = self._prefill(
-            self.params, jnp.asarray([ids], jnp.int32),
-            jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
-            jnp.asarray(req.adapter, jnp.int32), prompt_len=plen,
-        )
+        row_logits, row_cache, cursor = self._prefill_row(
+            ids, mask, positions, plen, n_prompt, req.adapter,
+            budget_needed=max_new)
+        max_new = max(1, min(max_new, self.max_seq_len - cursor))
         stop_row = np.full((MAX_STOP,), -1, np.int32)
         stop_row[: len(req.stop_ids)] = req.stop_ids
         (self._cache, self._logits, self._pos, self._remaining, self._active,
@@ -308,7 +432,9 @@ class BatchedEngine:
             self._cache, self._logits, self._pos, self._remaining, self._active,
             self._temps, self._top_ps, self._stops, self._adapter_idx, self._rng,
             jnp.asarray(slot, jnp.int32), row_cache, row_logits,
-            jnp.asarray(plen, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
+            # the slot's write cursor continues from the row's real KV depth
+            # (prefix reuse can sit deeper than this request's own plen)
+            jnp.asarray(cursor, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
             jnp.asarray(max_new, jnp.int32),
             jnp.asarray(req.temperature, jnp.float32),
             jnp.asarray(req.top_p, jnp.float32),

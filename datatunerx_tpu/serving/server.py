@@ -189,32 +189,30 @@ class Handler(BaseHTTPRequestHandler):
 
 def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
-                      adapters=None, kv_quant=None):
+                      adapters=None, kv_quant=None, prefix_cache=0):
     def _load():
         try:
             STATE.model_path = model_path
-            if adapters and (slots <= 1 or quantization):
-                # refusing beats silently serving the base model under a
-                # tenant's adapter name
-                raise ValueError(
-                    "--adapters requires the batched engine "
-                    "(--slots > 1, no --quantization)"
-                )
-            if kv_quant and (slots <= 1 or quantization):
-                # refusing beats silently running a full-size cache the
-                # operator budgeted HBM against
-                raise ValueError(
-                    "--kv_quant requires the batched engine "
-                    "(--slots > 1, no --quantization)"
-                )
-            if slots > 1 and not quantization:
+            batched = slots > 1 and not quantization
+            # refusing beats silently serving the base model under a tenant's
+            # adapter name / running a full-size cache the operator budgeted
+            # HBM against
+            for flag, val in (("--adapters", adapters),
+                              ("--prefix_cache", prefix_cache),
+                              ("--kv_quant", kv_quant)):
+                if val and not batched:
+                    raise ValueError(
+                        f"{flag} requires the batched engine "
+                        "(--slots > 1, no --quantization)"
+                    )
+            if batched:
                 from datatunerx_tpu.serving.batched_engine import BatchedEngine
 
                 STATE.engine = BatchedEngine(
                     model_path, checkpoint_path or None, adapters=adapters,
                     template=template, max_seq_len=max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
-                    kv_quant=kv_quant or None,
+                    kv_quant=kv_quant or None, prefix_cache=prefix_cache,
                 )
             else:
                 # single-slot path also carries serve-time quantization
@@ -266,13 +264,18 @@ def main(argv=None):
     p.add_argument("--kv_quant", default="", choices=["", "int8"],
                    help="int8-quantized KV cache: half the cache HBM, double "
                         "the slots×context budget (batched engine only)")
+    p.add_argument("--prefix_cache", type=int, default=0,
+                   help="LRU entries of reusable prefilled prompt prefixes "
+                        "(shared system prompts / repeated probes skip "
+                        "prefill; batched engine only; costs one cache row "
+                        "of HBM per entry)")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
                       args.max_seq_len, quantization=args.quantization,
                       slots=args.slots, decode_chunk=args.decode_chunk,
                       adapters=parse_adapters(args.adapters),
-                      kv_quant=args.kv_quant)
+                      kv_quant=args.kv_quant, prefix_cache=args.prefix_cache)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

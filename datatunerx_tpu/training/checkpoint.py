@@ -153,6 +153,10 @@ def export_merged_model(params, cfg, export_dir: str, lora=None, scaling: float 
         params = merge_lora(params, lora, scaling)
     os.makedirs(export_dir, exist_ok=True)
     sd = export_hf_state_dict(params, cfg)
+    if lora is not None and "v_head" in lora:
+        # reward models (stage rm) carry a scalar value head the HF layout
+        # has no slot for; exported under its own key
+        sd["v_head.weight"] = np.asarray(lora["v_head"])
     out = os.path.join(export_dir, "model.npz")
     np.savez(out, **sd)
     import dataclasses

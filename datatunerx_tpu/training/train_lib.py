@@ -19,6 +19,7 @@ result is identical to one big batch regardless of padding imbalance.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Sequence
 
 import jax
@@ -76,20 +77,24 @@ class TrainConfig:
     grad_accum: int = 1
     neftune_alpha: float = 0.0
     compute_dtype: Any = jnp.bfloat16
-    # stage: sft (default) | dpo. DPO is LoRA-only by design: the frozen
+    # stage: sft (default) | dpo | rm. DPO is LoRA-only by design: the frozen
     # reference policy is the BASE model with the adapter switched off — one
     # weight tree serves both policies, no second 7B copy in HBM (the
-    # reference reserves --stage dpo but has no runtime for it).
+    # reference reserves --stage dpo but has no runtime for it). RM (reference
+    # cmd/tuning/parser.py:117-120 stage list, reward_model arg :74-76) trains
+    # base+LoRA with a scalar value head scored at the last response token,
+    # pairwise ranking loss -log σ(r_chosen − r_rejected).
     stage: str = "sft"
     dpo_beta: float = 0.1
 
     def __post_init__(self):
         assert self.finetuning_type in ("lora", "freeze", "full", "none")
-        assert self.stage in ("sft", "dpo")
-        if self.stage == "dpo" and self.finetuning_type != "lora":
+        assert self.stage in ("sft", "dpo", "rm")
+        if self.stage in ("dpo", "rm") and self.finetuning_type != "lora":
             raise ValueError(
-                "stage dpo requires finetuning_type lora (the reference "
-                "policy is the adapter-free base; full/freeze would need a "
+                f"stage {self.stage} requires finetuning_type lora (the "
+                "frozen base serves as the DPO reference policy / keeps the "
+                "reward model a cheap adapter; full/freeze would need a "
                 "second copy of the weights)"
             )
 
@@ -161,6 +166,15 @@ class Trainer:
                 rank=self.cfg.lora_rank,
                 targets=tuple(self.cfg.lora_targets),
             )
+            if self.cfg.stage == "rm":
+                # scalar value head over the final-norm hidden state; rides in
+                # the trainable tree (replicated by the sharding rules)
+                lora["v_head"] = (
+                    jax.random.normal(jax.random.fold_in(rng, 0x4EAD),
+                                      (self.model_cfg.hidden_size,),
+                                      jnp.float32)
+                    / math.sqrt(self.model_cfg.hidden_size)
+                )
         if self.mesh is not None:
             params = shard_tree(params, self.mesh)
             if lora is not None:
@@ -261,9 +275,41 @@ class Trainer:
         # (sum, count) contract shared with the token-NLL path: count = pairs
         return jnp.sum(loss * valid), jnp.sum(valid).astype(jnp.int32)
 
+    def _rm_loss(self, trainable, state: TrainState, batch, rng, train: bool):
+        """Pairwise reward-model loss: -log σ(r_chosen − r_rejected), reward =
+        v_head · hidden at each sequence's LAST response token (where the
+        label stops being IGNORE). Chosen/rejected share one forward."""
+        ids = jnp.concatenate([batch["chosen_ids"], batch["rejected_ids"]], 0)
+        labels = jnp.concatenate([batch["chosen_labels"],
+                                  batch["rejected_labels"]], 0)
+        _, _, hidden = forward(
+            state.params, ids, self.model_cfg,
+            lora=(trainable, self.scaling),
+            compute_dtype=self.cfg.compute_dtype,
+            lora_dropout=self.cfg.lora_dropout if train else 0.0,
+            dropout_rng=rng if train else None,
+            return_hidden=True,
+        )
+        resp = labels != IGNORE_INDEX  # [2B, T]
+        T = ids.shape[1]
+        last = jnp.argmax(
+            jnp.where(resp, jnp.arange(T, dtype=jnp.int32)[None, :], -1), axis=1
+        )  # [2B] index of last response token (0 for all-pad rows)
+        h_last = jnp.take_along_axis(
+            hidden, last[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0].astype(jnp.float32)  # [2B, D]
+        rewards = h_last @ trainable["v_head"].astype(jnp.float32)  # [2B]
+        B = batch["chosen_ids"].shape[0]
+        loss = -jax.nn.log_sigmoid(rewards[:B] - rewards[B:])
+        valid = jnp.any(batch["chosen_labels"][:, 1:] != IGNORE_INDEX,
+                        axis=-1).astype(jnp.float32)  # mask eval-tail pad pairs
+        return jnp.sum(loss * valid), jnp.sum(valid).astype(jnp.int32)
+
     def _forward_loss(self, trainable, state: TrainState, batch, rng, train: bool):
         if self.cfg.stage == "dpo":
             return self._dpo_loss(trainable, state, batch, rng, train)
+        if self.cfg.stage == "rm":
+            return self._rm_loss(trainable, state, batch, rng, train)
         if self.cfg.finetuning_type == "lora":
             params, lora = state.params, trainable
         else:

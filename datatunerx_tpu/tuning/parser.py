@@ -37,7 +37,7 @@ class TrainArgs:
     checkpoint_dir: Optional[str] = None  # resume/merge adapters
     export_dir: Optional[str] = None
     # finetuning (reference cmd/tuning/parser.py:112-221)
-    stage: str = "sft"  # pt | sft | dpo (rm/ppo reserved)
+    stage: str = "sft"  # pt | sft | dpo | rm (ppo reserved)
     finetuning_type: str = "lora"  # lora | freeze | full | none
     num_layer_trainable: int = 3
     name_module_trainable: str = "mlp"
@@ -93,13 +93,14 @@ class TrainArgs:
     def __post_init__(self):
         if self.stage not in ("pt", "sft", "rm", "ppo", "dpo"):
             raise ValueError(f"invalid --stage {self.stage}")
-        if self.stage not in ("pt", "sft", "dpo"):
+        if self.stage == "ppo":
             raise NotImplementedError(
-                f"stage {self.stage!r} is reserved (reference implements sft "
-                "only; rm/ppo have no runtime there either)"
+                "stage 'ppo' is reserved (reference lists it but has no "
+                "runtime for it either)"
             )
-        if self.stage == "dpo" and self.finetuning_type != "lora":
-            raise ValueError("--stage dpo requires --finetuning_type lora")
+        if self.stage in ("dpo", "rm") and self.finetuning_type != "lora":
+            raise ValueError(
+                f"--stage {self.stage} requires --finetuning_type lora")
         if self.finetuning_type not in ("lora", "freeze", "full", "none"):
             raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
         if self.quantization not in (None, "int4", "int8"):

@@ -86,7 +86,7 @@ def run(args: TrainArgs) -> dict:
     template = get_template(args.template, tokenizer)
     pad_id = tokenizer.pad_token_id or 0
     train_ds = CsvDataset(args.train_path, columns=args.columns_map)
-    if args.stage == "dpo":
+    if args.stage in ("dpo", "rm"):
         train_examples = preprocess_preference_records(
             train_ds.records, template, tokenizer,
             cutoff_len=args.block_size, columns=args.columns_map,
@@ -100,8 +100,8 @@ def run(args: TrainArgs) -> dict:
     eval_records = None
     if args.evaluation_path:
         eval_ds = CsvDataset(args.evaluation_path, columns=args.columns_map)
-        if args.stage == "dpo":
-            # preference eval: mean DPO loss over held-out pairs
+        if args.stage in ("dpo", "rm"):
+            # preference eval: mean pairwise loss over held-out pairs
             eval_examples = preprocess_preference_records(
                 eval_ds.records, template, tokenizer,
                 cutoff_len=args.block_size, columns=args.columns_map,
@@ -127,7 +127,7 @@ def run(args: TrainArgs) -> dict:
 
     global_batch = args.per_device_train_batch_size * data_par * args.gradient_accumulation_steps
     iterator_cls = BatchIterator
-    if args.stage == "dpo":
+    if args.stage in ("dpo", "rm"):
         from datatunerx_tpu.data.loader import PreferenceBatchIterator
 
         iterator_cls = PreferenceBatchIterator
@@ -172,7 +172,7 @@ def run(args: TrainArgs) -> dict:
         grad_accum=args.gradient_accumulation_steps,
         neftune_alpha=args.neft_alpha,
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
-        stage="dpo" if args.stage == "dpo" else "sft",
+        stage=args.stage if args.stage in ("dpo", "rm") else "sft",
         dpo_beta=args.dpo_beta,
     )
     trainer = Trainer(cfg, tcfg, mesh=mesh)
@@ -376,7 +376,7 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
     if trainer.mesh is not None:
         data_par = trainer.mesh.shape["dp"] * trainer.mesh.shape["fsdp"]
     iterator_cls = BatchIterator
-    if args.stage == "dpo":
+    if args.stage in ("dpo", "rm"):
         from datatunerx_tpu.data.loader import PreferenceBatchIterator
 
         iterator_cls = PreferenceBatchIterator
@@ -392,9 +392,9 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
     )
     m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
                                  for b in eval_it.epoch(0)))
-    if args.stage == "dpo":
-        # eval_loss IS the mean DPO loss over held-out pairs; exp(loss) is
-        # not a perplexity in this stage
+    if args.stage in ("dpo", "rm"):
+        # eval_loss IS the mean pairwise loss over held-out pairs; exp(loss)
+        # is not a perplexity in these stages
         m.pop("perplexity", None)
     if is_main:
         logger.log_eval(step, m)

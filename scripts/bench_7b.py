@@ -1,0 +1,107 @@
+"""7B-scale single-chip proof (VERDICT next-round #6, BASELINE.md row 1).
+
+Llama-2-7B architecture, nf4-quantized base + LoRA, one v5e chip:
+init + quantize on host (7B bf16 = 13.5 GB; nf4 ≈ 3.5 GB fits the 16 GB HBM
+with remat'd activations), then time train steps on the device.
+
+Prints one JSON line per measured config:
+  {"metric": "qlora_sft_tokens_per_sec_per_chip[llama2-7b,...]", ...}
+
+Run: python scripts/bench_7b.py [--batch 4] [--seq 1024] [--steps 10]
+     [--attention flash] [--quant_impl xla|pallas]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--attention", default="flash", choices=["xla", "flash"])
+    ap.add_argument("--quant_impl", default="xla", choices=["xla", "pallas"])
+    ap.add_argument("--remat", default="full", choices=["none", "dots", "full"])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.models import get_config, init_params
+    from datatunerx_tpu.ops.quant import quantize_model_params
+    from datatunerx_tpu.training import TrainConfig, Trainer
+    from datatunerx_tpu.training.loss import IGNORE_INDEX
+
+    assert jax.default_backend() == "tpu", "7B bench needs the real chip"
+    cpu = jax.devices("cpu")[0]
+
+    cfg = get_config(
+        "llama2-7b", remat=args.remat, attention_impl=args.attention,
+        quantization="int4", quant_impl=args.quant_impl,
+    )
+
+    t0 = time.perf_counter()
+    with jax.default_device(cpu):
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        params = quantize_model_params(params, "int4")
+    print(f"host init+quantize: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    tr = Trainer(
+        cfg,
+        TrainConfig(
+            finetuning_type="lora", lora_rank=8, lora_alpha=32.0,
+            lora_dropout=0.05, lora_targets=("q_proj", "v_proj"),
+            learning_rate=2e-4, scheduler="cosine", optimizer="adamw",
+            total_steps=1000, compute_dtype=jnp.bfloat16,
+        ),
+    )
+    t0 = time.perf_counter()
+    params = jax.device_put(params, jax.devices()[0])
+    state = tr.init_state(params, jax.random.PRNGKey(1))
+    print(f"device transfer + opt init: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    B, T = args.batch, args.seq
+    toks = jax.random.randint(
+        jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size, jnp.int32)
+    labels = jnp.where(jnp.arange(T)[None, :] < T // 8, IGNORE_INDEX, toks)
+    batch = {"input_ids": toks, "labels": labels}
+
+    t0 = time.perf_counter()
+    state, m = tr.train_step(state, batch)
+    loss0 = float(m["loss"])  # host fetch = real sync (tunnel-safe)
+    print(f"compile + first step: {time.perf_counter() - t0:.1f}s "
+          f"loss={loss0:.3f}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = tr.train_step(state, batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    toks_per_sec = B * T * args.steps / dt
+
+    # 7B LoRA step ≈ 2 (fwd) + 4 (bwd) matmul-FLOPs per param-token
+    approx_flops = 6 * 6.74e9 * toks_per_sec
+    mfu = approx_flops / 197e12  # v5e bf16 peak 197 TFLOP/s
+
+    print(json.dumps({
+        "metric": (f"qlora_sft_tokens_per_sec_per_chip[llama2-7b,nf4,"
+                   f"B{B}xT{T},{args.attention},remat={args.remat},"
+                   f"quant={args.quant_impl}]"),
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 3),  # MFU in lieu of a reference number
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""Prefix-cache reuse in the continuous-batching engine (ROADMAP §2): exact
+hits skip prefill, shared-prefix prompts extend a cached row instead of
+recomputing it, LRU evicts, and — the correctness bar — every reuse path
+produces exactly the generation the cold path produces."""
+
+import pytest
+
+from datatunerx_tpu.serving.batched_engine import BatchedEngine, _PrefixCache
+
+
+@pytest.fixture(scope="module")
+def cold():
+    eng = BatchedEngine("preset:debug", template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def cached():
+    eng = BatchedEngine("preset:debug", template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, prefix_cache=3)
+    yield eng
+    eng.close()
+
+
+# ----------------------------------------------------------- unit: LRU
+
+def test_lru_unit():
+    pc = _PrefixCache(2)
+    pc.put(((1, 2), 0), {"cursor": 2})
+    pc.put(((1, 2, 3), 0), {"cursor": 3})
+    assert pc.get(((1, 2), 0)) is not None  # refresh
+    pc.put(((9,), 0), {"cursor": 1})        # evicts (1,2,3)
+    assert pc.get(((1, 2, 3), 0)) is None
+    assert pc.get(((1, 2), 0)) is not None
+
+    key, ent = pc.longest_prefix((1, 2, 7, 8), 0)
+    assert key == ((1, 2), 0)
+    # strict prefix only: the full tuple itself must not match
+    key2, _ = pc.longest_prefix((1, 2), 0)
+    assert key2 is None
+    # adapter isolation
+    key3, _ = pc.longest_prefix((1, 2, 7), 1)
+    assert key3 is None
+
+
+# ------------------------------------------------- engine: reuse paths
+
+def test_exact_reuse_matches_cold(cold, cached):
+    prompt = cold.tokenizer.encode("the quick brown fox jumps")
+    want = cold.generate(prompt, max_new_tokens=10)
+
+    got1 = cached.generate(prompt, max_new_tokens=10)
+    full_after_first = cached.prefill_stats["full"]
+    got2 = cached.generate(prompt, max_new_tokens=10)
+
+    assert got1 == want
+    assert got2 == want
+    assert cached.prefill_stats["full"] == full_after_first  # no new prefill
+    assert cached.prefill_stats["reuse"] >= 1
+
+
+def test_prefix_extension_matches_cold(cold, cached):
+    base = cold.tokenizer.encode("shared system preamble for every request")
+    longer = base + cold.tokenizer.encode(" user question one")
+    want = cold.generate(longer, max_new_tokens=10)
+
+    cached.generate(base, max_new_tokens=1)  # seed the prefix entry
+    before = dict(cached.prefill_stats)
+    got = cached.generate(longer, max_new_tokens=10)
+
+    assert got == want
+    assert cached.prefill_stats["extend"] == before["extend"] + 1
+    assert cached.prefill_stats["full"] == before["full"]
+
+
+def test_extension_chain_and_second_hit(cached):
+    """The extended entry is itself cached: a repeat of the longer prompt is
+    an exact hit, and a yet-longer prompt extends the extended row."""
+    base = cached.tokenizer.encode("chain base segment")
+    mid = base + cached.tokenizer.encode(" plus middle")
+    long_ = mid + cached.tokenizer.encode(" plus tail")
+
+    cached.generate(base, max_new_tokens=1)
+    cached.generate(mid, max_new_tokens=1)
+    before = dict(cached.prefill_stats)
+
+    r1 = cached.generate(mid, max_new_tokens=4)
+    assert cached.prefill_stats["reuse"] == before["reuse"] + 1
+    r2 = cached.generate(long_, max_new_tokens=4)
+    assert cached.prefill_stats["extend"] == before["extend"] + 1
+    assert r1 and r2
+
+
+def test_long_generation_after_extension_matches_cold(cold, cached):
+    """Decode must continue writing at the row's REAL KV depth (the cache
+    cursor), not at this prompt's own bucketed plen: an extended row sits
+    deeper, and a cursor reset to plen would overwrite cached suffix KV once
+    generation runs long enough to reach it."""
+    base = cold.tokenizer.encode("kv depth regression base prompt")
+    longer = base + cold.tokenizer.encode(" with extra tail words")
+    want = cold.generate(longer, max_new_tokens=110)
+
+    cached.generate(base, max_new_tokens=1)  # seed prefix entry
+    before = dict(cached.prefill_stats)
+    got = cached.generate(longer, max_new_tokens=110)
+    assert cached.prefill_stats["extend"] == before["extend"] + 1
+    assert got == want
+
+
+def test_reuse_never_shrinks_decode_budget(cold, cached):
+    """A request whose decode budget fits the cold path but not the (deeper)
+    cached row must fall back to cold prefill — cache state may never change
+    the response."""
+    base = cached.tokenizer.encode("budget parity base")
+    longer = base + cached.tokenizer.encode(" tail")
+    cached.generate(base, max_new_tokens=1)
+    cached.generate(longer, max_new_tokens=1)  # extended entry, deep cursor
+    # drive the entry deeper via chained extensions until an extension would
+    # leave < 200 decode room (max_seq_len=256, plen stays 64 for short
+    # prompts → cold budget 192)
+    want = cold.generate(longer, max_new_tokens=180)
+    before = dict(cached.prefill_stats)
+    got = cached.generate(longer, max_new_tokens=180)
+    assert got == want
+    # the exact entry exists but its cursor (>=128) can't serve 180 new
+    # tokens; the engine must NOT have reused it
+    assert cached.prefill_stats["reuse"] == before["reuse"]
+    assert cached.prefill_stats["full"] == before["full"] + 1
+
+
+def test_reuse_does_not_corrupt_shared_entry(cached):
+    """Two requests admitted from the same cached prefix must not interfere:
+    stored rows are immutable, slots get copies."""
+    prompt = cached.tokenizer.encode("immutability probe prompt")
+    a = cached.generate(prompt, max_new_tokens=8)
+    b = cached.generate(prompt, max_new_tokens=8)
+    c = cached.generate(prompt, max_new_tokens=8)
+    assert a == b == c

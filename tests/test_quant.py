@@ -105,6 +105,24 @@ def test_pallas_kernels_match_xla(mode):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
 
 
+def test_pallas_nf4_odd_chunk_k():
+    """Real-model K values (5632, 11008) are not 128·64-multiples: with
+    K=1408 the kernel runs 2 chunks of 11 blocks — odd blocks-per-chunk and
+    a multi-step K grid, the shape class the chunk-major layout exists for."""
+    from datatunerx_tpu.ops.pallas_quant import _pick_chunk, pallas_matmul_nf4
+
+    K, N = 1408, 128
+    assert _pick_chunk(K // 64, 64) == 11 * 64
+    rng = np.random.default_rng(11)
+    w = _w(rng, (K, N))
+    x = _w(rng, (24, K), scale=1.0)
+    qw = quantize_nf4(w)
+    ref = matmul_nf4(x, qw, (K, N))
+    out = pallas_matmul_nf4(x, qw, (K, N), block_m=64, block_n=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
 @pytest.mark.parametrize("mode", ["int8", "int4"])
 def test_quantized_forward_close_to_full(mode):
     import dataclasses
