@@ -50,9 +50,12 @@ def main():
     else:  # CPU smoke fallback so bench never hard-fails
         model, B, T, steps = "debug", 8, 128, 5
 
-    # perf knobs for the real-chip pass (round-1 number used xla attention;
-    # flash + remat sweeps are the expected upside once the relay is healthy)
-    attention = os.environ.get("DTX_BENCH_ATTENTION", "xla")
+    # perf knobs: the Pallas flash kernel is Mosaic-validated on the v5e
+    # (scripts/tpu_validate.py 8/8, BASELINE.md round-2 pass) and is 1.34×
+    # the xla-attention round-1 number — it is the TPU default. CPU smoke
+    # keeps xla (flash off-TPU would dispatch interpret mode: slow, no signal).
+    attention = os.environ.get("DTX_BENCH_ATTENTION",
+                               "flash" if on_tpu else "xla")
     remat = os.environ.get("DTX_BENCH_REMAT", "dots")
     cfg = get_config(model, remat=remat, attention_impl=attention)
     tr = Trainer(

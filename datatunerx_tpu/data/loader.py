@@ -76,6 +76,142 @@ class CsvDataset:
         )
 
 
+class StreamingCsvDataset:
+    """Record stream over .csv/.jsonl without materializing the file
+    (ROADMAP §4 streaming ingest): large datasets are read line-by-line from
+    local paths or object-store URIs. JSON *arrays* can't stream — they fall
+    back to a full parse."""
+
+    def __init__(self, path: str, columns: Optional[Dict[str, str]] = None):
+        from datatunerx_tpu.utils import storage
+
+        if not storage.exists(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self.columns = columns
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        from datatunerx_tpu.utils import storage
+
+        if self.path.endswith((".jsonl", ".json")):
+            with storage.open_uri(self.path, "r") as f:
+                first = f.readline()
+                if first.lstrip().startswith("["):  # JSON array: no streaming
+                    rest = first + f.read()
+                    yield from json.loads(rest)
+                    return
+                line = first
+                while line:
+                    s = line.strip()
+                    if s:
+                        yield json.loads(s)
+                    line = f.readline()
+        else:
+            with storage.open_uri(self.path, "r") as f:
+                yield from csv.DictReader(f)
+
+
+class StreamingBatchIterator:
+    """Shuffle-buffered streaming batches (tf.data ``shuffle(buffer)``
+    semantics): records are encoded on the fly, held in a bounded reservoir,
+    and emitted as fixed-shape [global_batch, block] batches — the dataset
+    never lives in memory whole. Deterministic per (seed, epoch); host
+    slicing matches BatchIterator. SFT/PT only (preference/prompt stages use
+    small curated sets where whole-file load is the right call)."""
+
+    def __init__(
+        self,
+        dataset: StreamingCsvDataset,
+        template: Template,
+        tokenizer,
+        *,
+        global_batch: int,
+        block_size: int,
+        cutoff_len: Optional[int] = None,
+        pad_id: int = 0,
+        grad_accum: int = 1,
+        buffer_size: int = 2048,
+        seed: int = 0,
+        host_id: int = 0,
+        num_hosts: int = 1,
+        stage: str = "sft",  # sft = templated instruction pairs; pt = plain LM
+    ):
+        if global_batch % max(grad_accum, 1) != 0:
+            raise ValueError("global_batch must be divisible by grad_accum")
+        if (global_batch // max(grad_accum, 1)) % num_hosts != 0:
+            raise ValueError("per-step batch must be divisible by num_hosts")
+        self.dataset = dataset
+        self.template = template
+        self.tokenizer = tokenizer
+        self.global_batch = global_batch
+        self.block_size = block_size
+        self.cutoff_len = cutoff_len or block_size
+        self.pad_id = pad_id
+        self.grad_accum = max(grad_accum, 1)
+        self.buffer_size = max(buffer_size, global_batch)
+        self.seed = seed
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.stage = stage
+
+    def steps_per_epoch(self) -> int:
+        return -1  # unknown without a full pass; callers must use max_steps
+
+    def _encoded(self) -> Iterator[Dict[str, List[int]]]:
+        from datatunerx_tpu.data.preprocess import preprocess_pretrain_records
+
+        for rec in self.dataset:
+            if self.stage == "pt":
+                out = preprocess_pretrain_records(
+                    [rec], self.tokenizer,
+                    cutoff_len=self.cutoff_len, columns=self.dataset.columns,
+                )
+            else:
+                out = preprocess_records(
+                    [rec], self.template, self.tokenizer,
+                    cutoff_len=self.cutoff_len, columns=self.dataset.columns,
+                )
+            if out:
+                yield out[0]
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.default_rng(self.seed + epoch)
+        buf: List[Dict[str, List[int]]] = []
+        pending: List[Dict[str, List[int]]] = []
+
+        def emit(exs):
+            batch = pad_to_block(exs, self.block_size, self.pad_id)
+            if self.num_hosts > 1:
+                B = batch["input_ids"].shape[0]
+                per = B // self.num_hosts
+                lo = self.host_id * per
+                batch = {k: v[lo : lo + per] for k, v in batch.items()}
+            if self.grad_accum > 1:
+                batch = {
+                    k: v.reshape(self.grad_accum, -1, *v.shape[1:])
+                    for k, v in batch.items()
+                }
+            return batch
+
+        for ex in self._encoded():
+            buf.append(ex)
+            if len(buf) < self.buffer_size:
+                continue
+            pending.append(buf.pop(int(rng.integers(len(buf)))))
+            if len(pending) == self.global_batch:
+                yield emit(pending)
+                pending = []
+        # drain: keep sampling the reservoir down to full batches only
+        # (trailing partial batch dropped, as in BatchIterator)
+        rng.shuffle(buf)  # type: ignore[arg-type]
+        tail = pending + buf
+        for s in range(len(tail) // self.global_batch):
+            yield emit(tail[s * self.global_batch : (s + 1) * self.global_batch])
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
 class BatchIterator:
     """Deterministic shuffled epochs over encoded examples → fixed-shape batches.
 
@@ -196,6 +332,64 @@ class PreferenceBatchIterator:
                 "rejected_ids": br["input_ids"],
                 "rejected_labels": br["labels"],
             }
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+class PromptBatchIterator:
+    """Prompt-only batches for PPO rollouts (training/ppo.py): LEFT-padded
+    ``prompt_ids`` [B, block] + ``prompt_mask``, matching the generation
+    convention (pads in front, real tokens at the end so the last column is
+    the last prompt token). Same contract as BatchIterator: deterministic
+    shuffles, host slicing, static shapes, trailing partial batch dropped."""
+
+    def __init__(
+        self,
+        examples: Sequence[Dict[str, List[int]]],
+        *,
+        global_batch: int,
+        block_size: int,
+        pad_id: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        host_id: int = 0,
+        num_hosts: int = 1,
+        **_ignored,  # grad_accum/pack accepted for contract, meaningless here
+    ):
+        if global_batch % num_hosts != 0:
+            raise ValueError("global_batch must be divisible by num_hosts")
+        self.examples = [e for e in examples if e.get("prompt_ids")]
+        self.global_batch = global_batch
+        self.block_size = block_size
+        self.pad_id = pad_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+
+    def steps_per_epoch(self) -> int:
+        return len(self.examples) // self.global_batch
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(len(self.examples))
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(order)
+        T = self.block_size
+        for s in range(self.steps_per_epoch()):
+            idx = order[s * self.global_batch : (s + 1) * self.global_batch]
+            ids = np.full((len(idx), T), self.pad_id, np.int32)
+            mask = np.zeros((len(idx), T), np.int32)
+            for r, i in enumerate(idx):
+                p = self.examples[i]["prompt_ids"][-T:]
+                ids[r, T - len(p):] = p
+                mask[r, T - len(p):] = 1
+            batch = {"prompt_ids": ids, "prompt_mask": mask}
+            if self.num_hosts > 1:
+                per = self.global_batch // self.num_hosts
+                lo = self.host_id * per
+                batch = {k: v[lo : lo + per] for k, v in batch.items()}
+            yield batch
 
     def __iter__(self):
         return self.epoch(0)

@@ -102,6 +102,42 @@ def preprocess_records(
     return out
 
 
+def preprocess_pretrain_records(
+    records: Iterable[Dict[str, Any]],
+    tokenizer,
+    cutoff_len: int = 1024,
+    columns: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, List[int]]]:
+    """Plain-LM pretraining (``--stage pt``; the reference lists pt in its
+    stage enum, cmd/tuning/parser.py:117-120, but its runtime only ever
+    builds the SFT trainer): records carry a ``text`` column (the Dataset CR
+    column map applies — map your corpus column to ``text``), falling back to
+    ``instruction``+``response`` concatenation so SFT-shaped files still
+    work. Every token is a label: no template, no prompt masking. Pairs well
+    with ``--pack_sequences``."""
+    bos = getattr(tokenizer, "bos_token_id", None)
+    add_bos = bool(getattr(tokenizer, "add_bos_token", False)) and bos is not None
+    eos = tokenizer.eos_token_id
+    out = []
+    for rec in records:
+        rec = map_columns(rec, columns)
+        text = rec.get("text")
+        if not isinstance(text, str) or not text:
+            parts = [rec.get("instruction"), rec.get("response")]
+            text = "\n".join(p for p in parts if isinstance(p, str) and p)
+        if not text:
+            continue
+        ids = tokenizer.encode(text, add_special_tokens=False)
+        if add_bos:
+            ids = [bos] + ids
+        if eos is not None:
+            ids = ids + [eos]
+        ids = ids[:cutoff_len]
+        out.append({"input_ids": ids, "labels": list(ids),
+                    "attention_mask": [1] * len(ids)})
+    return out
+
+
 def preprocess_preference_records(
     records: Iterable[Dict[str, Any]],
     template: Template,
@@ -137,6 +173,34 @@ def preprocess_preference_records(
             pair[f"{side}_ids"] = ids
             pair[f"{side}_labels"] = labels
         out.append(pair)
+    return out
+
+
+def preprocess_prompt_records(
+    records: Iterable[Dict[str, Any]],
+    template: Template,
+    tokenizer,
+    cutoff_len: int = 1024,
+    columns: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, List[int]]]:
+    """PPO prompt sets: only ``instruction`` (+ optional query/history/system)
+    is consumed — the policy GENERATES the response, so any ``response``
+    column is ignored. Encoding matches the generative-eval prompt encoding
+    (training/generate.py) so PPO rollouts see the same template framing the
+    served model will."""
+    out = []
+    for rec in records:
+        rec = map_columns(rec, columns)
+        query = rec.get("instruction")
+        if not (isinstance(query, str) and query):
+            continue
+        if rec.get("query"):
+            query = query + "\n" + rec["query"]
+        prompt_ids, _ = template.encode_oneturn(
+            tokenizer, query, "", rec.get("history"), rec.get("system"))
+        if not prompt_ids:
+            continue
+        out.append({"prompt_ids": prompt_ids[-cutoff_len:]})
     return out
 
 

@@ -170,6 +170,17 @@ def _kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype):
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
+def lm_logits(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Project final-norm hidden states onto the vocabulary ([..., D] →
+    [..., V] float32). Exposed so rm/ppo can project only the response
+    window instead of paying the lm_head matmul for every prompt position."""
+    if cfg.tie_word_embeddings or "lm_head" not in params:
+        logits = x @ params["embed_tokens"]["embedding"].astype(x.dtype).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(x.dtype)
+    return logits.astype(jnp.float32)
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,  # [B, T] int32
@@ -186,9 +197,15 @@ def forward(
     dropout_rng: Optional[jax.Array] = None,
     neftune_alpha: float = 0.0,
     return_hidden: bool = False,
+    skip_logits: bool = False,
 ):
     """Returns (logits [B, T, V] float32, new_cache | None); with
-    ``return_hidden`` also the final-norm hidden states [B, T, D]."""
+    ``return_hidden`` also the final-norm hidden states [B, T, D].
+    ``skip_logits`` (requires return_hidden) returns logits=None — value-head
+    consumers (rm/ppo) skip the [T, V] lm_head matmul entirely and project
+    only the positions they need via ``lm_logits``."""
+    if skip_logits and not return_hidden:
+        raise ValueError("skip_logits without return_hidden returns nothing")
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
@@ -368,11 +385,7 @@ def forward(
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(block, x, xs)
 
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings or "lm_head" not in params:
-        logits = x @ params["embed_tokens"]["embedding"].astype(x.dtype).T
-    else:
-        logits = x @ params["lm_head"]["kernel"].astype(x.dtype)
-    logits = logits.astype(jnp.float32)
+    logits = None if skip_logits else lm_logits(params, x, cfg)
 
     new_cache = None
     if cache is not None:

@@ -41,6 +41,7 @@ PARAMETER_KEYS = (
     "FP16",
     # TPU additions
     "meshShape", "loRATarget", "packSequences", "attention",
+    "rewardModel",  # --stage ppo: rm-stage run dir under the storage path
 )
 
 
@@ -137,10 +138,13 @@ def build_trainer_args(
         args += ["--quantization", "int4"]
 
     # trainerType selects the training stage (Hyperparameter CR field the
-    # reference carries but never consumes): sft (default) | dpo | rm
+    # reference carries but never consumes): sft (default) | dpo | rm | ppo
     tt = str(parameters.get("trainerType", "")).lower()
-    if tt in ("dpo", "rm"):
+    if tt in ("dpo", "rm", "ppo"):
         args += ["--stage", tt]
+    if tt == "ppo" and parameters.get("rewardModel"):
+        # an --stage rm run directory (<storage_path>/<uid>)
+        args += ["--reward_model", str(parameters["rewardModel"])]
 
     peft = str(parameters.get("PEFT", "true")).lower() in ("true", "1", "")
     args += ["--finetuning_type", "lora" if peft else "full"]

@@ -66,9 +66,13 @@ def validate_hyperparameter(obj: CustomResource):
                      f"invalid lora target {t.strip()!r}")
     if p.get("trainerType"):
         tt = str(p["trainerType"]).lower()
-        _require(tt in ("sft", "dpo", "rm"),
-                 "trainerType must be sft, dpo, or rm (ppo reserved)")
-        if tt in ("dpo", "rm"):
+        _require(tt in ("sft", "dpo", "rm", "ppo"),
+                 "trainerType must be sft, dpo, rm, or ppo")
+        if tt == "ppo":
+            _require(bool(p.get("rewardModel")),
+                     "trainerType ppo requires parameters.rewardModel (an "
+                     "rm-stage run directory under the storage path)")
+        if tt in ("dpo", "rm", "ppo"):
             # catch the unrunnable combo at admission, not after the JobSet
             # burned its retries: DPO needs the LoRA policy/reference trick,
             # RM keeps the reward model a frozen-base adapter + value head.

@@ -43,7 +43,37 @@ def _int8_kernel(x_ref, q_ref, s_ref, o_ref):
     o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def pallas_matmul_int8(
+def pallas_matmul_int8(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
+                       block_m: int = 256, block_n: int = 256) -> jnp.ndarray:
+    """Differentiable wrapper: forward rides the fused kernel; backward is
+    dx = (g·scale) @ qᵀ through XLA (q/scale are a frozen quantized base —
+    QLoRA never needs their gradients; pallas_call has no jvp rule, so
+    without this the TRAINING path couldn't use the kernel at all)."""
+    return _int8_mm((block_m, block_n), x, q, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _int8_mm(blocks, x, q, scale):
+    return _pallas_matmul_int8_impl(x, q, scale, *blocks)
+
+
+def _int8_fwd(blocks, x, q, scale):
+    return _pallas_matmul_int8_impl(x, q, scale, *blocks), (q, scale)
+
+
+def _int8_bwd(blocks, res, g):
+    q, scale = res
+    gs = g.astype(jnp.float32) * scale.astype(jnp.float32)  # [..., N] * [N]
+    dx = jnp.einsum("...n,kn->...k", gs.astype(g.dtype),
+                    q.astype(g.dtype),
+                    preferred_element_type=jnp.float32).astype(g.dtype)
+    return (dx, np.zeros(q.shape, jax.dtypes.float0), jnp.zeros_like(scale))
+
+
+_int8_mm.defvjp(_int8_fwd, _int8_bwd)
+
+
+def _pallas_matmul_int8_impl(
     x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
     block_m: int = 256, block_n: int = 256,
 ) -> jnp.ndarray:
@@ -129,7 +159,50 @@ def _pick_chunk(nb_total: int, block_size: int, cap_nb: int = 16) -> int:
     return best * block_size
 
 
-def pallas_matmul_nf4(
+def pallas_matmul_nf4(x: jnp.ndarray, qw: Dict[str, jnp.ndarray],
+                      shape: Tuple[int, int], block_m: int = 256,
+                      block_n: int = 256) -> jnp.ndarray:
+    """Differentiable wrapper (see pallas_matmul_int8): forward = fused
+    kernel, backward = dx = g @ Wᵀ with W dequantized by the XLA reference
+    path (frozen base ⇒ no weight grads)."""
+    return _nf4_mm((shape, block_m, block_n), x,
+                   qw["packed"], qw["scale_q"], qw["meta"])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _nf4_mm(static, x, packed, scale_q, meta):
+    shape, block_m, block_n = static
+    return _pallas_matmul_nf4_impl(
+        x, {"packed": packed, "scale_q": scale_q, "meta": meta}, shape,
+        block_m=block_m, block_n=block_n)
+
+
+def _nf4_mm_fwd(static, x, packed, scale_q, meta):
+    shape, block_m, block_n = static
+    out = _pallas_matmul_nf4_impl(
+        x, {"packed": packed, "scale_q": scale_q, "meta": meta}, shape,
+        block_m=block_m, block_n=block_n)
+    return out, (packed, scale_q, meta)
+
+
+def _nf4_mm_bwd(static, res, g):
+    packed, scale_q, meta = res
+    from datatunerx_tpu.ops.quant import dequant_nf4
+
+    w = dequant_nf4({"packed": packed, "scale_q": scale_q, "meta": meta},
+                    static[0], dtype=g.dtype)                   # [K, N]
+    dx = jnp.einsum("...n,kn->...k", g, w,
+                    preferred_element_type=jnp.float32).astype(g.dtype)
+    return (dx,
+            np.zeros(packed.shape, jax.dtypes.float0),
+            np.zeros(scale_q.shape, jax.dtypes.float0),
+            jnp.zeros_like(meta))
+
+
+_nf4_mm.defvjp(_nf4_mm_fwd, _nf4_mm_bwd)
+
+
+def _pallas_matmul_nf4_impl(
     x: jnp.ndarray, qw: Dict[str, jnp.ndarray], shape: Tuple[int, int],
     block_m: int = 256, block_n: int = 256, block_size: int = 64,
 ) -> jnp.ndarray:

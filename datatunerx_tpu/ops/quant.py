@@ -71,6 +71,15 @@ def matmul_int8(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarr
 
 # ------------------------------------------------------------------ nf4
 
+def _nearest_nf4(normed: jnp.ndarray) -> jnp.ndarray:
+    """Nearest NF4 code index via searchsorted on the codebook midpoints —
+    identical to the 16-way |x − code| argmin (the codebook is sorted; exact
+    midpoint ties are measure-zero) at 1/16th the arithmetic, which is what
+    makes host-side quantization of a 7B tree tractable."""
+    mids = jnp.asarray((NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0)
+    return jnp.searchsorted(mids, normed).astype(jnp.uint8)
+
+
 def quantize_nf4(w: jnp.ndarray, block_size: int = NF4_BLOCK) -> Dict[str, jnp.ndarray]:
     """w: [in, out] → packed nf4 (channel-contiguous blocks: tensor is
     transposed to [out, in] then flattened, so each block holds one channel's
@@ -85,9 +94,7 @@ def quantize_nf4(w: jnp.ndarray, block_size: int = NF4_BLOCK) -> Dict[str, jnp.n
     blocks = flat.reshape(-1, block_size)
     absmax = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1), 1e-12)
     normed = blocks / absmax[:, None]
-    code = jnp.asarray(NF4_CODE)
-    idx = jnp.argmin(jnp.abs(normed[..., None] - code[None, None, :]), axis=-1)
-    idx = idx.astype(jnp.uint8)
+    idx = _nearest_nf4(normed)
     # planar nibble layout: lo nibbles hold the block's first half, hi the
     # second — dequant is then a minor-dim concat instead of an interleave,
     # which Mosaic can lower (vector shape-cast on the lane dim can't)
@@ -139,25 +146,62 @@ QUANT_KERNELS = (
 )
 
 
+@jax.jit
+def _quantize_int8_stacked(kern: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """[L, in, out] → stacked int8, all layers in one fused program."""
+    w = kern.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=1) / 127.0, 1e-12)  # [L, out]
+    q = jnp.clip(jnp.round(w / scale[:, None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+@jax.jit
+def _quantize_nf4_stacked(kern: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """[L, in, out] → stacked planar-nibble nf4, all layers in one fused
+    program (layerwise-identical to quantize_nf4; one dispatch per kernel
+    name instead of L unjitted calls with [nb, b, 16] argmin temps — the
+    difference between minutes and an hour for a 7B host-side quantize)."""
+    L, in_dim, out_dim = kern.shape
+    block_size = NF4_BLOCK
+    flat = jnp.swapaxes(kern.astype(jnp.float32), 1, 2).reshape(
+        L, -1, block_size)                                   # channel-contig
+    absmax = jnp.maximum(jnp.max(jnp.abs(flat), axis=2), 1e-12)  # [L, nb]
+    idx = _nearest_nf4(flat / absmax[..., None])
+    half = block_size // 2
+    packed = (idx[..., :half] | (idx[..., half:] << 4)).astype(jnp.uint8)
+    meta0 = jnp.maximum(jnp.max(absmax, axis=1) / 127.0, 1e-12)  # [L]
+    scale_q = jnp.clip(jnp.round(absmax / meta0[:, None]), 1, 127).astype(jnp.int8)
+    meta = jnp.stack(
+        [meta0, jnp.full((L,), NF4_LAYOUT_VERSION, jnp.float32)], axis=1)
+    # STACKED layout is flat bytes per layer [L, nb*b/2]: a [L, nb, 32] stack
+    # tiles to T(8,128) with a 4.0× lane-padding expansion (minor dim 32 vs
+    # 128 lanes) and XLA materializes padded copies of the whole weight stack
+    # as HLO temps — ~12 GB extra on a 7B model, an instant HBM OOM. Flat
+    # rows are 128-divisible → zero padding; consumers reshape ONE layer's
+    # slice back to [nb, b/2] inside the scan body (a ~21 MB transient).
+    return {"packed": packed.reshape(L, -1), "scale_q": scale_q, "meta": meta}
+
+
 def quantize_model_params(params, mode: str):
     """Quantize the stacked [L, in, out] transformer kernels in-tree.
     Embeddings, norms, and lm_head stay full-precision (bnb's skip list).
     Array-only leaves: int8 → q [L,in,out] + scale [L,out];
-    nf4 → packed [L,nb,b/2] + scale_q [L,nb] + meta [L,2]."""
+    nf4 → packed [L, nb*b/2] (flat bytes; see _quantize_nf4_stacked for why)
+    + scale_q [L,nb] + meta [L,2]."""
     if mode not in ("int8", "int4", "nf4"):
         raise ValueError(f"unknown quantization mode {mode!r}")
     layers = dict(params["layers"])
     for name in QUANT_KERNELS:
         proj = dict(layers[name])
         kern = proj.pop("kernel")
-        L = kern.shape[0]
-        per_layer = [
-            quantize_int8(kern[i]) if mode == "int8" else quantize_nf4(kern[i])
-            for i in range(L)
-        ]
-        proj["quant"] = {
-            k: jnp.stack([pl_[k] for pl_ in per_layer]) for k in per_layer[0]
-        }
+        if mode == "int8":
+            proj["quant"] = _quantize_int8_stacked(kern)
+        else:
+            if kern.shape[1] % NF4_BLOCK != 0:
+                raise ValueError(
+                    f"nf4 requires in_dim % {NF4_BLOCK} == 0 (got "
+                    f"{kern.shape[1]}): blocks must not straddle channels")
+            proj["quant"] = _quantize_nf4_stacked(kern)
         layers[name] = proj
     out = dict(params)
     out["layers"] = layers
@@ -176,8 +220,12 @@ def dequantize_model_params(params, mode: str, dims_fn):
                 [dequant_int8(quant["q"][i], quant["scale"][i]) for i in range(L)]
             )
         else:
+            nb = quant["scale_q"].shape[1]
             per = [
-                dequant_nf4({k: v[i] for k, v in quant.items()}, dims_fn(name))
+                dequant_nf4(
+                    {"packed": quant["packed"][i].reshape(nb, NF4_BLOCK // 2),
+                     "scale_q": quant["scale_q"][i], "meta": quant["meta"][i]},
+                    dims_fn(name))
                 for i in range(L)
             ]
             kern = jnp.stack(per)
@@ -201,6 +249,10 @@ def quantized_matmul(
 
             return pallas_matmul_int8(x, quant["q"], quant["scale"])
         return matmul_int8(x, quant["q"], quant["scale"])
+    if quant["packed"].ndim == 1:
+        # layer slice of the stacked flat-byte layout → per-block view
+        quant = dict(quant, packed=quant["packed"].reshape(
+            quant["scale_q"].shape[0], NF4_BLOCK // 2))
     if use_pallas:
         from datatunerx_tpu.ops.pallas_quant import pallas_matmul_nf4
 

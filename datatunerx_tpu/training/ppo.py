@@ -1,0 +1,414 @@
+"""PPO stage: RLHF policy optimization against a trained reward model.
+
+The reference lists ``--stage ppo`` in its argument schema (reference
+cmd/tuning/parser.py:117-120) with ppo knobs (:170-185) but, like dpo/rm,
+ships no runtime for it — its train.py only ever builds an SFT trainer.
+This module is new capability, designed TPU-first:
+
+- **One frozen base, three roles.** Policy = base + trainable LoRA (+ value
+  head on the final-norm hidden state); reference policy = the same base with
+  the adapter switched OFF (the DPO trick, train_lib.py:256); reward model =
+  the same base + the FROZEN adapter/v_head from an ``--stage rm`` run. One
+  copy of the 7B weights in HBM serves all three — the torch equivalent keeps
+  2-3 model replicas.
+- **Whole rollout is ONE compiled program**: prefill → ``lax.scan`` sampling
+  decode over the shared KV cache (old log-probs and values recorded inside
+  the scan — the policy is never re-run for them) → reference log-probs →
+  reward score → per-token KL-shaped rewards → GAE, all jitted together. No
+  host round-trips inside a PPO step.
+- **Token-level PPO** (the TRL/InstructGPT recipe): reward at the last
+  response token from the rm value head, per-token penalty
+  ``-kl_coef * (log π(a) - log π_ref(a))``, GAE(γ, λ) advantages, clipped
+  surrogate + clipped value loss over ``ppo_epochs`` full-batch passes.
+- Adaptive KL controller (``ppo_target`` > 0) runs on host between steps and
+  feeds ``kl_coef`` back in as a scalar operand — no recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models.config import ModelConfig
+from datatunerx_tpu.models.llama import forward, init_cache, lm_logits
+from datatunerx_tpu.training.train_lib import TrainConfig, Trainer
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    gen_len: int = 64          # response tokens sampled per rollout
+    temperature: float = 1.0   # 0 = greedy (degenerate but allowed for tests)
+    top_k: int = 0             # 0 = sample the full softmax
+    kl_coef: float = 0.1       # initial per-token KL penalty coefficient
+    ppo_target: float = 0.0    # target |KL|; >0 enables the adaptive controller
+    kl_horizon: float = 10.0   # adaptation speed (steps to close the error)
+    ppo_epochs: int = 2        # optimization passes per rollout batch
+    clip_ratio: float = 0.2
+    vf_coef: float = 0.1
+    vf_clip: float = 0.2
+    gamma: float = 1.0
+    gae_lambda: float = 0.95
+    score_norm: bool = False   # whiten rm scores across the batch (--ppo_score_norm)
+    whiten_advantages: bool = True
+
+    def __post_init__(self):
+        assert self.gen_len > 0
+        assert self.ppo_epochs >= 1
+        assert 0.0 < self.clip_ratio < 1.0
+
+
+def compute_gae(rewards, values, mask, gamma: float, lam: float):
+    """GAE over [B, G] response windows. ``mask`` is 1 on response tokens
+    (a contiguous prefix of the window); the episode terminates at the last
+    masked token — no bootstrap value beyond it."""
+    rewards = rewards * mask
+    values = values * mask
+
+    def step(carry, xs):
+        r, v, v_next, m, m_next = xs
+        delta = r + gamma * v_next * m_next - v
+        adv = delta + gamma * lam * carry
+        adv = adv * m  # positions after the episode carry nothing
+        return adv, adv
+
+    v_next = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], 1)
+    m_next = jnp.concatenate([mask[:, 1:], jnp.zeros_like(mask[:, :1])], 1)
+    xs = tuple(x.T for x in (rewards, values, v_next, mask, m_next))  # [G, B]
+    _, adv = jax.lax.scan(step, jnp.zeros(rewards.shape[:1]), xs, reverse=True)
+    adv = adv.T
+    return adv, adv + values
+
+
+def _masked_mean(x, m, eps=1e-8):
+    return jnp.sum(x * m) / jnp.maximum(jnp.sum(m), eps)
+
+
+def _whiten(x, m):
+    mean = _masked_mean(x, m)
+    var = _masked_mean(jnp.square(x - mean), m)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-8) * m
+
+
+class PPOTrainer(Trainer):
+    """Composes the base Trainer's state/optimizer/mesh machinery with
+    rollout + PPO update steps. ``train_cfg.stage`` must be "ppo"
+    (finetuning_type lora; the policy value head rides in the lora tree like
+    the rm stage's, train_lib.py:169-177)."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        train_cfg: TrainConfig,
+        ppo_cfg: PPOConfig,
+        *,
+        reward_lora: Any,
+        reward_scaling: float,
+        eos_id: int,
+        pad_id: int = 0,
+        mesh=None,
+    ):
+        assert train_cfg.stage == "ppo", "PPOTrainer requires stage='ppo'"
+        if "v_head" not in reward_lora:
+            raise ValueError(
+                "reward_lora must come from an --stage rm run (no v_head found)"
+            )
+        super().__init__(model_cfg, train_cfg, mesh=mesh)
+        self.ppo_cfg = ppo_cfg
+        self.eos_id = int(eos_id)
+        self.pad_id = int(pad_id)
+        self.kl_coef = float(ppo_cfg.kl_coef)  # host-side, adaptively tuned
+        if mesh is not None:
+            from datatunerx_tpu.parallel.sharding import shard_tree
+
+            reward_lora = shard_tree(reward_lora, mesh)
+        self.reward_lora = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                                  reward_lora)
+        self.reward_scaling = float(reward_scaling)
+        self._rollout = jax.jit(self._rollout_impl)
+        self._update = jax.jit(self._ppo_update_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- rollout
+    def _sample(self, logits, rng):
+        p = self.ppo_cfg
+        if p.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / p.temperature
+        if p.top_k > 0:
+            kth = jax.lax.top_k(logits, p.top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+    def _rollout_impl(self, state, batch, kl_coef):
+        cfg, p = self.model_cfg, self.ppo_cfg
+        cdt = self.cfg.compute_dtype
+        prompt_ids = batch["prompt_ids"].astype(jnp.int32)
+        pmask = batch["prompt_mask"].astype(jnp.int32)
+        B, Tp = prompt_ids.shape
+        G = p.gen_len
+        lora = (state.lora, self.scaling)
+        v_head = state.lora["v_head"].astype(jnp.float32)
+        rng = jax.random.fold_in(jax.random.fold_in(state.rng, 0x990),
+                                 state.step)
+
+        # left-padded prompts: real tokens at the end, rope positions 0..n-1
+        positions = jnp.maximum(jnp.cumsum(pmask, axis=1) - 1, 0).astype(jnp.int32)
+        n_prompt = jnp.sum(pmask, axis=1).astype(jnp.int32)  # [B]
+        cache = init_cache(cfg, B, Tp + G,
+                           dtype=jnp.bfloat16 if cdt is not None else jnp.float32)
+        logits, cache, hidden = forward(
+            params := state.params, prompt_ids, cfg, positions=positions,
+            attention_mask=pmask, cache=cache, lora=lora, compute_dtype=cdt,
+            return_hidden=True,
+        )
+
+        def dec(carry, i):
+            lg_prev, h_prev, cache, done, r = carry
+            r, r_step = jax.random.split(r)
+            lg_prev = lg_prev.astype(jnp.float32)
+            a = self._sample(lg_prev, r_step)                       # [B]
+            logp = jax.nn.log_softmax(lg_prev, axis=-1)
+            lp_a = jnp.take_along_axis(logp, a[:, None], 1)[:, 0]
+            value = h_prev.astype(jnp.float32) @ v_head             # V(s_t)
+            m = (~done).astype(jnp.int32)   # token i is part of the response
+            tok = jnp.where(done, self.pad_id, a)
+            new_done = done | (a == self.eos_id)
+            pos = (n_prompt + i)[:, None]
+            lg, cache, h = forward(
+                params, tok[:, None], cfg, positions=pos,
+                attention_mask=m[:, None],  # post-eos slots → pos sentinel
+                cache=cache, lora=lora, compute_dtype=cdt, return_hidden=True,
+            )
+            return (lg[:, -1], h[:, -1], cache, new_done, r), (tok, lp_a, value, m)
+
+        carry0 = (logits[:, -1], hidden[:, -1], cache,
+                  jnp.zeros((B,), bool), rng)
+        _, (toks, old_logp, values, resp_mask) = jax.lax.scan(
+            dec, carry0, jnp.arange(G))
+        toks, old_logp = toks.T, old_logp.T                    # [B, G]
+        values, resp_mask = values.T, resp_mask.T.astype(jnp.float32)
+
+        # ---- full sequences for the reference/reward forwards ----------
+        seq = jnp.concatenate([prompt_ids, toks], axis=1)      # [B, Tp+G]
+        full_mask = jnp.concatenate(
+            [pmask, resp_mask.astype(jnp.int32)], axis=1)
+        full_pos = jnp.maximum(jnp.cumsum(full_mask, axis=1) - 1, 0).astype(jnp.int32)
+
+        def gen_logps(lora_arg):
+            # hidden-only forward + lm_head over just the G predicting
+            # positions: the [Tp+G, V] softmax would be ~17× wasted work
+            _, _, h = forward(params, seq, cfg, positions=full_pos,
+                              attention_mask=full_mask, lora=lora_arg,
+                              compute_dtype=cdt, return_hidden=True,
+                              skip_logits=True)
+            lg = lm_logits(params, h[:, Tp - 1:-1], cfg)        # [B, G, V]
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.take_along_axis(
+                lp, seq[:, Tp:, None], axis=-1)[..., 0]         # [B, G]
+
+        ref_logp = jax.lax.stop_gradient(gen_logps(None))
+
+        _, _, rh = forward(params, seq, cfg, positions=full_pos,
+                           attention_mask=full_mask,
+                           lora=(self.reward_lora, self.reward_scaling),
+                           compute_dtype=cdt, return_hidden=True,
+                           skip_logits=True)
+        n_resp = jnp.sum(resp_mask, axis=1).astype(jnp.int32)   # ≥ 1 always
+        last_idx = Tp + n_resp - 1
+        h_last = jnp.take_along_axis(
+            rh, last_idx[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+        score = h_last @ self.reward_lora["v_head"].astype(jnp.float32)  # [B]
+        raw_score = score
+        if p.score_norm:
+            score = (score - jnp.mean(score)) / (jnp.std(score) + 1e-6)
+
+        kl = (old_logp - ref_logp) * resp_mask                  # [B, G]
+        last_onehot = (jnp.arange(G)[None, :] == (n_resp - 1)[:, None])
+        rewards = -kl_coef * kl + last_onehot * score[:, None]
+        adv, rets = compute_gae(rewards, values, resp_mask,
+                                p.gamma, p.gae_lambda)
+
+        stats = {
+            "reward_score": jnp.mean(raw_score),
+            "kl": _masked_mean(kl, resp_mask),
+            "response_len": jnp.mean(n_resp.astype(jnp.float32)),
+        }
+        ro = {
+            "seq": seq, "full_mask": full_mask, "positions": full_pos,
+            "resp_mask": resp_mask, "old_logp": old_logp, "values": values,
+            "advantages": adv, "returns": rets,
+        }
+        return jax.lax.stop_gradient(ro), stats
+
+    # -------------------------------------------------------------- update
+    def _ppo_update_impl(self, state, ro):
+        cfg, p = self.model_cfg, self.ppo_cfg
+        cdt = self.cfg.compute_dtype
+        G = ro["old_logp"].shape[1]
+        Tp = ro["seq"].shape[1] - G
+        m = ro["resp_mask"]
+        adv = ro["advantages"]
+        if p.whiten_advantages:
+            adv = _whiten(adv, m)
+
+        def loss_fn(lora_tr):
+            _, _, hid = forward(
+                state.params, ro["seq"], cfg, positions=ro["positions"],
+                attention_mask=ro["full_mask"], lora=(lora_tr, self.scaling),
+                compute_dtype=cdt, return_hidden=True, skip_logits=True,
+                # no dropout in PPO: the surrogate ratio must compare the same
+                # deterministic policy the rollout sampled from
+            )
+            h_pred = hid[:, Tp - 1:-1]                           # [B, G, D]
+            lg = lm_logits(state.params, h_pred, cfg)            # [B, G, V]
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            new_logp = jnp.take_along_axis(
+                lp, ro["seq"][:, Tp:, None], axis=-1)[..., 0]
+            new_v = h_pred.astype(jnp.float32) @ lora_tr["v_head"].astype(jnp.float32)
+
+            ratio = jnp.exp(new_logp - ro["old_logp"])
+            clipped = jnp.clip(ratio, 1.0 - p.clip_ratio, 1.0 + p.clip_ratio)
+            pg = -jnp.minimum(ratio * adv, clipped * adv)
+            pg_loss = _masked_mean(pg, m)
+
+            v_clip = ro["values"] + jnp.clip(
+                new_v - ro["values"], -p.vf_clip, p.vf_clip)
+            vf = 0.5 * jnp.maximum(jnp.square(new_v - ro["returns"]),
+                                   jnp.square(v_clip - ro["returns"]))
+            vf_loss = _masked_mean(vf, m)
+
+            aux = {
+                "pg_loss": pg_loss,
+                "vf_loss": vf_loss,
+                "approx_kl": _masked_mean(ro["old_logp"] - new_logp, m),
+                "clipfrac": _masked_mean(
+                    (jnp.abs(ratio - 1.0) > p.clip_ratio).astype(jnp.float32), m),
+            }
+            return pg_loss + p.vf_coef * vf_loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.lora)
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.lora)
+        new_lora = jax.tree_util.tree_map(jnp.add, state.lora, updates)
+        metrics = dict(aux)
+        metrics["loss"] = loss
+        metrics["lr"] = self.schedule(state.step)
+        from datatunerx_tpu.training.train_lib import optax_global_norm
+
+        metrics["grad_norm"] = optax_global_norm(grads)
+        return state.replace(step=state.step + 1, lora=new_lora,
+                             opt_state=opt_state), metrics
+
+    # ---------------------------------------------------------- public API
+    def step(self, state, batch):
+        """One PPO iteration: rollout, then ``ppo_epochs`` update passes.
+        Returns (state, metrics); metrics mix rollout stats (reward_score, kl,
+        response_len) with the last update pass's losses."""
+        p = self.ppo_cfg
+        batch = self._put_batch(batch)
+        ro, stats = self._rollout(state, batch, jnp.float32(self.kl_coef))
+        metrics = {}
+        for _ in range(p.ppo_epochs):
+            state, metrics = self._update(state, ro)
+        metrics.update({k: v for k, v in stats.items()})
+        metrics["kl_coef"] = self.kl_coef
+        if p.ppo_target > 0.0:
+            # proportional controller (TRL AdaptiveKLController): nudge the
+            # coefficient so measured per-token KL tracks ppo_target
+            err = float(jnp.clip(
+                float(stats["kl"]) / p.ppo_target - 1.0, -0.2, 0.2))
+            self.kl_coef = max(self.kl_coef * (1.0 + err / p.kl_horizon), 1e-4)
+        return state, metrics
+
+    # SFT-style train/eval steps don't apply to PPO
+    def train_step(self, state, batch):  # pragma: no cover
+        raise NotImplementedError("use PPOTrainer.step(state, prompt_batch)")
+
+    def eval_step(self, state, batch):  # pragma: no cover
+        raise NotImplementedError("use PPOTrainer.step(state, prompt_batch)")
+
+
+CONTROLLER_STATE = "ppo_controller.json"
+
+
+def save_controller_state(ckpt_dir: str, step: int, kl_coef: float) -> None:
+    """Persist the host-side adaptive-KL controller next to the Orbax
+    checkpoints: kl_coef is trainer state the TrainState pytree doesn't
+    carry, and a resume that silently reset it to --init_kl_coef would
+    discontinuously weaken the reward shaping."""
+    import json
+
+    from datatunerx_tpu.utils import storage
+
+    storage.write_text(
+        storage.join(ckpt_dir, CONTROLLER_STATE),
+        json.dumps({"step": int(step), "kl_coef": float(kl_coef)}))
+
+
+def load_controller_state(ckpt_dir: str) -> Optional[dict]:
+    import json
+
+    from datatunerx_tpu.utils import storage
+
+    path = storage.join(ckpt_dir, CONTROLLER_STATE)
+    if not storage.exists(path):
+        return None
+    return json.loads(storage.read_text(path))
+
+
+def load_reward_model(model_cfg: ModelConfig, params, reward_dir: str,
+                      mesh=None):
+    """Load the frozen reward adapter from an ``--stage rm`` run directory
+    (``<storage_path>/<run>`` containing manifest.json + checkpoints/).
+
+    Reuses the run's manifest for rank/targets/scaling and restores the
+    adapter + v_head through a throwaway rm-stage TrainState template over the
+    SAME base params — the 7B base is never duplicated. Returns
+    (reward_lora, reward_scaling)."""
+    import json
+    import os
+
+    from datatunerx_tpu.models.lora import DEFAULT_TARGETS, lora_scaling
+    from datatunerx_tpu.training.checkpoint import (
+        MANIFEST_NAME,
+        CheckpointManager,
+    )
+    from datatunerx_tpu.utils import storage
+
+    mpath = storage.join(reward_dir, MANIFEST_NAME)
+    if not storage.exists(mpath):
+        raise FileNotFoundError(
+            f"--reward_model {reward_dir!r}: no {MANIFEST_NAME} — point it at "
+            "an --stage rm run directory (<storage_path>/<uid>)")
+    manifest = json.loads(storage.read_text(mpath))
+    rank = int(manifest.get("lora_rank") or 8)
+    targets = tuple(manifest.get("lora_targets") or DEFAULT_TARGETS)
+    scaling = float(manifest.get("lora_scaling")
+                    or lora_scaling(float(manifest.get("lora_alpha") or 32.0),
+                                    rank))
+    ckpt_uri = manifest.get("checkpoint")
+    if not ckpt_uri:
+        raise ValueError(f"manifest {mpath} has no checkpoint URI")
+    ckpt_dir = os.path.dirname(str(ckpt_uri).rstrip("/"))
+    step = int(os.path.basename(str(ckpt_uri).rstrip("/")))
+
+    rm_trainer = Trainer(
+        model_cfg,
+        TrainConfig(stage="rm", finetuning_type="lora", lora_rank=rank,
+                    lora_targets=targets, compute_dtype=None,
+                    # the template's opt_state tree must match the saved one;
+                    # structure depends only on the optimizer family
+                    optimizer=str(manifest.get("optimizer") or "adamw")),
+        mesh=mesh,
+    )
+    template = rm_trainer.init_state(params, jax.random.PRNGKey(0))
+    mngr = CheckpointManager(ckpt_dir)
+    try:
+        restored, _ = mngr.restore(template, step=step)
+    finally:
+        mngr.close()
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint at {ckpt_uri}")
+    return restored.lora, scaling

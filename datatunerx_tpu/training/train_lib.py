@@ -77,20 +77,23 @@ class TrainConfig:
     grad_accum: int = 1
     neftune_alpha: float = 0.0
     compute_dtype: Any = jnp.bfloat16
-    # stage: sft (default) | dpo | rm. DPO is LoRA-only by design: the frozen
-    # reference policy is the BASE model with the adapter switched off — one
-    # weight tree serves both policies, no second 7B copy in HBM (the
+    # stage: sft (default) | dpo | rm | ppo. DPO is LoRA-only by design: the
+    # frozen reference policy is the BASE model with the adapter switched off —
+    # one weight tree serves both policies, no second 7B copy in HBM (the
     # reference reserves --stage dpo but has no runtime for it). RM (reference
     # cmd/tuning/parser.py:117-120 stage list, reward_model arg :74-76) trains
     # base+LoRA with a scalar value head scored at the last response token,
-    # pairwise ranking loss -log σ(r_chosen − r_rejected).
+    # pairwise ranking loss -log σ(r_chosen − r_rejected). PPO (training/
+    # ppo.py) adds the same v_head to the POLICY adapter (actor-critic shared
+    # trunk) and reuses the adapter-off base as both reference policy and
+    # reward-model trunk.
     stage: str = "sft"
     dpo_beta: float = 0.1
 
     def __post_init__(self):
         assert self.finetuning_type in ("lora", "freeze", "full", "none")
-        assert self.stage in ("sft", "dpo", "rm")
-        if self.stage in ("dpo", "rm") and self.finetuning_type != "lora":
+        assert self.stage in ("sft", "dpo", "rm", "ppo")
+        if self.stage in ("dpo", "rm", "ppo") and self.finetuning_type != "lora":
             raise ValueError(
                 f"stage {self.stage} requires finetuning_type lora (the "
                 "frozen base serves as the DPO reference policy / keeps the "
@@ -166,7 +169,7 @@ class Trainer:
                 rank=self.cfg.lora_rank,
                 targets=tuple(self.cfg.lora_targets),
             )
-            if self.cfg.stage == "rm":
+            if self.cfg.stage in ("rm", "ppo"):
                 # scalar value head over the final-norm hidden state; rides in
                 # the trainable tree (replicated by the sharding rules)
                 lora["v_head"] = (
@@ -289,6 +292,7 @@ class Trainer:
             lora_dropout=self.cfg.lora_dropout if train else 0.0,
             dropout_rng=rng if train else None,
             return_hidden=True,
+            skip_logits=True,  # reward = v_head · hidden; no vocab projection
         )
         resp = labels != IGNORE_INDEX  # [2B, T]
         T = ids.shape[1]

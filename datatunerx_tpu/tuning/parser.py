@@ -37,7 +37,7 @@ class TrainArgs:
     checkpoint_dir: Optional[str] = None  # resume/merge adapters
     export_dir: Optional[str] = None
     # finetuning (reference cmd/tuning/parser.py:112-221)
-    stage: str = "sft"  # pt | sft | dpo | rm (ppo reserved)
+    stage: str = "sft"  # pt | sft | dpo | rm | ppo
     finetuning_type: str = "lora"  # lora | freeze | full | none
     num_layer_trainable: int = 3
     name_module_trainable: str = "mlp"
@@ -47,6 +47,16 @@ class TrainArgs:
     lora_target: str = "q_proj,v_proj"
     neft_alpha: float = 0.0
     dpo_beta: float = 0.1  # reference reserves dpo knobs (parser.py:170-185)
+    # ppo (reference reserves --stage ppo + knobs, parser.py:117-120,170-185,
+    # and a --reward_model arg :74-76; runtime is new capability,
+    # training/ppo.py)
+    reward_model: Optional[str] = None  # --stage rm run dir (storage/<uid>)
+    ppo_epochs: int = 2
+    ppo_target: float = 0.0  # >0: adaptive KL controller target
+    ppo_score_norm: bool = False
+    init_kl_coef: float = 0.1
+    ppo_gen_len: int = 64
+    ppo_temperature: float = 1.0
     num_workers: int = 1
     storage_path: Optional[str] = None
     metrics_export_address: Optional[str] = None
@@ -58,6 +68,8 @@ class TrainArgs:
     block_size: int = 1024
     template: str = "llama2"  # reference hardcodes llama2 (train.py:63)
     pack_sequences: bool = False
+    streaming: bool = False  # shuffle-buffered streaming ingest (sft/pt)
+    shuffle_buffer: int = 2048
     # training loop (HF Seq2SeqTrainingArguments subset the pipeline uses)
     output_dir: str = "result"
     per_device_train_batch_size: int = 4
@@ -93,14 +105,25 @@ class TrainArgs:
     def __post_init__(self):
         if self.stage not in ("pt", "sft", "rm", "ppo", "dpo"):
             raise ValueError(f"invalid --stage {self.stage}")
-        if self.stage == "ppo":
-            raise NotImplementedError(
-                "stage 'ppo' is reserved (reference lists it but has no "
-                "runtime for it either)"
-            )
-        if self.stage in ("dpo", "rm") and self.finetuning_type != "lora":
+        if self.stage in ("dpo", "rm", "ppo") and self.finetuning_type != "lora":
             raise ValueError(
                 f"--stage {self.stage} requires --finetuning_type lora")
+        if self.stage == "ppo" and self.train_path is not None \
+                and not self.reward_model:
+            raise ValueError(
+                "--stage ppo requires --reward_model (an --stage rm run "
+                "directory: <storage_path>/<uid>)")
+        if self.streaming:
+            if self.stage not in ("sft", "pt"):
+                raise ValueError("--streaming supports stages sft/pt only")
+            if self.max_steps <= 0:
+                raise ValueError(
+                    "--streaming needs --max_steps (epoch length is unknown "
+                    "without materializing the stream)")
+            if self.pack_sequences:
+                raise ValueError(
+                    "--streaming and --pack_sequences are exclusive (packing "
+                    "needs the whole dataset to fill blocks densely)")
         if self.finetuning_type not in ("lora", "freeze", "full", "none"):
             raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
         if self.quantization not in (None, "int4", "int8"):
@@ -137,7 +160,8 @@ class TrainArgs:
 
 
 _BOOLS = {"fp16", "bf16", "flash_attn", "shift_attn", "double_quantization",
-          "pack_sequences", "resume", "predict_with_generate"}
+          "pack_sequences", "resume", "predict_with_generate",
+          "ppo_score_norm", "streaming"}
 _ALIASES = {"lora_r": "lora_rank"}  # controller emits --lora_r
 
 

@@ -85,25 +85,57 @@ def run(args: TrainArgs) -> dict:
     # ----- data --------------------------------------------------------
     template = get_template(args.template, tokenizer)
     pad_id = tokenizer.pad_token_id or 0
-    train_ds = CsvDataset(args.train_path, columns=args.columns_map)
-    if args.stage in ("dpo", "rm"):
+    if args.streaming:
+        train_ds = None  # records never materialize; see iterator below
+        train_examples = None
+    else:
+        train_ds = CsvDataset(args.train_path, columns=args.columns_map)
+    if args.streaming:
+        pass  # encoded lazily by StreamingBatchIterator below
+    elif args.stage in ("dpo", "rm"):
         train_examples = preprocess_preference_records(
             train_ds.records, template, tokenizer,
+            cutoff_len=args.block_size, columns=args.columns_map,
+        )
+    elif args.stage == "ppo":
+        from datatunerx_tpu.data.preprocess import preprocess_prompt_records
+
+        train_examples = preprocess_prompt_records(
+            train_ds.records, template, tokenizer,
+            cutoff_len=args.block_size, columns=args.columns_map,
+        )
+    elif args.stage == "pt":
+        from datatunerx_tpu.data.preprocess import preprocess_pretrain_records
+
+        train_examples = preprocess_pretrain_records(
+            train_ds.records, tokenizer,
             cutoff_len=args.block_size, columns=args.columns_map,
         )
     else:
         train_examples = train_ds.encode(template, tokenizer,
                                          cutoff_len=args.block_size)
-    if not train_examples:
+    if not args.streaming and not train_examples:
         raise RuntimeError("Empty dataset!")
     eval_examples = None
     eval_records = None
-    if args.evaluation_path:
+    if args.evaluation_path and args.stage == "ppo" and is_main:
+        print("[ppo] --evaluation_path ignored: PPO's held-out signal is the "
+              "reward/KL curve, not a loss over a fixed eval set", flush=True)
+    if args.evaluation_path and args.stage != "ppo":
         eval_ds = CsvDataset(args.evaluation_path, columns=args.columns_map)
         if args.stage in ("dpo", "rm"):
             # preference eval: mean pairwise loss over held-out pairs
             eval_examples = preprocess_preference_records(
                 eval_ds.records, template, tokenizer,
+                cutoff_len=args.block_size, columns=args.columns_map,
+            )
+        elif args.stage == "pt":
+            from datatunerx_tpu.data.preprocess import (
+                preprocess_pretrain_records,
+            )
+
+            eval_examples = preprocess_pretrain_records(
+                eval_ds.records, tokenizer,
                 cutoff_len=args.block_size, columns=args.columns_map,
             )
         else:
@@ -125,33 +157,68 @@ def run(args: TrainArgs) -> dict:
     mesh = make_mesh(shape, dcn_dp=dcn_dp)
     data_par = shape[0] * shape[1]
 
-    global_batch = args.per_device_train_batch_size * data_par * args.gradient_accumulation_steps
+    grad_accum = args.gradient_accumulation_steps
+    if args.stage == "ppo":
+        if grad_accum > 1 and is_main:
+            print(f"[ppo] --gradient_accumulation_steps {grad_accum} ignored:"
+                  " a PPO step already makes ppo_epochs optimization passes "
+                  "per rollout batch", flush=True)
+        grad_accum = 1
+    global_batch = args.per_device_train_batch_size * data_par * grad_accum
     iterator_cls = BatchIterator
     if args.stage in ("dpo", "rm"):
         from datatunerx_tpu.data.loader import PreferenceBatchIterator
 
         iterator_cls = PreferenceBatchIterator
-    it = iterator_cls(
-        train_examples,
-        global_batch=global_batch,
-        block_size=args.block_size,
-        pad_id=pad_id,
-        grad_accum=args.gradient_accumulation_steps,
-        seed=args.seed,
-        pack=args.pack_sequences,
-        host_id=dist["process_id"],
-        num_hosts=dist["num_processes"],
-    )
-    steps_per_epoch = it.steps_per_epoch()
-    if steps_per_epoch == 0:
-        raise RuntimeError(
-            f"dataset ({len(train_examples)} examples) smaller than one global "
-            f"batch ({global_batch})"
+    elif args.stage == "ppo":
+        from datatunerx_tpu.data.loader import PromptBatchIterator
+
+        iterator_cls = PromptBatchIterator
+    if args.streaming:
+        from datatunerx_tpu.data.loader import (
+            StreamingBatchIterator,
+            StreamingCsvDataset,
         )
-    total_steps = (
-        args.max_steps if args.max_steps > 0
-        else int(math.ceil(steps_per_epoch * args.num_train_epochs))
-    )
+
+        it = StreamingBatchIterator(
+            StreamingCsvDataset(args.train_path, columns=args.columns_map),
+            template, tokenizer,
+            global_batch=global_batch,
+            block_size=args.block_size,
+            pad_id=pad_id,
+            grad_accum=grad_accum,
+            buffer_size=args.shuffle_buffer,
+            seed=args.seed,
+            host_id=dist["process_id"],
+            num_hosts=dist["num_processes"],
+            stage=args.stage,
+        )
+        # epoch length is unknown for a stream; the loop below re-opens the
+        # stream (new shuffle order) until max_steps (validated > 0) land
+        total_steps = args.max_steps
+        steps_per_epoch = total_steps
+    else:
+        it = iterator_cls(
+            train_examples,
+            global_batch=global_batch,
+            block_size=args.block_size,
+            pad_id=pad_id,
+            grad_accum=grad_accum,
+            seed=args.seed,
+            pack=args.pack_sequences,
+            host_id=dist["process_id"],
+            num_hosts=dist["num_processes"],
+        )
+        steps_per_epoch = it.steps_per_epoch()
+        if steps_per_epoch == 0:
+            raise RuntimeError(
+                f"dataset ({len(train_examples)} examples) smaller than one "
+                f"global batch ({global_batch})"
+            )
+        total_steps = (
+            args.max_steps if args.max_steps > 0
+            else int(math.ceil(steps_per_epoch * args.num_train_epochs))
+        )
 
     # ----- trainer -----------------------------------------------------
     tcfg = TrainConfig(
@@ -168,14 +235,44 @@ def run(args: TrainArgs) -> dict:
         warmup_ratio=args.warmup_ratio,
         weight_decay=args.weight_decay,
         max_grad_norm=args.max_grad_norm,
-        total_steps=total_steps,
-        grad_accum=args.gradient_accumulation_steps,
+        # each PPO step runs ppo_epochs optimizer updates, and the optax
+        # schedule counts UPDATES — scale the horizon so the LR decays over
+        # the whole run instead of finishing ppo_epochs× early
+        total_steps=(total_steps * max(args.ppo_epochs, 1)
+                     if args.stage == "ppo" else total_steps),
+        grad_accum=grad_accum,
         neftune_alpha=args.neft_alpha,
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
-        stage=args.stage if args.stage in ("dpo", "rm") else "sft",
+        stage=args.stage if args.stage in ("dpo", "rm", "ppo") else "sft",
         dpo_beta=args.dpo_beta,
     )
-    trainer = Trainer(cfg, tcfg, mesh=mesh)
+    if args.stage == "ppo":
+        from datatunerx_tpu.training.ppo import (
+            PPOConfig,
+            PPOTrainer,
+            load_reward_model,
+        )
+
+        reward_lora, reward_scaling = load_reward_model(
+            cfg, params, args.reward_model, mesh=mesh)
+        trainer = PPOTrainer(
+            cfg, tcfg,
+            PPOConfig(
+                gen_len=args.ppo_gen_len,
+                temperature=args.ppo_temperature,
+                kl_coef=args.init_kl_coef,
+                ppo_target=args.ppo_target,
+                ppo_epochs=args.ppo_epochs,
+                score_norm=args.ppo_score_norm,
+            ),
+            reward_lora=reward_lora,
+            reward_scaling=reward_scaling,
+            eos_id=tokenizer.eos_token_id,
+            pad_id=pad_id,
+            mesh=mesh,
+        )
+    else:
+        trainer = Trainer(cfg, tcfg, mesh=mesh)
     state = trainer.init_state(params, jax.random.PRNGKey(args.seed))
 
     from datatunerx_tpu.utils import storage
@@ -188,6 +285,12 @@ def run(args: TrainArgs) -> dict:
         restored, start_step = ckpt.restore(state)
         if restored is not None:
             state = trainer.place_state(restored)
+            if args.stage == "ppo":
+                from datatunerx_tpu.training.ppo import load_controller_state
+
+                cs = load_controller_state(ckpt_dir)
+                if cs is not None:
+                    trainer.kl_coef = float(cs["kl_coef"])
             if is_main:
                 print(f"[resume] restored step {start_step} from {ckpt_dir}", flush=True)
 
@@ -203,14 +306,22 @@ def run(args: TrainArgs) -> dict:
     trace_dir = os.path.join(args.output_dir, "trace")
     profiling = {"active": False, "done": args.profile_steps <= 0}
 
+    step_fn = trainer.step if args.stage == "ppo" else trainer.train_step
     step = 0  # counts up through start_step (skipping those batches) on resume
     final_metrics: dict = {}
-    epochs = range(int(math.ceil(total_steps / steps_per_epoch)))
+    if args.streaming:
+        import itertools
+
+        epochs = itertools.count()  # re-open the stream until max_steps land
+    else:
+        epochs = range(int(math.ceil(total_steps / steps_per_epoch)))
     done = False
     for epoch in epochs:
         if done:
             break
+        saw_batch = False
         for batch in it.epoch(epoch):
+            saw_batch = True
             if step >= total_steps:
                 done = True
                 break
@@ -221,7 +332,7 @@ def run(args: TrainArgs) -> dict:
                 jax.profiler.start_trace(trace_dir)
                 profiling["active"] = True
                 profiling["until"] = step + args.profile_steps
-            state, metrics = trainer.train_step(state, batch)
+            state, metrics = step_fn(state, batch)
             step += 1
             if profiling["active"] and step >= profiling["until"]:
                 jax.block_until_ready(metrics["loss"])
@@ -235,7 +346,13 @@ def run(args: TrainArgs) -> dict:
                 logger.log_train(step, host)
                 final_metrics = host
             if args.save_steps > 0:
-                ckpt.maybe_save(state, step)
+                if ckpt.maybe_save(state, step) and args.stage == "ppo" \
+                        and is_main:
+                    from datatunerx_tpu.training.ppo import (
+                        save_controller_state,
+                    )
+
+                    save_controller_state(ckpt_dir, step, trainer.kl_coef)
             if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
                 _run_eval(trainer, state, eval_examples, args, pad_id, logger,
                           step, is_main, dist)
@@ -255,6 +372,10 @@ def run(args: TrainArgs) -> dict:
             # eval_steps=0 → once per epoch (final epoch's eval happens below)
             _run_eval(trainer, state, eval_examples, args, pad_id, logger,
                       step, is_main, dist)
+        if not saw_batch:  # streaming: a pass produced no full batch
+            if step == 0:
+                raise RuntimeError("Empty dataset!")
+            break
 
     if profiling["active"]:  # window extended past the last step
         jax.profiler.stop_trace()
@@ -267,6 +388,10 @@ def run(args: TrainArgs) -> dict:
                       step, is_main, dist)
         )
     ckpt.maybe_save(state, step, force=True)
+    if args.stage == "ppo" and is_main:
+        from datatunerx_tpu.training.ppo import save_controller_state
+
+        save_controller_state(ckpt_dir, step, trainer.kl_coef)
 
     if args.predict_with_generate and eval_records:
         # single-host only: generation is a process-0-only loop, which would
@@ -318,6 +443,16 @@ def run(args: TrainArgs) -> dict:
                 "lora_rank": (
                     args.lora_rank if tcfg.finetuning_type == "lora" else None
                 ),
+                "lora_targets": (
+                    list(args.lora_targets)
+                    if tcfg.finetuning_type == "lora" else None
+                ),
+                # stage/optimizer let downstream consumers (e.g. --stage ppo
+                # loading this run as its reward model) rebuild a matching
+                # restore template without guessing
+                "stage": args.stage,
+                "optimizer": args.optim,
+                "reward_model": args.reward_model,
                 "template": args.template,
                 "mesh": dict(zip(("dp", "fsdp", "tp", "sp"), shape)),
                 "steps": step,

@@ -22,6 +22,108 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _tree_flatten_paths(tree):
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(p.key for p in path)
+        out[key] = leaf
+    return out
+
+
+def _npz_path(path):
+    return path if path.endswith(".npz") else path + ".npz"  # savez appends
+
+
+def _cache_format():
+    # a cache from an older nf4 layout would silently reintroduce the tile-
+    # padding HBM OOM the flat-byte layout fixed — version the file and
+    # requantize on any mismatch
+    from datatunerx_tpu.ops.quant import NF4_LAYOUT_VERSION
+
+    return {"mode": "int4", "nf4_layout": NF4_LAYOUT_VERSION,
+            "packed_flat": True}
+
+
+def _save_cached(path, params):
+    import json
+
+    import numpy as np
+
+    import jax
+
+    flat, dtypes = {}, {}
+    for k, v in _tree_flatten_paths(params).items():
+        arr = np.asarray(jax.device_get(v))
+        dtypes[k] = str(arr.dtype)
+        if arr.dtype.name == "bfloat16":  # npy can't portably store bf16
+            arr = arr.astype(np.float32)
+        flat[k] = arr
+    flat["__dtypes__"] = np.asarray(json.dumps(dtypes))
+    flat["__format__"] = np.asarray(json.dumps(_cache_format()))
+    np.savez(_npz_path(path), **flat)
+
+
+def _load_cached(path):
+    import json
+    import os
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    path = _npz_path(path)
+    if not os.path.exists(path):
+        return None
+    z = np.load(path)
+    if "__format__" not in z.files or \
+            json.loads(str(z["__format__"])) != _cache_format():
+        print(f"[cache] {path}: stale/unversioned format — requantizing",
+              file=sys.stderr)
+        return None
+    dtypes = json.loads(str(z["__dtypes__"]))
+    tree = {}
+    for key in z.files:
+        if key == "__dtypes__":
+            continue
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(z[key]).astype(dtypes[key])
+    return tree
+
+
+def _fast_host_init(cfg, init_params, seed: int):
+    """Throughput-bench init: same param TREE as init_params (via eval_shape)
+    but leaves filled with numpy's PCG64 instead of jax's counter-based
+    threefry — ~50× faster on a single host core, and a 7B threefry init
+    takes half an hour there. Values only need plausible scale for a
+    tokens/sec measurement, not reproducibility against training runs."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    abstract = jax.eval_shape(
+        lambda k: init_params(cfg, k, dtype=jnp.bfloat16),
+        jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+
+    def fill(path, s):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "scale":   # rms-norm scales init to 1
+            return jnp.ones(s.shape, s.dtype)
+        if name == "bias":
+            return jnp.zeros(s.shape, s.dtype)
+        w = rng.standard_normal(s.shape, dtype=np.float32) * 0.02
+        return jnp.asarray(w, s.dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, abstract)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=4)
@@ -30,6 +132,10 @@ def main():
     ap.add_argument("--attention", default="flash", choices=["xla", "flash"])
     ap.add_argument("--quant_impl", default="xla", choices=["xla", "pallas"])
     ap.add_argument("--remat", default="full", choices=["none", "dots", "full"])
+    ap.add_argument("--cache", default="/tmp/bench7b_params.npz",
+                    help="quantized-params disk cache ('' disables): host "
+                         "init+quantize of 7B costs ~40 min on one core, "
+                         "variant sweeps shouldn't pay it twice")
     args = ap.parse_args()
 
     import jax
@@ -49,9 +155,14 @@ def main():
     )
 
     t0 = time.perf_counter()
-    with jax.default_device(cpu):
-        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-        params = quantize_model_params(params, "int4")
+    params = _load_cached(args.cache) if args.cache else None
+    if params is None:
+        with jax.default_device(cpu):
+            params = _fast_host_init(cfg, init_params, seed=0)
+            params = quantize_model_params(params, "int4")
+            jax.block_until_ready(params)
+        if args.cache:
+            _save_cached(args.cache, params)
     print(f"host init+quantize: {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
 

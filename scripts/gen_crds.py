@@ -57,6 +57,8 @@ HYPERPARAMETERS = obj({
     "topology": STR,
     "meshShape": obj({"dcn": INT, "dp": INT, "fsdp": INT, "tp": INT, "sp": INT}),
     "packSequences": STR,
+    "loRATarget": STR, "attention": STR,
+    "rewardModel": STR,  # trainerType ppo: rm-stage run dir
 })
 
 FINETUNE_SPEC = obj({

@@ -122,3 +122,51 @@ def test_packed_batch_trains(tmp_path):
     state, m = tr.train_step(state, batch)
     assert np.isfinite(float(m["loss"]))
     assert int(m["tokens"]) > 0
+
+
+def test_pretrain_records_plain_lm():
+    """--stage pt (reference lists pt with no runtime): text column → every
+    token labeled, bos/eos framing, no template."""
+    from datatunerx_tpu.data.preprocess import preprocess_pretrain_records
+
+    tok = FakeTokenizer()
+    out = preprocess_pretrain_records(
+        [{"text": "plain corpus line"},
+         {"instruction": "a", "response": "b"},  # SFT-shaped fallback
+         {"text": ""}],  # empty → skipped (no instruction fallback either)
+        tok, cutoff_len=32,
+    )
+    assert len(out) == 2
+    ex = out[0]
+    assert ex["labels"] == ex["input_ids"]  # no prompt masking
+    assert ex["input_ids"][0] == tok.bos_token_id
+    assert ex["input_ids"][-1] == tok.eos_token_id
+    # column map applies: corpus column renamed to text
+    mapped = preprocess_pretrain_records(
+        [{"content": "xyz"}], tok, cutoff_len=32,
+        columns={"content": "text"},
+    )
+    assert len(mapped) == 1
+
+
+def test_pt_cli_e2e(tmp_path):
+    import json as _json
+
+    from datatunerx_tpu.tuning.parser import parse_train_args
+    from datatunerx_tpu.tuning.train import run
+
+    data = tmp_path / "corpus.jsonl"
+    with open(data, "w") as f:
+        for i in range(40):
+            f.write(_json.dumps({"text": f"document number {i} body"}) + "\n")
+    args = parse_train_args([
+        "--model_name_or_path", "preset:debug", "--stage", "pt",
+        "--train_path", str(data), "--output_dir", str(tmp_path / "out"),
+        "--storage_path", str(tmp_path / "storage"), "--uid", "pt-run",
+        "--block_size", "32", "--per_device_train_batch_size", "1",
+        "--max_steps", "2", "--bf16", "false", "--logging_steps", "1",
+        "--pack_sequences", "true",
+    ])
+    res = run(args)
+    assert res["steps"] == 2
+    assert res["manifest"]

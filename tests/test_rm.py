@@ -147,9 +147,13 @@ def test_rm_reachable_through_operator():
     with pytest.raises(AdmissionError, match="PEFT"):
         admit(Hyperparameter(metadata=ObjectMeta(name="h-rm2"), spec={
             "parameters": {"trainerType": "rm", "PEFT": "false"}}))
-    with pytest.raises(AdmissionError, match="ppo reserved"):
+    # ppo is a real stage now (training/ppo.py) but needs its reward model
+    with pytest.raises(AdmissionError, match="rewardModel"):
         admit(Hyperparameter(metadata=ObjectMeta(name="h-ppo"), spec={
             "parameters": {"trainerType": "ppo"}}))
+    admit(Hyperparameter(metadata=ObjectMeta(name="h-ppo2"), spec={
+        "parameters": {"trainerType": "ppo",
+                       "rewardModel": "/storage/rm-run"}}))
 
     from datatunerx_tpu.operator.api import Finetune
 
