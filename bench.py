@@ -1,36 +1,52 @@
-"""Headline benchmark: LoRA SFT tokens/sec/chip (BASELINE.md north-star #1).
+"""Headline benchmark: LoRA SFT tokens/sec/chip (BASELINE.md north-stars).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Runs on whatever backend JAX selects (the driver provides one real TPU chip).
-The model is tinyllama-1.1b (real llama-family config that fits one v5e chip in
-bf16 with LoRA); batch geometry mirrors the reference's operating point
-(block_size 1024, reference cmd/tuning/train.py:50-51).
+Orchestration (round 3, per VERDICT next-round #1 and #3):
+- Pre-flight probes the default device in a subprocess, RETRYING over a
+  window (the tunneled relay wedges transiently) before degrading to CPU.
+- A CPU fallback line is explicitly marked ``"cpu_fallback": true`` with
+  ``"vs_baseline": null`` so a smoke run can never read as a TPU result;
+  if a dated in-repo TPU artifact exists (BENCH_TPU.json) its headline is
+  referenced in ``"tpu_evidence"``.
+- On TPU the headline is the NORTH-STAR metric — Llama-2-7B QLoRA
+  tokens/sec/chip (scripts/bench_7b.py, BASELINE.json metric) — with the
+  tinyllama-1.1b line (rounds 1-2 continuity) embedded as ``"secondary"``.
+  Both are persisted with timestamp+config to BENCH_TPU.json.
+- Each measurement runs in its own subprocess: a wedge mid-bench costs that
+  child's timeout, not the whole artifact.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
-denominator is this project's own round-1 recorded measurement — values > 1.0
-mean speedup over round 1.
+denominator is this project's own prior recorded measurement — values > 1.0
+mean speedup over that round. 7B line: round-2's 709 tok/s/chip (XLA dequant
+path). tinyllama line: round-1's 12,996 tok/s/chip.
 """
 
 import json
 import os
+import subprocess
 import sys
-import threading
 import time
 
-BENCH_TIMEOUT_S = float(os.environ.get("DTX_BENCH_TIMEOUT_S", "480"))
-# Pre-flight deadline: generous enough for first-compile of a tiny matmul
-# (~20-40s cold) but far below the full watchdog, so a wedged relay costs
-# ~90s + a CPU smoke run instead of the whole 480s budget.
-PREFLIGHT_TIMEOUT_S = float(os.environ.get("DTX_BENCH_PREFLIGHT_S", "90"))
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-# Round-1 recorded tokens/sec/chip on TPU v5e-1 (see BASELINE.md); update only
-# alongside BASELINE.md.
-ROUND1_BASELINE_TOKS_PER_SEC = 12996.0  # TPU v5e-1, tinyllama-1.1b LoRA B8xT1024
+DEADLINE_S = float(os.environ.get("DTX_BENCH_TIMEOUT_S", "480"))
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("DTX_BENCH_PREFLIGHT_S", "60"))
+PREFLIGHT_TRIES = int(os.environ.get("DTX_BENCH_PREFLIGHT_TRIES", "4"))
+PREFLIGHT_SLEEP_S = float(os.environ.get("DTX_BENCH_PREFLIGHT_SLEEP_S", "15"))
+
+# Prior-round recorded tokens/sec/chip on TPU v5e-1 (see BASELINE.md); update
+# only alongside BASELINE.md.
+ROUND1_TINYLLAMA_TOKS = 12996.0  # round 1, xla attention, B8xT1024
+ROUND2_7B_TOKS = 709.0           # round 2, nf4 XLA dequant path, B4xT1024
 
 
-def main():
+# --------------------------------------------------------------- child mode
+
+def child_tinyllama():
+    """Measure tinyllama-1.1b LoRA SFT tokens/sec on the default backend and
+    print one JSON line. Run in a subprocess by the orchestrator."""
     import jax
 
     if os.environ.get("DTX_BENCH_FORCE_CPU"):
@@ -47,11 +63,11 @@ def main():
     if on_tpu:
         model, B, T, steps = "tinyllama-1.1b", 8, 1024, 20
         B = int(os.environ.get("DTX_BENCH_BATCH", B))
-    else:  # CPU smoke fallback so bench never hard-fails
+    else:  # CPU smoke so the artifact always carries a line
         model, B, T, steps = "debug", 8, 128, 5
 
     # perf knobs: the Pallas flash kernel is Mosaic-validated on the v5e
-    # (scripts/tpu_validate.py 8/8, BASELINE.md round-2 pass) and is 1.34×
+    # (scripts/tpu_validate.py 8/8, BASELINE.md round-2 pass) and is 1.34x
     # the xla-attention round-1 number — it is the TPU default. CPU smoke
     # keeps xla (flash off-TPU would dispatch interpret mode: slow, no signal).
     attention = os.environ.get("DTX_BENCH_ATTENTION",
@@ -90,83 +106,191 @@ def main():
     dt = time.perf_counter() - t0
 
     toks_per_sec = B * T * steps / dt
-    vs = (
-        toks_per_sec / ROUND1_BASELINE_TOKS_PER_SEC
-        if (ROUND1_BASELINE_TOKS_PER_SEC and on_tpu)
-        else 1.0
-    )
+    vs = toks_per_sec / ROUND1_TINYLLAMA_TOKS if on_tpu else None
     tag = (f",{attention}" if attention != "xla" else "") + (
         f",remat={remat}" if remat != "dots" else "")
     tag += f",B{B}" if B != 8 else ""
-    print(
-        json.dumps(
-            {
-                "metric": f"lora_sft_tokens_per_sec_per_chip[{model},B{B}xT{T}{tag}]",
-                "value": round(toks_per_sec, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    print(json.dumps({
+        "metric": f"lora_sft_tokens_per_sec_per_chip[{model},B{B}xT{T}{tag}]",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3) if vs is not None else None,
+    }))
 
+
+# ------------------------------------------------------------- orchestrator
 
 def _preflight_device_ok():
-    """Probe the default device with a tiny matmul in a SUBPROCESS.
+    """Probe the default device with a tiny matmul in a SUBPROCESS, retrying
+    over a window.
 
     The tunneled TPU backend wedges by hanging (not erroring), and once a
-    process has initialized the wedged platform it cannot recover — so the
-    probe must be isolated. If the probe hangs or fails, the bench falls back
-    to the CPU smoke immediately instead of burning the full watchdog budget.
+    process has initialized the wedged platform it cannot recover — so each
+    probe must be isolated. The wedge is transient (VERDICT r2 weak #1), so
+    one failed probe is not evidence: retry a few times before degrading.
     """
-    import subprocess
-
     code = (
         "import jax, jax.numpy as jnp;"
         "x = jnp.ones((256, 256), jnp.float32);"
         "print(float((x @ x)[0, 0]))"
     )
+    for attempt in range(PREFLIGHT_TRIES):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=PREFLIGHT_TIMEOUT_S, capture_output=True, text=True,
+            )
+            if p.returncode == 0 and "256.0" in p.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"[bench] pre-flight attempt {attempt + 1}/{PREFLIGHT_TRIES} "
+              f"failed (device hung or errored)", file=sys.stderr)
+        if attempt + 1 < PREFLIGHT_TRIES:
+            time.sleep(PREFLIGHT_SLEEP_S)
+    return False
+
+
+def _run_child(argv, timeout_s, env_extra=None):
+    """Run a bench child; return its parsed last JSON stdout line or None."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
     try:
         p = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=PREFLIGHT_TIMEOUT_S, capture_output=True, text=True,
+            argv, timeout=timeout_s, capture_output=True, text=True,
+            env=env, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
-        return False
-    return p.returncode == 0 and "256.0" in p.stdout
-
-
-def _run_with_watchdog():
-    """The tunneled TPU backend can wedge indefinitely (device ops hang, not
-    error). Run the bench on a daemon thread; if it exceeds the deadline, emit
-    the error JSON line and hard-exit so the driver always gets exactly one
-    line of stdout."""
-    if not os.environ.get("DTX_BENCH_FORCE_CPU") and not _preflight_device_ok():
-        # Device hung/failed the pre-flight: emit the CPU smoke line rather
-        # than a bench_error so BENCH_rN always carries signal.
-        os.environ["DTX_BENCH_FORCE_CPU"] = "1"
-
-    result = {}
-
-    def target():
+        print(f"[bench] child {argv[1]} timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+        return None
+    sys.stderr.write(p.stderr[-2000:])
+    for line in reversed(p.stdout.strip().splitlines()):
         try:
-            main()
-            result["ok"] = True
-        except Exception as e:  # noqa: BLE001
-            result["err"] = str(e)[:200]
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+        except ValueError:
+            continue
+    print(f"[bench] child {argv[1]} exited rc={p.returncode} with no "
+          f"JSON line", file=sys.stderr)
+    return None
 
-    t = threading.Thread(target=target, daemon=True)
-    t.start()
-    t.join(BENCH_TIMEOUT_S)
-    if t.is_alive():
-        print(json.dumps({"metric": "bench_error", "value": 0,
-                          "unit": f"timeout after {BENCH_TIMEOUT_S}s (TPU backend hung)",
-                          "vs_baseline": 0.0}), flush=True)
-        os._exit(1)
-    if "err" in result:
-        print(json.dumps({"metric": "bench_error", "value": 0,
-                          "unit": result["err"], "vs_baseline": 0.0}))
-        sys.exit(1)
+
+def _tpu_evidence():
+    """Headline of the committed dated TPU artifact, if one exists."""
+    path = os.path.join(REPO, "BENCH_TPU.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        head = doc.get("headline", {})
+        return {
+            "file": "BENCH_TPU.json",
+            "timestamp": doc.get("timestamp"),
+            "metric": head.get("metric"),
+            "value": head.get("value"),
+        }
+    except Exception:  # noqa: BLE001 — evidence pointer is best-effort
+        return None
+
+
+def _persist_tpu_artifact(headline, secondary):
+    from datetime import datetime, timezone
+
+    doc = {
+        "timestamp": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "hardware": "TPU v5e-1 (tunneled)",
+        "headline": headline,
+        "secondary": secondary,
+        "config": {
+            "tinyllama": "B8xT1024 bf16 LoRA r8 q/v, flash, remat=dots",
+            "llama2_7b": "B4xT1024 nf4-base QLoRA r8 q/v, flash, remat=full",
+        },
+    }
+    with open(os.path.join(REPO, "BENCH_TPU.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def main():
+    t_start = time.monotonic()
+
+    def remaining():
+        return DEADLINE_S - (time.monotonic() - t_start)
+
+    def emit_cpu_fallback():
+        # CPU smoke: explicitly marked; can never read as a TPU result.
+        line = _run_child(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+            timeout_s=max(remaining() - 10, 60),
+            env_extra={"DTX_BENCH_FORCE_CPU": "1"},
+        )
+        if line is None:
+            line = {"metric": "bench_error", "value": 0,
+                    "unit": "cpu smoke failed", "vs_baseline": None}
+        line["cpu_fallback"] = True
+        line["vs_baseline"] = None
+        ev = _tpu_evidence()
+        if ev is not None:
+            line["tpu_evidence"] = ev
+        print(json.dumps(line), flush=True)
+
+    forced_cpu = bool(os.environ.get("DTX_BENCH_FORCE_CPU"))
+    on_tpu = False if forced_cpu else _preflight_device_ok()
+
+    if not on_tpu:
+        return emit_cpu_fallback()
+
+    # --- TPU path: tinyllama (continuity) then 7B QLoRA (the north star) ---
+    tiny = _run_child(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+        timeout_s=min(max(remaining() * 0.45, 120), 300),
+    )
+    if tiny is not None and "debug" in tiny.get("metric", ""):
+        # the child fell back to CPU after a clean (non-hang) device failure
+        # post-preflight: a smoke line must never be persisted as TPU evidence
+        print("[bench] tinyllama child degraded to CPU despite preflight — "
+              "dropping its line from the TPU artifact", file=sys.stderr)
+        tiny = None
+
+    seven = None
+    if remaining() > 150:
+        seven = _run_child(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_7b.py"),
+             "--steps", os.environ.get("DTX_BENCH_7B_STEPS", "10")],
+            timeout_s=remaining() - 20,
+        )
+        if seven is not None:
+            # vs_baseline for the artifact = speedup over round-2's recorded
+            # 709 tok/s/chip (bench_7b.py itself reports MFU there)
+            seven = dict(seven)
+            seven["mfu"] = seven.get("vs_baseline")
+            seven["vs_baseline"] = round(
+                float(seven["value"]) / ROUND2_7B_TOKS, 3)
+    else:
+        print("[bench] skipping 7B line: insufficient budget left "
+              f"({remaining():.0f}s)", file=sys.stderr)
+
+    headline = seven or tiny
+    if headline is None:
+        # the device passed preflight but every measurement child failed or
+        # degraded — fall back to the marked CPU smoke so the artifact still
+        # carries an honest line
+        print("[bench] no TPU measurement landed; emitting marked CPU "
+              "fallback", file=sys.stderr)
+        return emit_cpu_fallback()
+    secondary = tiny if headline is seven else None
+    _persist_tpu_artifact(headline, secondary)
+    out = dict(headline)
+    if secondary is not None:
+        out["secondary"] = secondary
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    _run_with_watchdog()
+    if "--child" in sys.argv:
+        child_tinyllama()
+    else:
+        main()

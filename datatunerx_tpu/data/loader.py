@@ -95,7 +95,11 @@ class StreamingCsvDataset:
 
         if self.path.endswith((".jsonl", ".json")):
             with storage.open_uri(self.path, "r") as f:
+                # skip leading blank lines before sniffing for a JSON array so
+                # streaming matches CsvDataset (which strips the whole text)
                 first = f.readline()
+                while first and not first.strip():
+                    first = f.readline()
                 if first.lstrip().startswith("["):  # JSON array: no streaming
                     rest = first + f.read()
                     yield from json.loads(rest)

@@ -126,7 +126,24 @@ def main(argv=None):
                         "by construction)")
     p.add_argument("--leader-lease-duration", type=float, default=15.0)
     p.add_argument("--leader-renew-period", type=float, default=5.0)
-    p.add_argument("--enable-cert-rotator", default="false")  # accepted no-op
+    p.add_argument("--enable-cert-rotator", default="true",
+                   help="kube backend: rotate the webhook TLS cert before "
+                        "expiry and re-patch the caBundle (reference "
+                        "cert-rotator, controller_manager.go:83-111)")
+    p.add_argument("--webhook-bind-address", default=":9443",
+                   help="kube backend: admission webhook HTTPS address "
+                        "(reference webhook server port, "
+                        "controller_manager.go:70); ':0' picks a free port, "
+                        "'disabled' turns the webhook server off")
+    p.add_argument("--webhook-cert-dir", default="/tmp/dtx-webhook-certs",
+                   help="TLS cert dir for the webhook server; in HA "
+                        "deployments mount a shared Secret here so every "
+                        "replica serves the same CA (the caBundle in the "
+                        "webhook configs is last-writer-wins)")
+    p.add_argument("--webhook-url-base", default=None,
+                   help="externally reachable base URL of this webhook "
+                        "server, written into the webhook configurations "
+                        "(default: https://<first-cert-SAN>:<port>)")
     # TPU-native options
     p.add_argument("--persist-dir", default=None,
                    help="JSON object store directory (durable CRs)")
@@ -179,6 +196,33 @@ def main(argv=None):
         mgr = build_manager(store, training, serving,
                             storage_path=args.storage_path,
                             slice_pool=pool_from_env())
+
+        # Kubernetes-native admission: serve the webhook rules over TLS and
+        # register the configurations so kubectl-applied CRs are validated by
+        # the apiserver itself, not just by this process's AdmittingStore.
+        if args.webhook_bind_address != "disabled":
+            from datatunerx_tpu.operator.webhook_server import (
+                AdmissionWebhookServer,
+                CertManager,
+                install_webhooks,
+            )
+
+            wh_host, _, wh_port = args.webhook_bind_address.rpartition(":")
+            certs = CertManager(args.webhook_cert_dir)
+            wh_srv = AdmissionWebhookServer(
+                certs, host=wh_host or "0.0.0.0", port=int(wh_port or 9443))
+            base = (args.webhook_url_base
+                    or f"https://{certs.dns_names[0]}:{wh_srv.port}")
+            rotate = (3600.0 if str(args.enable_cert_rotator).lower()
+                      in ("true", "1", "yes") else 0.0)
+            wh_srv.start(
+                rotation_check_s=rotate,
+                on_rotate=lambda ca: install_webhooks(client, ca, base),
+            )
+            install_webhooks(client, certs.ca_bundle_b64(), base)
+            print(f"[controller-manager] admission webhooks on :{wh_srv.port}",
+                  flush=True)
+
         elector = None
         if str(args.leader_elect).lower() in ("true", "1", "yes"):
             import os as _os

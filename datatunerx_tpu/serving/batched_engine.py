@@ -45,6 +45,12 @@ class _PrefixCache:
     prefix-reuse tier of paged serving stacks (vLLM/JetStream), host-managed
     here because rows are full-width and slots are few.
 
+    Lookup structure is a per-adapter token TRIE: ``longest_prefix`` walks at
+    most ``len(tokens)`` nodes, so admission cost is O(prompt_len) instead of
+    the round-2 O(entries × prompt_len) linear scan over all stored keys.
+    The OrderedDict keeps only LRU recency + the entry payloads; the trie
+    mirrors its key set (terminal nodes point back at the exact key).
+
     Entries: {"cache": row_cache, "logits": last-token logits,
     "cursor": cache write depth}. Stored row caches are immutable JAX
     arrays — inserting a row into a slot copies, and extension builds a new
@@ -56,6 +62,12 @@ class _PrefixCache:
 
         self.capacity = capacity
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+        # adapter -> trie root; node = [children {tok: node}, terminal key]
+        self._roots: Dict[int, list] = {}
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._d)
 
     def get(self, key):
         ent = self._d.get(key)
@@ -64,24 +76,59 @@ class _PrefixCache:
         return ent
 
     def longest_prefix(self, tokens: tuple, adapter: int):
-        """Longest stored strict prefix of ``tokens`` for this adapter."""
-        best_key, best = None, None
-        for (ptoks, pad), ent in self._d.items():
-            if pad != adapter or len(ptoks) >= len(tokens):
-                continue
-            if tokens[: len(ptoks)] == ptoks and (
-                best_key is None or len(ptoks) > len(best_key[0])
-            ):
-                best_key, best = (ptoks, pad), ent
-        if best_key is not None:
-            self._d.move_to_end(best_key)
-        return best_key, best
+        """Longest stored strict prefix of ``tokens`` for this adapter —
+        one trie descent, deepest terminal wins."""
+        node = self._roots.get(adapter)
+        if node is None:
+            return None, None
+        best_key = None
+        for i in range(len(tokens) - 1):  # strict: depth < len(tokens)
+            node = node[0].get(tokens[i])
+            if node is None:
+                break
+            if node[1] is not None:
+                best_key = node[1]
+        if best_key is None:
+            return None, None
+        self._d.move_to_end(best_key)
+        return best_key, self._d[best_key]
 
     def put(self, key, ent):
+        is_new = key not in self._d
         self._d[key] = ent
         self._d.move_to_end(key)
+        if is_new:
+            ptoks, adapter = key
+            node = self._roots.setdefault(adapter, [{}, None])
+            for t in ptoks:
+                node = node[0].setdefault(t, [{}, None])
+            node[1] = key
         while len(self._d) > self.capacity:
-            self._d.popitem(last=False)
+            old_key, _ = self._d.popitem(last=False)
+            self._trie_remove(old_key)
+            self.evictions += 1
+
+    def _trie_remove(self, key):
+        ptoks, adapter = key
+        root = self._roots.get(adapter)
+        if root is None:
+            return
+        path, node = [root], root
+        for t in ptoks:
+            node = node[0].get(t)
+            if node is None:
+                return
+            path.append(node)
+        node[1] = None
+        # prune now-useless nodes bottom-up so the trie never outgrows
+        # capacity × prompt_len
+        for i in range(len(path) - 1, 0, -1):
+            n = path[i]
+            if n[0] or n[1] is not None:
+                break
+            del path[i - 1][0][ptoks[i - 1]]
+        if not root[0] and root[1] is None:
+            del self._roots[adapter]
 
 
 class Request:

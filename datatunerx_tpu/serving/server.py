@@ -51,8 +51,56 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path == "/v1/models":
             self._json(200, {"object": "list", "data": [
                 {"id": STATE.model_path, "object": "model"}]})
+        elif self.path == "/metrics":
+            self._metrics()
         else:
             self._json(404, {"error": "not found"})
+
+    def _metrics(self):
+        """Prometheus text exposition: prefill/prefix-cache counters (batched
+        engine). Serving-side twin of the operator's /metrics endpoint."""
+        lines = [
+            "# TYPE dtx_serving_up gauge",
+            f"dtx_serving_up {1 if STATE.engine is not None else 0}",
+        ]
+        eng = STATE.engine
+        stats = getattr(eng, "prefill_stats", None)
+        if stats is not None:
+            lines.append("# TYPE dtx_serving_prefill_total counter")
+            for kind, n in sorted(stats.items()):
+                lines.append(
+                    f'dtx_serving_prefill_total{{kind="{kind}"}} {n}')
+            # hit = exact reuse, partial = suffix extension, miss = full
+            lines.append("# TYPE dtx_serving_prefix_cache_hits_total counter")
+            lines.append(
+                f"dtx_serving_prefix_cache_hits_total {stats['reuse']}")
+            lines.append(
+                "# TYPE dtx_serving_prefix_cache_partial_hits_total counter")
+            lines.append(
+                f"dtx_serving_prefix_cache_partial_hits_total {stats['extend']}")
+            lines.append("# TYPE dtx_serving_prefix_cache_misses_total counter")
+            lines.append(
+                f"dtx_serving_prefix_cache_misses_total {stats['full']}")
+        prefix = getattr(eng, "_prefix", None)
+        if prefix is not None:
+            lines.append("# TYPE dtx_serving_prefix_cache_entries gauge")
+            lines.append(f"dtx_serving_prefix_cache_entries {len(prefix)}")
+            lines.append(
+                "# TYPE dtx_serving_prefix_cache_evictions_total counter")
+            lines.append(
+                f"dtx_serving_prefix_cache_evictions_total {prefix.evictions}")
+        if eng is not None and hasattr(eng, "_slot_req"):
+            busy = sum(1 for r in eng._slot_req if r is not None)
+            lines.append("# TYPE dtx_serving_slots_busy gauge")
+            lines.append(f"dtx_serving_slots_busy {busy}")
+            lines.append("# TYPE dtx_serving_slots_total gauge")
+            lines.append(f"dtx_serving_slots_total {eng.slots}")
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_POST(self):
         if self.path == "/perplexity":

@@ -117,6 +117,12 @@ class PPOTrainer(Trainer):
             )
         super().__init__(model_cfg, train_cfg, mesh=mesh)
         self.ppo_cfg = ppo_cfg
+        if eos_id is None:
+            raise ValueError(
+                "PPO requires a tokenizer with an EOS token "
+                "(tokenizer.eos_token_id is None): rollouts could never "
+                "terminate early without one"
+            )
         self.eos_id = int(eos_id)
         self.pad_id = int(pad_id)
         self.kl_coef = float(ppo_cfg.kl_coef)  # host-side, adaptively tuned
@@ -268,7 +274,11 @@ class PPOTrainer(Trainer):
                 lp, ro["seq"][:, Tp:, None], axis=-1)[..., 0]
             new_v = h_pred.astype(jnp.float32) @ lora_tr["v_head"].astype(jnp.float32)
 
-            ratio = jnp.exp(new_logp - ro["old_logp"])
+            # Clamp before exp: at masked (post-EOS) positions old_logp is the
+            # sampled token's log-prob while new_logp indexes the pad token, so
+            # the difference is meaningless — adv=0 cancels it, but an
+            # unclamped exp can overflow to inf and inf*0 => NaN.
+            ratio = jnp.exp(jnp.clip(new_logp - ro["old_logp"], -20.0, 20.0))
             clipped = jnp.clip(ratio, 1.0 - p.clip_ratio, 1.0 + p.clip_ratio)
             pg = -jnp.minimum(ratio * adv, clipped * adv)
             pg_loss = _masked_mean(pg, m)

@@ -52,7 +52,8 @@ class TrainArgs:
     # training/ppo.py)
     reward_model: Optional[str] = None  # --stage rm run dir (storage/<uid>)
     ppo_epochs: int = 2
-    ppo_target: float = 0.0  # >0: adaptive KL controller target
+    ppo_target: float = 6.0  # >0: adaptive KL controller target (reference
+    # parser.py default 6.0 — adaptive KL is ON by default; pass 0 to disable)
     ppo_score_norm: bool = False
     init_kl_coef: float = 0.1
     ppo_gen_len: int = 64

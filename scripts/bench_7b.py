@@ -124,6 +124,61 @@ def _fast_host_init(cfg, init_params, seed: int):
     return jax.tree_util.tree_map_with_path(fill, abstract)
 
 
+def _synth_packed_init(cfg, init_params, seed: int):
+    """Direct synthesis of the QUANTIZED param tree — random packed nf4 bytes
+    with plausible scales, no bf16 materialization and no quantize pass.
+    Throughput-only: the compiled program is byte-identical to one fed real
+    quantized weights (same shapes/dtypes), so tokens/sec is unaffected, and
+    init drops from ~40 min (threefry+quantize) to seconds. Loss values are
+    meaningless; use the cache/--real_quant path for numerics."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.ops.quant import NF4_BLOCK, NF4_LAYOUT_VERSION
+
+    abstract = jax.eval_shape(
+        lambda k: init_params(cfg, k, dtype=jnp.bfloat16),
+        jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+
+    def fill(path, s):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "scale":
+            return jnp.ones(s.shape, s.dtype)
+        if name == "bias":
+            return jnp.zeros(s.shape, s.dtype)
+        w = rng.standard_normal(s.shape, dtype=np.float32) * 0.02
+        return jnp.asarray(w, s.dtype)
+
+    full = jax.tree_util.tree_map_with_path(fill, abstract)
+    # replace the stacked transformer kernels with synthesized packed leaves
+    from datatunerx_tpu.ops.quant import QUANT_KERNELS
+
+    layers = dict(full["layers"])
+    for kname in QUANT_KERNELS:
+        proj = dict(layers[kname])
+        kern = proj.pop("kernel")
+        L, in_dim, out_dim = kern.shape
+        del kern
+        nb = in_dim * out_dim // NF4_BLOCK
+        packed = rng.integers(0, 256, (L, nb * NF4_BLOCK // 2), dtype=np.uint8)
+        scale_q = rng.integers(1, 128, (L, nb), dtype=np.int8)
+        meta = np.stack(
+            [np.full((L,), 0.08 / 127.0, np.float32),
+             np.full((L,), NF4_LAYOUT_VERSION, np.float32)], axis=1)
+        proj["quant"] = {
+            "packed": jnp.asarray(packed),
+            "scale_q": jnp.asarray(scale_q),
+            "meta": jnp.asarray(meta),
+        }
+        layers[kname] = proj
+    full = dict(full)
+    full["layers"] = layers
+    return full
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=4)
@@ -136,6 +191,10 @@ def main():
                     help="quantized-params disk cache ('' disables): host "
                          "init+quantize of 7B costs ~40 min on one core, "
                          "variant sweeps shouldn't pay it twice")
+    ap.add_argument("--real_quant", action="store_true",
+                    help="on cache miss, do the real init+quantize pass "
+                         "instead of synthesizing packed bytes (slow; only "
+                         "needed when loss values must be meaningful)")
     args = ap.parse_args()
 
     import jax
@@ -158,10 +217,13 @@ def main():
     params = _load_cached(args.cache) if args.cache else None
     if params is None:
         with jax.default_device(cpu):
-            params = _fast_host_init(cfg, init_params, seed=0)
-            params = quantize_model_params(params, "int4")
+            if args.real_quant:
+                params = _fast_host_init(cfg, init_params, seed=0)
+                params = quantize_model_params(params, "int4")
+            else:
+                params = _synth_packed_init(cfg, init_params, seed=0)
             jax.block_until_ready(params)
-        if args.cache:
+        if args.cache and args.real_quant:
             _save_cached(args.cache, params)
     print(f"host init+quantize: {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)

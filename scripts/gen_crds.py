@@ -160,6 +160,30 @@ def crd_for(cls) -> dict:
     }
 
 
+def webhook_manifests() -> list:
+    """Deploy-time Mutating/ValidatingWebhookConfiguration manifests
+    (service-style clientConfig; the operator's cert manager injects the
+    caBundle at startup — reference cert-rotator behavior,
+    controller_manager.go:83-111). The test/dev path installs url-style
+    configs directly via operator.webhook_server.install_webhooks."""
+    from datatunerx_tpu.operator.webhook_server import webhook_configurations
+
+    configs = webhook_configurations(ca_bundle_b64="", base_url="")
+    for cfg in configs:
+        for wh in cfg["webhooks"]:
+            path = wh["clientConfig"]["url"].rsplit("/", 1)[-1]
+            wh["clientConfig"] = {
+                "service": {
+                    "name": "datatunerx-webhook-service",
+                    "namespace": "datatunerx-dev",
+                    "path": f"/{path}",
+                    "port": 9443,
+                },
+                "caBundle": "",  # injected by the operator at startup
+            }
+    return configs
+
+
 def main():
     os.makedirs(OUT_DIR, exist_ok=True)
     for cls in ALL_KINDS:
@@ -168,6 +192,10 @@ def main():
         with open(path, "w") as f:
             yaml.safe_dump(crd_for(cls), f, sort_keys=False)
         print(f"wrote {path}")
+    wh_path = os.path.join(os.path.dirname(OUT_DIR), "webhooks.yaml")
+    with open(wh_path, "w") as f:
+        yaml.safe_dump_all(webhook_manifests(), f, sort_keys=False)
+    print(f"wrote {wh_path}")
 
 
 if __name__ == "__main__":

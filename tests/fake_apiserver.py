@@ -14,6 +14,12 @@ controllers + KubeObjectStore depend on, with high fidelity:
 - label-selector list filtering (equality terms)
 - watch streams (?watch=true) with resourceVersion resume + initial-state
   ADDED events, one JSON object per line
+- admission webhooks: stored Mutating/ValidatingWebhookConfiguration objects
+  are honored on create/update — the fake POSTs admission.k8s.io/v1
+  AdmissionReview to the configured url over TLS (verified against the
+  config's caBundle), applies returned JSONPatches, and surfaces denials as
+  400s, exactly like a real apiserver front-running the operator's webhook
+  server
 
 Single global revision counter (etcd-style); resourceVersions are digit
 strings as on a real cluster.
@@ -174,6 +180,135 @@ class FakeKubeApiServer:
             "items": json.loads(json.dumps(items)),
         })
 
+    # ----------------------------------------------------------- admission
+    WEBHOOK_GROUP = "admissionregistration.k8s.io"
+
+    def _webhook_configs(self, plural_cfg: str):
+        """Stored webhook configurations of the given plural (cluster-scoped;
+        the fake namespaces them under whatever ns they were POSTed with)."""
+        with self.state.lock:
+            return [
+                json.loads(json.dumps(o))
+                for (g, p, _, _), o in self.state.objects.items()
+                if g == self.WEBHOOK_GROUP and p == plural_cfg
+            ]
+
+    @staticmethod
+    def _rules_match(rules, group, version, plural, operation) -> bool:
+        for rule in rules or []:
+            if operation not in (rule.get("operations") or []):
+                continue
+            if group not in (rule.get("apiGroups") or []):
+                continue
+            vs = rule.get("apiVersions") or []
+            if "*" not in vs and version not in vs:
+                continue
+            rs = rule.get("resources") or []
+            if "*" in rs or plural in rs:
+                return True
+        return False
+
+    @staticmethod
+    def _call_webhook(webhook: dict, review: dict) -> dict:
+        """POST an AdmissionReview to the webhook url, TLS-verified against
+        its caBundle. Returns the response dict; raises on transport error
+        (failurePolicy Fail semantics at the call site)."""
+        import base64
+        import ssl
+        import urllib.request
+
+        cc = webhook.get("clientConfig") or {}
+        url = cc.get("url")
+        if not url:
+            raise RuntimeError("only url-style clientConfig supported")
+        ca = cc.get("caBundle")
+        if ca:
+            # self-signed server certs carry only SAN entries for
+            # localhost/127.0.0.1 — keep hostname checking ON (the cert
+            # manager includes them), just trust the provided CA
+            ctx = ssl.create_default_context(
+                cadata=base64.b64decode(ca).decode())
+        else:
+            ctx = ssl.create_default_context()
+        req = urllib.request.Request(
+            url, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            return json.loads(resp.read()).get("response") or {}
+
+    @staticmethod
+    def _apply_json_patch(obj: dict, patch_b64: str) -> dict:
+        """RFC-6902 subset: add/replace (what defaulting webhooks emit)."""
+        import base64
+
+        ops = json.loads(base64.b64decode(patch_b64))
+        for op in ops:
+            if op.get("op") not in ("add", "replace"):
+                raise RuntimeError(f"unsupported patch op {op.get('op')!r}")
+            parts = [p.replace("~1", "/").replace("~0", "~")
+                     for p in op["path"].lstrip("/").split("/")]
+            node = obj
+            for p in parts[:-1]:
+                nxt = node.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[p] = nxt
+                node = nxt
+            node[parts[-1]] = op["value"]
+        return obj
+
+    def _admit(self, group, version, plural, ns, body, operation):
+        """Run the stored webhook chain (mutating first, then validating —
+        apiserver phase order). Returns (possibly-mutated body, None) or
+        (None, (code, reason, message)) on denial/failure."""
+        if group == self.WEBHOOK_GROUP:
+            return body, None  # configurations themselves are not gated
+        kind = body.get("kind") or plural[:-1].capitalize()
+        review_of = lambda obj: {  # noqa: E731
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": str(uuid.uuid4()),
+                "kind": {"group": group, "version": version, "kind": kind},
+                "resource": {"group": group, "version": version,
+                             "resource": plural},
+                "namespace": ns,
+                "operation": operation,
+                "object": obj,
+            },
+        }
+        for cfg_plural, phase in (
+            ("mutatingwebhookconfigurations", "mutate"),
+            ("validatingwebhookconfigurations", "validate"),
+        ):
+            for cfg in self._webhook_configs(cfg_plural):
+                for wh in cfg.get("webhooks") or []:
+                    if not self._rules_match(wh.get("rules"), group, version,
+                                             plural, operation):
+                        continue
+                    try:
+                        resp = self._call_webhook(wh, review_of(body))
+                    except Exception as e:  # noqa: BLE001
+                        if (wh.get("failurePolicy") or "Fail") == "Ignore":
+                            continue
+                        return None, (
+                            500, "InternalError",
+                            f'failed calling webhook "{wh.get("name")}": {e}')
+                    if not resp.get("allowed"):
+                        msg = ((resp.get("status") or {}).get("message")
+                               or "denied")
+                        return None, (
+                            400, "AdmissionDenied",
+                            f'admission webhook "{wh.get("name")}" denied '
+                            f"the request: {msg}")
+                    if phase == "mutate" and resp.get("patch"):
+                        try:
+                            body = self._apply_json_patch(body, resp["patch"])
+                        except Exception as e:  # noqa: BLE001
+                            return None, (500, "InternalError",
+                                          f"bad webhook patch: {e}")
+        return body, None
+
     def _post(self, h):
         r = self._parse(h.path)
         if not r or not r[2] or r[4]:
@@ -183,7 +318,14 @@ class FakeKubeApiServer:
         name = (body.get("metadata") or {}).get("name")
         if not name:
             return h._status_err(422, "Invalid", "metadata.name required")
-        ns = ns or (body.get("metadata") or {}).get("namespace") or "default"
+        if group == self.WEBHOOK_GROUP:
+            ns = None  # admissionregistration resources are cluster-scoped
+        else:
+            ns = (ns or (body.get("metadata") or {}).get("namespace")
+                  or "default")
+        body, denial = self._admit(group, version, plural, ns, body, "CREATE")
+        if denial is not None:
+            return h._status_err(*denial)
         st = self.state
         with st.lock:
             key = (group, plural, ns, name)
@@ -191,7 +333,8 @@ class FakeKubeApiServer:
                 return h._status_err(409, "AlreadyExists",
                                      f"{plural} {ns}/{name} already exists")
             meta = body.setdefault("metadata", {})
-            meta["namespace"] = ns
+            if ns is not None:
+                meta["namespace"] = ns
             meta.setdefault("uid", str(uuid.uuid4()))
             meta["creationTimestamp"] = _now()
             meta["generation"] = 1
@@ -209,6 +352,13 @@ class FakeKubeApiServer:
             return h._status_err(404, "NotFound", "bad update path")
         group, version, plural, ns, name, sub, _ = r
         body = h._read_body()
+        if sub is None:
+            # status writes bypass admission (real apiservers only call
+            # webhooks for subresources explicitly scoped to them)
+            body, denial = self._admit(group, version, plural, ns, body,
+                                       "UPDATE")
+            if denial is not None:
+                return h._status_err(*denial)
         st = self.state
         with st.lock:
             key = (group, plural, ns, name)
@@ -224,8 +374,16 @@ class FakeKubeApiServer:
             if sub == "status":
                 new["status"] = body.get("status", {})
             else:
-                # main resource write: spec + mutable metadata; status immutable
-                new["spec"] = body.get("spec", {})
+                # main resource write: data fields (spec, or e.g. `webhooks`
+                # on admissionregistration kinds) + mutable metadata; status
+                # immutable
+                for k in set(body) | set(new):
+                    if k in ("metadata", "status", "apiVersion", "kind"):
+                        continue
+                    if k in body:
+                        new[k] = body[k]
+                    else:
+                        new.pop(k, None)
                 m, bm = new["metadata"], body.get("metadata") or {}
                 for f in ("labels", "annotations", "finalizers",
                           "ownerReferences"):
@@ -233,7 +391,7 @@ class FakeKubeApiServer:
                         m[f] = bm[f]
                     else:
                         m.pop(f, None)
-                if new["spec"] != cur["spec"]:
+                if new.get("spec") != cur.get("spec"):
                     m["generation"] = int(m.get("generation", 1)) + 1
             if new == cur:
                 return h._send(200, cur)  # no-op: no rv bump, no event

@@ -45,6 +45,56 @@ def test_lru_unit():
     assert key3 is None
 
 
+def test_trie_deepest_wins_and_eviction_prunes():
+    pc = _PrefixCache(4)
+    pc.put(((5,), 0), {"cursor": 1})
+    pc.put(((5, 6), 0), {"cursor": 2})
+    pc.put(((5, 6, 7), 0), {"cursor": 3})
+    # deepest stored prefix wins over shallower ones on one descent
+    key, _ = pc.longest_prefix((5, 6, 7, 8, 9), 0)
+    assert key == ((5, 6, 7), 0)
+
+    # evicting the deep entry must fall back to the next-deepest, not to a
+    # stale trie terminal
+    pc.get(((5,), 0))
+    pc.get(((5, 6), 0))
+    pc.put(((1,), 0), {"cursor": 1})
+    pc.put(((2,), 0), {"cursor": 1})  # evicts (5,6,7) (LRU)
+    assert pc.get(((5, 6, 7), 0)) is None
+    key, _ = pc.longest_prefix((5, 6, 7, 8, 9), 0)
+    assert key == ((5, 6), 0)
+    assert pc.evictions == 1
+
+
+def test_trie_update_existing_key_keeps_single_terminal():
+    pc = _PrefixCache(2)
+    pc.put(((3, 4), 0), {"cursor": 2})
+    pc.put(((3, 4), 0), {"cursor": 9})  # update, not insert
+    assert len(pc) == 1
+    key, ent = pc.longest_prefix((3, 4, 5), 0)
+    assert key == ((3, 4), 0) and ent["cursor"] == 9
+    # updating must not have doubled trie terminals: one eviction clears it
+    pc.put(((8,), 0), {"cursor": 1})
+    pc.put(((9,), 0), {"cursor": 1})
+    assert pc.longest_prefix((3, 4, 5), 0) == (None, None)
+
+
+def test_trie_adapter_roots_isolated():
+    pc = _PrefixCache(4)
+    pc.put(((1, 2), 0), {"cursor": 2})
+    pc.put(((1, 2), 1), {"cursor": 2})
+    k0, _ = pc.longest_prefix((1, 2, 3), 0)
+    k1, _ = pc.longest_prefix((1, 2, 3), 1)
+    assert k0 == ((1, 2), 0) and k1 == ((1, 2), 1)
+    # evict adapter-0's entry; adapter-1's must survive the shared token path
+    pc.put(((7,), 0), {"cursor": 1})
+    pc.put(((8,), 0), {"cursor": 1})
+    pc.put(((9,), 0), {"cursor": 1})  # capacity 4: evicts ((1,2),0)
+    assert pc.longest_prefix((1, 2, 3), 0) == (None, None)
+    k1b, _ = pc.longest_prefix((1, 2, 3), 1)
+    assert k1b == ((1, 2), 1)
+
+
 # ------------------------------------------------- engine: reuse paths
 
 def test_exact_reuse_matches_cold(cold, cached):
@@ -128,6 +178,40 @@ def test_reuse_never_shrinks_decode_budget(cold, cached):
     # tokens; the engine must NOT have reused it
     assert cached.prefill_stats["reuse"] == before["reuse"]
     assert cached.prefill_stats["full"] == before["full"] + 1
+
+
+def test_metrics_endpoint_exposes_prefix_counters(cached):
+    """/metrics (serving server) surfaces hit/miss/eviction counters in
+    Prometheus text format (VERDICT r2 next-round #9)."""
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from datatunerx_tpu.serving import server as srv_mod
+
+    prompt = cached.tokenizer.encode("metrics endpoint probe")
+    cached.generate(prompt, max_new_tokens=2)
+    cached.generate(prompt, max_new_tokens=2)  # exact hit
+
+    old_engine = srv_mod.STATE.engine
+    srv_mod.STATE.engine = cached
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), srv_mod.Handler)
+    import threading
+
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+    finally:
+        srv.shutdown()
+        srv_mod.STATE.engine = old_engine
+    assert "dtx_serving_prefix_cache_hits_total" in body
+    assert "dtx_serving_prefix_cache_misses_total" in body
+    assert "dtx_serving_prefix_cache_evictions_total" in body
+    assert "dtx_serving_prefix_cache_entries" in body
+    hits = [line for line in body.splitlines()
+            if line.startswith("dtx_serving_prefix_cache_hits_total")]
+    assert hits and float(hits[0].split()[-1]) >= 1
 
 
 def test_reuse_does_not_corrupt_shared_entry(cached):

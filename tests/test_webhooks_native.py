@@ -1,0 +1,233 @@
+"""Kubernetes-native admission (VERDICT r2 next-round #4): the webhook rules
+in operator/webhooks.py served behind a TLS AdmissionReview endpoint, wired
+into the (fake) apiserver via Mutating/ValidatingWebhookConfiguration — so a
+direct apiserver create of an invalid CR is rejected with the webhook's
+message, exactly the guarantee the reference gets from its meta-server
+webhooks + cert-rotator (reference controller_manager.go:83-135).
+"""
+
+import datetime
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from datatunerx_tpu.operator.kubeclient import ApiError, KubeClient
+from datatunerx_tpu.operator.webhook_server import (
+    AdmissionWebhookServer,
+    CertManager,
+    install_webhooks,
+    review_mutate,
+    review_validate,
+    webhook_configurations,
+)
+from tests.fake_apiserver import FakeKubeApiServer
+
+GROUP_CORE = "core.datatunerx.io"
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeKubeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def webhook(tmp_path_factory):
+    certs = CertManager(str(tmp_path_factory.mktemp("wh-certs")),
+                        dns_names=["localhost", "127.0.0.1"])
+    srv = AdmissionWebhookServer(certs, host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _install(apiserver, webhook):
+    client = KubeClient(base_url=apiserver.url)
+    install_webhooks(client, webhook.certs.ca_bundle_b64(),
+                     f"https://localhost:{webhook.port}")
+    return client
+
+
+def _hp(name, params):
+    return {
+        "apiVersion": f"{GROUP_CORE}/v1beta1",
+        "kind": "Hyperparameter",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"parameters": params},
+    }
+
+
+# ------------------------------------------------------------ cert manager
+
+def test_cert_manager_generates_and_reports_rotation(tmp_path):
+    cm = CertManager(str(tmp_path / "certs"))
+    assert cm.needs_rotation()  # nothing on disk yet
+    assert cm.ensure() is True
+    assert not cm.needs_rotation()
+    assert cm.ensure() is False  # idempotent while valid
+    assert cm.ca_bundle_b64()
+
+    # a cert inside the refresh margin rotates
+    short = CertManager(str(tmp_path / "short"), validity_days=5,
+                        refresh_margin_days=30)
+    assert short.ensure() is True
+    assert short.needs_rotation()  # 5d validity < 30d margin
+    exp1 = short._expiry()
+    assert short.ensure() is True  # regenerated
+    assert short._expiry() >= exp1
+    assert isinstance(exp1, datetime.datetime)
+
+
+# --------------------------------------------------------- review handlers
+
+def test_review_validate_denies_bad_dropout():
+    resp = review_validate({
+        "uid": "u1",
+        "kind": {"kind": "Hyperparameter"},
+        "object": _hp("h", {"loRA_Dropout": "2.0"}),
+    })
+    assert resp["allowed"] is False
+    assert "loRA_Dropout" in resp["status"]["message"]
+    assert resp["uid"] == "u1"
+
+
+def test_review_mutate_emits_defaulting_patch():
+    resp = review_mutate({
+        "uid": "u2",
+        "kind": {"kind": "Hyperparameter"},
+        "object": _hp("h", {"scheduler": "linear"}),
+    })
+    assert resp["allowed"] is True
+    import base64
+
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    paths = {op["path"] for op in ops}
+    assert "/spec/parameters/optimizer" in paths  # defaulted
+    assert "/spec/parameters/scheduler" not in paths  # already set
+
+
+# ------------------------------------------- end-to-end via fake apiserver
+
+def test_direct_apiserver_create_of_invalid_cr_rejected(apiserver, webhook):
+    client = _install(apiserver, webhook)
+    with pytest.raises(ApiError) as ei:
+        client.request(
+            "POST",
+            f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/hyperparameters",
+            body=_hp("bad", {"loRA_Dropout": "2.0"}),
+        )
+    assert ei.value.status == 400
+    assert "admission webhook" in ei.value.body
+    assert "loRA_Dropout" in ei.value.body
+    # nothing persisted
+    with pytest.raises(ApiError):
+        client.request(
+            "GET",
+            f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/"
+            "hyperparameters/bad",
+        )
+
+
+def test_valid_cr_created_with_defaults_applied(apiserver, webhook):
+    client = _install(apiserver, webhook)
+    created = client.request(
+        "POST",
+        f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/hyperparameters",
+        body=_hp("good", {"scheduler": "linear"}),
+    )
+    p = created["spec"]["parameters"]
+    assert p["scheduler"] == "linear"          # user value kept
+    assert p["optimizer"] == "adamw"           # defaulted via JSONPatch
+    assert p["loRA_R"] == "8"
+
+
+def test_update_also_gated(apiserver, webhook):
+    client = _install(apiserver, webhook)
+    created = client.request(
+        "POST",
+        f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/hyperparameters",
+        body=_hp("upd", {}),
+    )
+    created["spec"]["parameters"]["warmupRatio"] = "7.5"
+    with pytest.raises(ApiError) as ei:
+        client.request(
+            "PUT",
+            f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/"
+            "hyperparameters/upd",
+            body=created,
+        )
+    assert ei.value.status == 400
+    assert "warmupRatio" in ei.value.body
+
+
+def test_unrelated_resources_not_gated(apiserver, webhook):
+    client = _install(apiserver, webhook)
+    client.request(
+        "POST",
+        "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets",
+        body={"apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+              "metadata": {"name": "j1"}, "spec": {}},
+    )  # no webhook rules match → no gating, no error
+
+
+def test_invalid_dataset_rejected_via_webhook(apiserver, webhook):
+    client = _install(apiserver, webhook)
+    with pytest.raises(ApiError) as ei:
+        client.request(
+            "POST",
+            "/apis/extension.datatunerx.io/v1beta1/namespaces/default/"
+            "datasets",
+            body={
+                "apiVersion": "extension.datatunerx.io/v1beta1",
+                "kind": "Dataset",
+                "metadata": {"name": "d"},
+                "spec": {"datasetMetadata": {"datasetInfo": {}}},
+            },
+        )
+    assert ei.value.status == 400
+    assert "subsets" in ei.value.body
+
+
+def test_cert_rotation_repatches_cabundle(apiserver, tmp_path):
+    """Rotation regenerates the CA, reloads TLS in place, and the re-patched
+    caBundle keeps admission working — the cert-rotator loop end-to-end."""
+    certs = CertManager(str(tmp_path / "rot"), validity_days=365,
+                        dns_names=["localhost", "127.0.0.1"])
+    srv = AdmissionWebhookServer(certs, host="127.0.0.1", port=0).start()
+    try:
+        client = KubeClient(base_url=apiserver.url)
+        base = f"https://localhost:{srv.port}"
+        install_webhooks(client, certs.ca_bundle_b64(), base)
+
+        # force rotation: shrink validity window check
+        certs.refresh_margin = datetime.timedelta(days=9999)
+        assert certs.ensure() is True
+        srv._ssl_ctx.load_cert_chain(certs.cert_path, certs.key_path)
+        install_webhooks(client, certs.ca_bundle_b64(), base)
+
+        # admission still enforced under the rotated chain
+        with pytest.raises(ApiError) as ei:
+            client.request(
+                "POST",
+                f"/apis/{GROUP_CORE}/v1beta1/namespaces/default/"
+                "hyperparameters",
+                body=_hp("rot-bad", {"loRA_Dropout": "3.0"}),
+            )
+        assert ei.value.status == 400
+    finally:
+        srv.stop()
+
+
+def test_webhook_configuration_shape():
+    cfgs = webhook_configurations("Q0E=", "https://localhost:9443")
+    kinds = [c["kind"] for c in cfgs]
+    assert kinds == ["MutatingWebhookConfiguration",
+                     "ValidatingWebhookConfiguration"]
+    val = cfgs[1]["webhooks"][0]
+    assert val["failurePolicy"] == "Fail"
+    assert val["clientConfig"]["caBundle"] == "Q0E="
+    covered = {r for rule in val["rules"] for r in rule["resources"]}
+    assert covered == {"finetunejobs", "finetuneexperiments", "llms",
+                       "hyperparameters", "datasets"}
