@@ -10,6 +10,10 @@ Talks to the operator's REST API (operator/apiserver.py):
   dtx delete <kind> <name> [-n ns]
   dtx status <finetunejob-name>        condensed pipeline view
   dtx logs <finetune-name>             trainer log tail (local backend)
+  dtx install [--kube-url URL]         one-command install: CRDs + RBAC +
+                                       operator Deployment + config
+                                       (env → ConfigMap/Secret); --dry-run
+                                       prints the manifests instead
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.
@@ -183,6 +187,44 @@ def cmd_logs(args):
     print(resp.get("log", ""), end="")
 
 
+def cmd_install(args):
+    """One-command install (reference dtx-ctl + Helm, INSTALL.md:26-48)."""
+    from datatunerx_tpu.operator.install import install, render_install_manifests
+
+    env = {}
+    for item in args.set or []:
+        key, sep, val = item.partition("=")
+        if not sep:
+            sys.exit(f"error: --set expects KEY=VALUE, got {item!r}")
+        env[key] = val
+
+    kw = dict(
+        namespace=args.namespace,
+        image=args.image,
+        env=env,
+        storage_path=args.storage_path,
+        leader_elect=args.leader_elect,
+        replicas=args.replicas,
+        include_webhooks=not args.no_webhooks,
+    )
+    if args.dry_run:
+        docs = render_install_manifests(**kw)
+        try:
+            import yaml
+
+            print(yaml.safe_dump_all(docs, sort_keys=False), end="")
+        except ImportError:
+            print(json.dumps(docs, indent=1))
+        return
+    from datatunerx_tpu.operator.kubeclient import KubeClient
+
+    client = KubeClient(base_url=args.kube_url,
+                        namespace=args.namespace)
+    ns = kw.pop("namespace")
+    for line in install(client, namespace=ns, **kw):
+        print(line)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dtx")
     p.add_argument("--server", default=os.environ.get("DTX_SERVER",
@@ -215,6 +257,28 @@ def main(argv=None):
     lp.add_argument("name")
     lp.add_argument("-n", "--namespace", default="default")
     lp.set_defaults(fn=cmd_logs)
+
+    ip = sub.add_parser(
+        "install",
+        help="install CRDs + RBAC + operator Deployment + config "
+             "(reference dtx-ctl/Helm flow, INSTALL.md:26-48)")
+    ip.add_argument("-n", "--namespace", default="datatunerx-dev")
+    ip.add_argument("--image", default="datatunerx-tpu/operator:latest")
+    ip.add_argument("--storage-path", default="/storage")
+    ip.add_argument("--set", action="append", metavar="KEY=VALUE",
+                    help="operator env config; credential keys "
+                         "(S3_ACCESS_KEY, S3_SECRET_KEY, REGISTRY_USER, "
+                         "REGISTRY_PASSWORD) land in a Secret, the rest in "
+                         "a ConfigMap")
+    ip.add_argument("--leader-elect", action="store_true")
+    ip.add_argument("--replicas", type=int, default=1)
+    ip.add_argument("--no-webhooks", action="store_true",
+                    help="skip the admission webhook Service + configurations")
+    ip.add_argument("--dry-run", action="store_true",
+                    help="print the manifests instead of applying")
+    ip.add_argument("--kube-url", default=os.environ.get("DTX_KUBE_URL"),
+                    help="apiserver base URL (default: in-cluster config)")
+    ip.set_defaults(fn=cmd_install)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -122,8 +122,11 @@ def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
     return out
 
 
-POS_SENTINEL = jnp.int32(2**30)  # marks invalid/pad cache slots: the causal
-# check kv_pos <= q_pos then masks them with no separate validity plumbing
+# Marks invalid/pad cache slots: the causal check kv_pos <= q_pos then masks
+# them with no separate validity plumbing. A plain int (NOT jnp.int32): a
+# module-level device array would initialize the XLA backend at import time,
+# breaking jax.distributed.initialize for multi-host trainer processes.
+POS_SENTINEL = 2**30
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,

@@ -110,15 +110,29 @@ class FakeServingBackend:
 # ---------------------------------------------------------- local process
 
 class LocalProcessBackend:
-    """Runs the trainer CLI as a subprocess per job; completion detected via
-    process exit + the completion manifest (training/checkpoint.py)."""
+    """Runs the trainer CLI as subprocess(es) per job; completion detected via
+    process exit + the completion manifest (training/checkpoint.py).
+
+    ``spec["num_hosts"] > 1`` spawns that many processes wired together with
+    the same DTX_* env contract the JobSet manifests set (DTX_COORDINATOR_
+    ADDRESS/NUM_PROCESSES/PROCESS_ID, parallel/distributed.py) — the local
+    backend is then a faithful multi-host simulator: one process per "host",
+    jax.distributed bootstrap, cross-process collectives over local gRPC."""
 
     def __init__(self, workdir: str, extra_env: Optional[dict] = None):
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.extra_env = extra_env or {}
-        self._procs: Dict[str, subprocess.Popen] = {}
+        self._procs: Dict[str, list] = {}  # job -> [Popen per host]
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
 
     def submit(self, name: str, spec: dict) -> None:
         with self._lock:
@@ -131,40 +145,66 @@ class LocalProcessBackend:
             ]
             with open(os.path.join(jobdir, "cmd.txt"), "w") as f:
                 f.write(shlex.join(argv))
-            log = open(os.path.join(jobdir, "log.txt"), "w")
             env = dict(os.environ)
             env["PYTHONPATH"] = _pkg_root() + os.pathsep + env.get("PYTHONPATH", "")
             env.update(self.extra_env)
             env.update(spec.get("env", {}))
-            self._procs[name] = subprocess.Popen(
-                argv, cwd=jobdir, stdout=log, stderr=subprocess.STDOUT, env=env
-            )
+
+            hosts = max(1, int(spec.get("num_hosts", 1) or 1))
+            procs = []
+            if hosts == 1:
+                log = open(os.path.join(jobdir, "log.txt"), "w")
+                procs.append(subprocess.Popen(
+                    argv, cwd=jobdir, stdout=log, stderr=subprocess.STDOUT,
+                    env=env,
+                ))
+            else:
+                coord = f"127.0.0.1:{self._free_port()}"
+                for pid in range(hosts):
+                    henv = dict(env)
+                    henv.update({
+                        "DTX_COORDINATOR_ADDRESS": coord,
+                        "DTX_NUM_PROCESSES": str(hosts),
+                        "DTX_PROCESS_ID": str(pid),
+                    })
+                    # pod-0 writes checkpoints/manifest; others log beside it
+                    log_name = "log.txt" if pid == 0 else f"log.{pid}.txt"
+                    log = open(os.path.join(jobdir, log_name), "w")
+                    procs.append(subprocess.Popen(
+                        argv, cwd=jobdir, stdout=log,
+                        stderr=subprocess.STDOUT, env=henv,
+                    ))
+            self._procs[name] = procs
 
     def status(self, name: str) -> str:
         with self._lock:
-            proc = self._procs.get(name)
-        if proc is None:
+            procs = self._procs.get(name)
+        if procs is None:
             return "NotFound"
-        rc = proc.poll()
-        if rc is None:
+        rcs = [p.poll() for p in procs]
+        if any(rc not in (None, 0) for rc in rcs):
+            return "Failed"  # JobSet failure semantics: any host failing fails the job
+        if any(rc is None for rc in rcs):
             return "Running"
-        return "Succeeded" if rc == 0 else "Failed"
+        return "Succeeded"
 
     def delete(self, name: str) -> None:
         with self._lock:
-            proc = self._procs.pop(name, None)
-        if proc is not None and proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+            procs = self._procs.pop(name, None)
+        for proc in procs or []:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
 
     def has_active_jobs(self) -> bool:
         """True while any trainer subprocess is live (the device health probe
         must not contend with a running job for the single-client TPU)."""
         with self._lock:
-            return any(p.poll() is None for p in self._procs.values())
+            return any(p.poll() is None
+                       for procs in self._procs.values() for p in procs)
 
     def metrics_series(self, name: str, max_points: int = 2000) -> dict:
         """Parsed trainer/eval jsonl curves for the UI (the data the reference

@@ -42,6 +42,7 @@ PARAMETER_KEYS = (
     # TPU additions
     "meshShape", "loRATarget", "packSequences", "attention",
     "rewardModel",  # --stage ppo: rm-stage run dir under the storage path
+    "quantImpl",  # pallas (fused kernels, default) | xla (dequant+dot)
 )
 
 
@@ -171,6 +172,8 @@ def build_trainer_args(
         args += ["--mesh", str(ms)]
     if parameters.get("attention"):
         args += ["--attention", str(parameters["attention"])]
+    if parameters.get("quantImpl"):
+        args += ["--quant_impl", str(parameters["quantImpl"])]
     if _truthy(parameters.get("packSequences")):
         args += ["--pack_sequences", "true"]
 
@@ -216,6 +219,9 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         # serve-time base quantization (serving/engine.py): fit big models on
         # one chip's HBM; TPU addition to ServeConfig
         "quantization": serve_cfg.get("quantization", ""),
+        # continuous-batching slot count (serving/server.py --slots; 1 =
+        # single-request engine); TPU addition to ServeConfig
+        "slots": serve_cfg.get("slots"),
     }
 
 

@@ -1,0 +1,264 @@
+"""``dtx install`` — one-command install bundle (reference ``dtx-ctl``'s
+Helm-driven install, reference INSTALL.md:26-48,115-144).
+
+Renders the complete operator install as a list of manifests — Namespace,
+the 8 CRDs, RBAC (ServiceAccount + ClusterRole + ClusterRoleBinding),
+environment config (non-secret keys → ConfigMap, credentials → Secret),
+webhook Service + configurations, and the controller-manager Deployment —
+and optionally applies them to an apiserver, create-or-update style.
+
+The env split mirrors the reference's viper config surface
+(pkg/config/config.go:7-27): S3/registry credentials land in the Secret,
+everything else in the ConfigMap; both are envFrom'd into the Deployment.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+from datatunerx_tpu.operator.crdgen import all_crds, webhook_manifests
+
+# Credential-ish env keys (reference config.go S3 + registry blocks) go to the
+# Secret; anything else is plain config.
+SECRET_KEYS = {
+    "S3_ACCESS_KEY", "S3_SECRET_KEY", "REGISTRY_USER", "REGISTRY_PASSWORD",
+    "DTX_API_TOKEN",
+}
+
+APP = "datatunerx-tpu-controller-manager"
+
+
+def _rbac(namespace: str) -> List[dict]:
+    crd_rules = [
+        {"apiGroups": [g],
+         "resources": rs,
+         "verbs": ["create", "delete", "get", "list", "patch", "update",
+                   "watch"]}
+        for g, rs in (
+            ("finetune.datatunerx.io",
+             ["finetunes", "finetunejobs", "finetuneexperiments"]),
+            ("core.datatunerx.io",
+             ["llms", "hyperparameters", "llmcheckpoints"]),
+            ("extension.datatunerx.io", ["datasets", "scorings"]),
+        )
+    ] + [
+        {"apiGroups": [g],
+         "resources": [f"{r}/status" for r in rs] +
+                      [f"{r}/finalizers" for r in rs],
+         "verbs": ["get", "patch", "update"]}
+        for g, rs in (
+            ("finetune.datatunerx.io",
+             ["finetunes", "finetunejobs", "finetuneexperiments"]),
+            ("core.datatunerx.io",
+             ["llms", "hyperparameters", "llmcheckpoints"]),
+            ("extension.datatunerx.io", ["datasets", "scorings"]),
+        )
+    ] + [
+        # workload + coordination surface (JobSets, serving Deployments,
+        # leader-election Leases, webhook config caBundle injection)
+        {"apiGroups": ["jobset.x-k8s.io"], "resources": ["jobsets"],
+         "verbs": ["create", "delete", "get", "list", "patch", "update",
+                   "watch"]},
+        {"apiGroups": ["apps"], "resources": ["deployments"],
+         "verbs": ["create", "delete", "get", "list", "patch", "update",
+                   "watch"]},
+        {"apiGroups": [""], "resources": ["services", "events"],
+         "verbs": ["create", "delete", "get", "list", "patch", "update",
+                   "watch"]},
+        {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+         "verbs": ["create", "get", "update"]},
+        {"apiGroups": ["admissionregistration.k8s.io"],
+         "resources": ["validatingwebhookconfigurations",
+                       "mutatingwebhookconfigurations"],
+         "verbs": ["get", "update", "patch", "create"]},
+    ]
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": APP, "namespace": namespace}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "datatunerx-tpu-manager-role"},
+         "rules": crd_rules},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": "datatunerx-tpu-manager-rolebinding"},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole",
+                     "name": "datatunerx-tpu-manager-role"},
+         "subjects": [{"kind": "ServiceAccount", "name": APP,
+                       "namespace": namespace}]},
+    ]
+
+
+def _deployment(namespace: str, image: str, storage_path: str,
+                leader_elect: bool, replicas: int) -> dict:
+    args = [
+        "--backend=kube",
+        "--metrics-bind-address=:8080",
+        "--health-probe-bind-address=:8081",
+        "--webhook-bind-address=:9443",
+        "--webhook-cert-dir=/var/lib/dtx/webhook-certs",
+        f"--kube-namespace={namespace}",
+        f"--storage-path={storage_path}",
+    ]
+    if leader_elect:
+        args.append("--leader-elect=true")
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": APP, "namespace": namespace,
+                     "labels": {"app": APP}},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": APP}},
+            "template": {
+                "metadata": {"labels": {"app": APP}},
+                "spec": {
+                    "serviceAccountName": APP,
+                    "containers": [{
+                        "name": "manager",
+                        "image": image,
+                        "command": ["python", "-m",
+                                    "datatunerx_tpu.operator.manager"],
+                        "args": args,
+                        "envFrom": [
+                            {"configMapRef": {"name": "dtx-config"}},
+                            {"secretRef": {"name": "dtx-credentials",
+                                           "optional": True}},
+                        ],
+                        "ports": [
+                            {"containerPort": 8080, "name": "api-metrics"},
+                            {"containerPort": 8081, "name": "probes"},
+                            {"containerPort": 9443, "name": "webhooks"},
+                        ],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/readyz", "port": 8081}},
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8081}},
+                        "volumeMounts": [
+                            {"name": "webhook-certs",
+                             "mountPath": "/var/lib/dtx/webhook-certs"},
+                            {"name": "storage", "mountPath": storage_path},
+                        ],
+                    }],
+                    "volumes": [
+                        # a shared Secret mount would pin one CA across HA
+                        # replicas; emptyDir suffices at replicas=1 (the
+                        # operator re-injects its caBundle at startup)
+                        {"name": "webhook-certs", "emptyDir": {}},
+                        {"name": "storage",
+                         "persistentVolumeClaim":
+                             {"claimName": "dtx-storage"}},
+                    ],
+                },
+            },
+        },
+    }
+
+
+def render_install_manifests(
+    namespace: str = "datatunerx-dev",
+    image: str = "datatunerx-tpu/operator:latest",
+    env: Optional[Dict[str, str]] = None,
+    storage_path: str = "/storage",
+    leader_elect: bool = False,
+    replicas: int = 1,
+    include_webhooks: bool = True,
+) -> List[dict]:
+    env = dict(env or {})
+    env.setdefault("STORAGE_PATH", storage_path)
+    config = {k: v for k, v in env.items() if k not in SECRET_KEYS}
+    secrets = {k: v for k, v in env.items() if k in SECRET_KEYS}
+
+    docs: List[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": namespace}},
+    ]
+    docs += all_crds()
+    docs += _rbac(namespace)
+    docs.append({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "dtx-config", "namespace": namespace},
+                 "data": config})
+    if secrets:
+        docs.append({"apiVersion": "v1", "kind": "Secret",
+                     "metadata": {"name": "dtx-credentials",
+                                  "namespace": namespace},
+                     "type": "Opaque", "stringData": secrets})
+    if include_webhooks:
+        docs.append({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "datatunerx-webhook-service",
+                         "namespace": namespace},
+            "spec": {"selector": {"app": APP},
+                     "ports": [{"port": 9443, "targetPort": 9443}]},
+        })
+        docs += webhook_manifests(namespace)
+    docs.append(_deployment(namespace, image, storage_path, leader_elect,
+                            replicas))
+    return docs
+
+
+# ----------------------------------------------------------------- applying
+
+# kind → (group, version, plural, cluster_scoped)
+_KIND_ROUTES: Dict[str, Tuple[str, str, str, bool]] = {
+    "Namespace": ("", "v1", "namespaces", True),
+    "ServiceAccount": ("", "v1", "serviceaccounts", False),
+    "ConfigMap": ("", "v1", "configmaps", False),
+    "Secret": ("", "v1", "secrets", False),
+    "Service": ("", "v1", "services", False),
+    "CustomResourceDefinition": (
+        "apiextensions.k8s.io", "v1", "customresourcedefinitions", True),
+    "ClusterRole": ("rbac.authorization.k8s.io", "v1", "clusterroles", True),
+    "ClusterRoleBinding": (
+        "rbac.authorization.k8s.io", "v1", "clusterrolebindings", True),
+    "Deployment": ("apps", "v1", "deployments", False),
+    "MutatingWebhookConfiguration": (
+        "admissionregistration.k8s.io", "v1",
+        "mutatingwebhookconfigurations", True),
+    "ValidatingWebhookConfiguration": (
+        "admissionregistration.k8s.io", "v1",
+        "validatingwebhookconfigurations", True),
+}
+
+
+def _path_for(doc: dict, namespace: str, name: Optional[str] = None) -> str:
+    kind = doc["kind"]
+    group, version, plural, cluster = _KIND_ROUTES[kind]
+    prefix = "/api/v1" if not group else f"/apis/{group}/{version}"
+    p = prefix
+    if not cluster:
+        ns = (doc.get("metadata") or {}).get("namespace") or namespace
+        p += f"/namespaces/{ns}"
+    p += f"/{plural}"
+    if name:
+        p += f"/{name}"
+    return p
+
+
+def apply_manifest(client, doc: dict, namespace: str = "default") -> str:
+    """Create-or-update one manifest through a KubeClient. Returns
+    'created'/'configured'."""
+    from datatunerx_tpu.operator.kubeclient import ApiError
+
+    name = doc["metadata"]["name"]
+    try:
+        client.request("POST", _path_for(doc, namespace), body=doc)
+        return "created"
+    except ApiError as e:
+        if e.status != 409:
+            raise
+    cur = client.request("GET", _path_for(doc, namespace, name))
+    upd = copy.deepcopy(doc)
+    upd.setdefault("metadata", {})["resourceVersion"] = (
+        cur.get("metadata", {}).get("resourceVersion"))
+    client.request("PUT", _path_for(doc, namespace, name), body=upd)
+    return "configured"
+
+
+def install(client, namespace: str = "datatunerx-dev", **render_kw) -> List[str]:
+    """Apply the full bundle; returns 'kind/name action' lines."""
+    out = []
+    for doc in render_install_manifests(namespace=namespace, **render_kw):
+        action = apply_manifest(client, doc, namespace=namespace)
+        out.append(f"{doc['kind'].lower()}/{doc['metadata']['name']} {action}")
+    return out

@@ -51,6 +51,8 @@ class LocalServingBackend:
                 "--port", str(port),
                 "--quantization", spec.get("quantization") or "",
             ]
+            if spec.get("slots"):
+                argv += ["--slots", str(spec["slots"])]
             from datatunerx_tpu.operator.backends import _pkg_root
 
             env = dict(os.environ)

@@ -30,6 +30,9 @@ class TrainArgs:
     quantization: Optional[str] = None  # int4 | int8
     quantization_type: str = "nf4"  # fp4 | nf4
     double_quantization: bool = True
+    quant_impl: str = "pallas"  # pallas (fused kernels) | xla (dequant+dot);
+    # TPU addition — replaces bitsandbytes' kernel selection (reference
+    # train.py:224-234 always uses bnb CUDA kernels when quantized)
     rope_scaling: Optional[str] = None  # linear | dynamic
     rope_scaling_factor: float = 2.0
     flash_attn: bool = False
@@ -129,6 +132,8 @@ class TrainArgs:
             raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
         if self.quantization not in (None, "int4", "int8"):
             raise ValueError("We only accept int4 or int8 quantization.")
+        if self.quant_impl not in ("xla", "pallas"):
+            raise ValueError("quant_impl must be 'pallas' or 'xla'")
         if self.rope_scaling not in (None, "linear", "dynamic"):
             raise ValueError(f"invalid --rope_scaling {self.rope_scaling}")
         if self.train_path is None and self.export_dir is None:

@@ -65,6 +65,7 @@ def run(args: TrainArgs) -> dict:
                 "(quantized base weights are frozen, as with bitsandbytes+peft)"
             )
         overrides["quantization"] = args.quantization
+        overrides["quant_impl"] = args.quant_impl
     dtype = jnp.bfloat16 if args.bf16 else np.float32
     cfg, params, tokenizer = load_model_and_tokenizer(
         args.model_name_or_path, dtype=dtype, seed=args.seed,

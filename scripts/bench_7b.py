@@ -185,7 +185,11 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--attention", default="flash", choices=["xla", "flash"])
-    ap.add_argument("--quant_impl", default="xla", choices=["xla", "pallas"])
+    ap.add_argument("--quant_impl", default="pallas",
+                    choices=["xla", "pallas"],
+                    help="pallas = fused nf4 kernels fwd+bwd (weights stay "
+                         "packed in HBM; round-3 default), xla = dequant+dot "
+                         "(the round-2 709 tok/s/chip path)")
     ap.add_argument("--remat", default="full", choices=["none", "dots", "full"])
     ap.add_argument("--cache", default="/tmp/bench7b_params.npz",
                     help="quantized-params disk cache ('' disables): host "

@@ -134,7 +134,9 @@ class FakeKubeApiServer:
             group, version, rest = parts[1], parts[2], parts[3:]
         else:
             return None
-        if rest[:1] == ["namespaces"] and len(rest) >= 2:
+        # "/namespaces/<x>" alone addresses the Namespace RESOURCE itself;
+        # it is only a scoping prefix when a plural follows
+        if rest[:1] == ["namespaces"] and len(rest) >= 3:
             ns, rest = rest[1], rest[2:]
         if rest:
             plural, rest = rest[0], rest[1:]
@@ -182,6 +184,12 @@ class FakeKubeApiServer:
 
     # ----------------------------------------------------------- admission
     WEBHOOK_GROUP = "admissionregistration.k8s.io"
+    # plurals stored without a namespace, as on a real cluster
+    CLUSTER_SCOPED = {
+        "namespaces", "customresourcedefinitions", "clusterroles",
+        "clusterrolebindings", "mutatingwebhookconfigurations",
+        "validatingwebhookconfigurations", "priorityclasses",
+    }
 
     def _webhook_configs(self, plural_cfg: str):
         """Stored webhook configurations of the given plural (cluster-scoped;
@@ -318,8 +326,8 @@ class FakeKubeApiServer:
         name = (body.get("metadata") or {}).get("name")
         if not name:
             return h._status_err(422, "Invalid", "metadata.name required")
-        if group == self.WEBHOOK_GROUP:
-            ns = None  # admissionregistration resources are cluster-scoped
+        if plural in self.CLUSTER_SCOPED:
+            ns = None
         else:
             ns = (ns or (body.get("metadata") or {}).get("namespace")
                   or "default")

@@ -1,0 +1,117 @@
+"""``dtx install`` (VERDICT r2 next-round #6): one command rendering CRDs +
+RBAC + operator Deployment + env config, parity with the reference's
+dtx-ctl/Helm install flow (reference INSTALL.md:26-48,115-144). The rendered
+bundle must apply cleanly against the fake apiserver, idempotently."""
+
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+from datatunerx_tpu.cli import main as cli_main
+from datatunerx_tpu.operator.install import (
+    apply_manifest,
+    install,
+    render_install_manifests,
+)
+from datatunerx_tpu.operator.kubeclient import KubeClient
+from tests.fake_apiserver import FakeKubeApiServer
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeKubeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+def test_render_bundle_shape():
+    docs = render_install_manifests(
+        namespace="dtx-ns",
+        env={"S3_ACCESS_KEY": "ak", "S3_SECRET_KEY": "sk",
+             "S3_ENDPOINT": "http://minio:9000", "STORAGE_PATH": "/st"},
+    )
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("CustomResourceDefinition") == 8
+    for required in ("Namespace", "ServiceAccount", "ClusterRole",
+                     "ClusterRoleBinding", "ConfigMap", "Secret", "Service",
+                     "MutatingWebhookConfiguration",
+                     "ValidatingWebhookConfiguration", "Deployment"):
+        assert required in kinds, f"missing {required}"
+    # credentials in the Secret, plain config in the ConfigMap
+    secret = next(d for d in docs if d["kind"] == "Secret")
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    assert set(secret["stringData"]) == {"S3_ACCESS_KEY", "S3_SECRET_KEY"}
+    assert cm["data"]["S3_ENDPOINT"] == "http://minio:9000"
+    assert "S3_SECRET_KEY" not in cm["data"]
+    # deployment wires both via envFrom and runs the kube backend
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert any("configMapRef" in e for e in c["envFrom"])
+    assert "--backend=kube" in c["args"]
+    assert dep["metadata"]["namespace"] == "dtx-ns"
+
+
+def test_install_applies_cleanly_and_idempotently(apiserver):
+    client = KubeClient(base_url=apiserver.url)
+    lines = install(client, namespace="dtx-ns",
+                    env={"S3_ACCESS_KEY": "ak"})
+    assert all(line.endswith("created") for line in lines), lines
+
+    # CRDs present, cluster-scoped
+    crds = client.request(
+        "GET", "/apis/apiextensions.k8s.io/v1/customresourcedefinitions")
+    names = {i["metadata"]["name"] for i in crds["items"]}
+    assert "finetunejobs.finetune.datatunerx.io" in names
+    assert "datasets.extension.datatunerx.io" in names
+
+    # second run: everything updates in place (create-or-update)
+    lines2 = install(client, namespace="dtx-ns",
+                     env={"S3_ACCESS_KEY": "ak2"})
+    assert all(line.endswith("configured") for line in lines2), lines2
+    sec = client.request(
+        "GET", "/api/v1/namespaces/dtx-ns/secrets/dtx-credentials")
+    assert sec["stringData"]["S3_ACCESS_KEY"] == "ak2"
+
+
+def test_dry_run_output_applies_against_fake(apiserver, capsys):
+    """The --dry-run manifests are the install: applying its output must
+    produce the same objects (VERDICT done-criterion)."""
+    rc = cli_main(["install", "--dry-run", "-n", "dtx-ns",
+                   "--set", "S3_ACCESS_KEY=k", "--set", "STORAGE_PATH=/st"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import yaml
+
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    client = KubeClient(base_url=apiserver.url)
+    for doc in docs:
+        assert apply_manifest(client, doc, namespace="dtx-ns") == "created"
+    dep = client.request(
+        "GET",
+        "/apis/apps/v1/namespaces/dtx-ns/deployments/"
+        "datatunerx-tpu-controller-manager")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["command"][0] == \
+        "python"
+
+
+def test_cli_install_against_fake_server(apiserver, capsys):
+    rc = cli_main(["install", "-n", "dtx-ns", "--kube-url", apiserver.url,
+                   "--set", "S3_ACCESS_KEY=k"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "deployment/datatunerx-tpu-controller-manager created" in out
+    assert "namespace/dtx-ns created" in out
+
+
+def test_webhook_service_routes_to_operator():
+    docs = render_install_manifests(namespace="nsx")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    vwc = next(d for d in docs
+               if d["kind"] == "ValidatingWebhookConfiguration")
+    cc = vwc["webhooks"][0]["clientConfig"]["service"]
+    assert cc["name"] == svc["metadata"]["name"]
+    assert cc["namespace"] == "nsx"
+    assert svc["spec"]["ports"][0]["port"] == 9443

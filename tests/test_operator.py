@@ -485,3 +485,25 @@ def test_finetune_bounded_retry_with_resume(world):
     mgr.enqueue("Finetune", "default", "run-exhaust")
     mgr.run_until_idle()
     assert store.get(Finetune, "run-exhaust").status["state"] == Finetune.STATE_FAILED
+
+
+def test_trainer_args_render_tpu_quant_params():
+    """Hyperparameter TPU additions flow to the trainer CLI: quantImpl
+    selects the fused Pallas kernels (--quant_impl, round 3) next to int4
+    and attention (the bitsandbytes kernel choice the reference hardwires,
+    reference train.py:224-234)."""
+    from datatunerx_tpu.operator.generate import build_trainer_args
+
+    ft = Finetune(metadata=ObjectMeta(name="qi"), spec={
+        "llm": "m", "dataset": "d",
+        "hyperparameter": {"hyperparameterRef": "hp"},
+        "image": {"path": "/m"},
+    })
+    ds_spec = {"datasetMetadata": {"datasetInfo": {
+        "subsets": [{"splits": {"train": {"file": "/t.csv"}}}]}}}
+    args = build_trainer_args(ft, ds_spec, {
+        "int4": "true", "quantImpl": "pallas", "attention": "flash"})
+    s = " ".join(str(a) for a in args)
+    assert "--quantization int4" in s
+    assert "--quant_impl pallas" in s
+    assert "--attention flash" in s

@@ -241,3 +241,25 @@ def test_pallas_quant_kernels_differentiate():
     g_ref = jax.grad(lambda x: jnp.sum(matmul_nf4(x, q4, (K, N)) ** 2))(x)
     np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_ref),
                                atol=1e-2, rtol=1e-2)
+
+
+def test_pallas_nf4_transposed_kernel_matches_reference():
+    """The fused dx kernel (g @ Wᵀ with per-tile dequant, round-3): exact
+    against the XLA dequant product across N-tile accumulation (nn > 1),
+    non-128·64-multiple K, and row padding."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.ops.pallas_quant import _pallas_matmul_nf4_t_impl
+
+    rng = np.random.default_rng(11)
+    for K, N, M in ((320, 256, 8), (384, 512, 33), (128, 384, 64)):
+        w = _w(rng, (K, N))
+        q4 = quantize_nf4(w)
+        wd = np.asarray(dequant_nf4(q4, (K, N)))
+        g = jnp.asarray(rng.standard_normal((M, N)), jnp.float32)
+        dx = _pallas_matmul_nf4_t_impl(g, q4, (K, N),
+                                       block_m=32, block_n=128)
+        ref = np.asarray(g) @ wd.T
+        np.testing.assert_allclose(np.asarray(dx), ref, atol=1e-3, rtol=1e-3)
