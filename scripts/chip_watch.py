@@ -1,0 +1,210 @@
+"""Chip-health watcher (VERDICT r3 next-round #1).
+
+The tunneled TPU relay wedges for hours at a time (r1: timeout, r3: wedged
+all round); every perf claim in this project is blocked on catching a
+healthy window. This daemon:
+
+1. probes the default device with a tiny matmul IN A SUBPROCESS every
+   ``--interval`` seconds (a wedged relay hangs rather than errors, and a
+   process that touched the wedged platform can't recover — isolation is
+   mandatory), appending every probe to the committed ``CHIPWATCH.log``;
+2. on the FIRST successful probe, runs the full evidence-capture sequence:
+     a. ``scripts/tpu_validate.py``        -> TPU_VALIDATE.log
+     b. ``scripts/bench_7b.py`` (pallas)   -> line in BENCH_7B_TPU.json
+     c. ``scripts/bench_7b.py`` (xla)      -> line in BENCH_7B_TPU.json
+     d. ``bench.py``                       -> persists BENCH_TPU.json itself
+     e. ``scripts/bench_serving.py``       -> persists BENCH_SERVING_TPU.json
+   re-probing between phases (the relay can wedge mid-window; a wedge costs
+   that child's timeout, not the artifacts already captured);
+3. writes ``CHIPWATCH_RESULT.json`` summarizing what landed, and exits 0.
+
+If the deadline passes with no healthy window, the log itself is the
+evidence that the relay never answered; exit 3.
+
+Run (round open):  nohup python scripts/chip_watch.py &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "CHIPWATCH.log")
+
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "assert jax.default_backend() in ('tpu','axon'), jax.default_backend();"
+    "x = jnp.ones((256, 256), jnp.float32);"
+    "print(float((x @ x)[0, 0]))"
+)
+
+
+def now() -> str:
+    return datetime.now(timezone.utc).isoformat(timespec="seconds")
+
+
+def log(msg: str) -> None:
+    line = f"{now()} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float) -> bool:
+    """One isolated device probe; True iff the chip multiplied matrices."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            timeout=timeout_s, capture_output=True, text=True, cwd=REPO,
+        )
+        return p.returncode == 0 and "256.0" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_phase(name: str, argv: list[str], timeout_s: float,
+              logfile: str | None = None) -> dict:
+    """Run one capture phase as a subprocess; return a summary record."""
+    log(f"phase {name}: start ({' '.join(argv)})")
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(
+            argv, timeout=timeout_s, capture_output=True, text=True, cwd=REPO,
+        )
+        rc, out, err = p.returncode, p.stdout, p.stderr
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        rc, timed_out = -1, True
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    dt = time.monotonic() - t0
+    if logfile:
+        with open(os.path.join(REPO, logfile), "a") as f:
+            f.write(f"=== {now()} {name} rc={rc} dt={dt:.0f}s ===\n")
+            f.write(out)
+            if err:
+                f.write("\n--- stderr ---\n" + err[-8000:])
+            f.write("\n")
+    # last JSON line, if the phase emits one
+    parsed = None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                parsed = obj
+                break
+        except ValueError:
+            continue
+    log(f"phase {name}: rc={rc}{' TIMEOUT' if timed_out else ''} "
+        f"dt={dt:.0f}s")
+    return {"name": name, "rc": rc, "timed_out": timed_out,
+            "seconds": round(dt, 1), "json": parsed}
+
+
+def capture(args) -> list[dict]:
+    """The full evidence sequence, with re-probes between phases."""
+    phases = []
+
+    def alive() -> bool:
+        ok = probe(args.probe_timeout)
+        if not ok:
+            log("re-probe failed — relay wedged mid-window; waiting for the "
+                "next healthy window for remaining phases")
+        return ok
+
+    phases.append(run_phase(
+        "tpu_validate",
+        [sys.executable, os.path.join(REPO, "scripts", "tpu_validate.py")],
+        timeout_s=1500, logfile="TPU_VALIDATE.log"))
+
+    results7b = []
+    for impl in ("pallas", "xla"):
+        if not alive():
+            return phases
+        rec = run_phase(
+            f"bench_7b_{impl}",
+            [sys.executable, os.path.join(REPO, "scripts", "bench_7b.py"),
+             "--quant_impl", impl, "--steps", str(args.bench_7b_steps)],
+            timeout_s=2400, logfile="TPU_VALIDATE.log")
+        phases.append(rec)
+        if rec["json"] is not None:
+            results7b.append(rec["json"])
+    if results7b:
+        with open(os.path.join(REPO, "BENCH_7B_TPU.json"), "w") as f:
+            json.dump({"timestamp": now(),
+                       "hardware": "TPU v5e-1 (tunneled)",
+                       "lines": results7b}, f, indent=1)
+            f.write("\n")
+        log("persisted BENCH_7B_TPU.json")
+
+    if not alive():
+        return phases
+    phases.append(run_phase(
+        "bench", [sys.executable, os.path.join(REPO, "bench.py")],
+        timeout_s=900))  # persists BENCH_TPU.json on success
+
+    if not alive():
+        return phases
+    phases.append(run_phase(
+        "bench_serving",
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py")],
+        timeout_s=1200))  # persists BENCH_SERVING_TPU.json on success
+
+    return phases
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=180.0,
+                    help="seconds between probes while wedged")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--deadline-hours", type=float, default=11.0,
+                    help="give up (exit 3) after this long with no window")
+    ap.add_argument("--bench-7b-steps", type=int, default=10)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe + capture attempt, no wait loop")
+    args = ap.parse_args()
+
+    t_start = time.monotonic()
+    log(f"chip_watch start pid={os.getpid()} interval={args.interval:.0f}s "
+        f"deadline={args.deadline_hours:.1f}h")
+    n = 0
+    while True:
+        n += 1
+        ok = probe(args.probe_timeout)
+        log(f"probe #{n}: {'HEALTHY' if ok else 'wedged/hung'}")
+        if ok:
+            phases = capture(args)
+            artifacts = [p for p in (
+                "BENCH_TPU.json", "BENCH_7B_TPU.json",
+                "BENCH_SERVING_TPU.json", "TPU_VALIDATE.log")
+                if os.path.exists(os.path.join(REPO, p))]
+            result = {
+                "timestamp": now(), "probes": n,
+                "wait_seconds": round(time.monotonic() - t_start, 0),
+                "phases": phases, "artifacts": artifacts,
+            }
+            with open(os.path.join(REPO, "CHIPWATCH_RESULT.json"), "w") as f:
+                json.dump(result, f, indent=1)
+                f.write("\n")
+            log(f"capture complete: artifacts={artifacts}")
+            return 0
+        if args.once:
+            return 3
+        if time.monotonic() - t_start > args.deadline_hours * 3600:
+            log("deadline reached with no healthy window — relay never "
+                "answered; the probe log above is the evidence")
+            return 3
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
