@@ -370,6 +370,8 @@ class ManifestBackend:
                                 "--checkpoint_path", spec.get("checkpoint_path", ""),
                                 "--port", "8000",
                                 "--quantization", spec.get("quantization", ""),
+                                *(["--slots", str(spec["slots"])]
+                                  if spec.get("slots") is not None else []),
                             ],
                             "ports": [{"containerPort": 8000}],
                             "readinessProbe": {

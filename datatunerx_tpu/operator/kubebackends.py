@@ -82,6 +82,7 @@ class KubeServingBackend(ManifestBackend):
             "node_selector": spec.get("nodeSelector", {}),
             "tolerations": spec.get("tolerations", []),
             "quantization": spec.get("quantization", ""),
+            "slots": spec.get("slots"),
         })
         for group, version, plural, body in (
             ("apps", "v1", "deployments", deployment),

@@ -96,6 +96,57 @@ def _restore_placements(store, slice_pool, attempts: int = 5):
                 print(f"[controller-manager] placement restore: {e}", flush=True)
 
 
+def _neutralize_webhook_configs(client) -> None:
+    """With no webhook server running, leftover failurePolicy:Fail
+    configurations reject every CREATE/UPDATE of the webhooked kinds
+    cluster-wide (the apiserver can't reach :9443). Flip them to Ignore —
+    loudly — so a cryptography-less deployment degrades to in-process-only
+    admission instead of a silent cluster-wide outage."""
+    for plural, name in (
+        ("validatingwebhookconfigurations", "datatunerx-validating-webhook"),
+        ("mutatingwebhookconfigurations", "datatunerx-mutating-webhook"),
+    ):
+        path = f"/apis/admissionregistration.k8s.io/v1/{plural}/{name}"
+        try:
+            cfg = client.request("GET", path)
+        except Exception:  # noqa: BLE001 — absent: nothing to neutralize
+            continue
+        changed = False
+        for wh in cfg.get("webhooks") or []:
+            if wh.get("failurePolicy") != "Ignore":
+                wh["failurePolicy"] = "Ignore"
+                changed = True
+        if not changed:
+            continue
+        try:
+            client.request("PUT", path, body=cfg)
+            print(f"[controller-manager] WARNING: set failurePolicy=Ignore "
+                  f"on {name} — kubectl-applied CRs are NOT validated until "
+                  "the webhook server is restored", flush=True)
+        except Exception as pe:  # noqa: BLE001
+            print(f"[controller-manager] ERROR: could not neutralize {name} "
+                  f"({pe}); kubectl CREATE/UPDATE of webhooked kinds will "
+                  "FAIL cluster-wide until it is deleted or the webhook "
+                  "server is restored", flush=True)
+
+
+def webhook_cert_sans(service_name: str, namespace: str) -> list:
+    """Serving-cert SANs for the admission webhook server.
+
+    A real apiserver routes service-style clientConfig traffic to
+    ``<service>.<ns>.svc`` and verifies the webhook's serving certificate
+    against that DNS name (the reference's cert-rotator certs the webhook
+    Service name for the same reason). localhost stays FIRST: the default
+    ``--webhook-url-base`` is derived from dns_names[0] and must keep
+    resolving for url-style dev / fake-apiserver routing."""
+    return [
+        "localhost",
+        "127.0.0.1",
+        f"{service_name}.{namespace}.svc",
+        f"{service_name}.{namespace}.svc.cluster.local",
+    ]
+
+
 class _HealthHandler(BaseHTTPRequestHandler):
     """Probe-only endpoint (reference --health-probe-bind-address,
     options.go:13-14); metrics live on the API address only."""
@@ -144,6 +195,18 @@ def main(argv=None):
                    help="externally reachable base URL of this webhook "
                         "server, written into the webhook configurations "
                         "(default: https://<first-cert-SAN>:<port>)")
+    p.add_argument("--webhook-service-name",
+                   default="datatunerx-webhook-service",
+                   help="Service routing admission traffic to this webhook "
+                        "server (deploy/webhooks.yaml clientConfig.service); "
+                        "its cluster DNS names are added to the serving-cert "
+                        "SANs so a real apiserver's TLS verification of "
+                        "service-style routing succeeds")
+    p.add_argument("--webhook-service-namespace", default=None,
+                   help="namespace of that Service (default: the pod's own "
+                        "namespace via the serviceaccount file / "
+                        "OPERATOR_NAMESPACE — NOT --kube-namespace, which "
+                        "scopes the CRs being reconciled)")
     # TPU-native options
     p.add_argument("--persist-dir", default=None,
                    help="JSON object store directory (durable CRs)")
@@ -201,27 +264,58 @@ def main(argv=None):
         # register the configurations so kubectl-applied CRs are validated by
         # the apiserver itself, not just by this process's AdmittingStore.
         if args.webhook_bind_address != "disabled":
-            from datatunerx_tpu.operator.webhook_server import (
-                AdmissionWebhookServer,
-                CertManager,
-                install_webhooks,
-            )
+            try:
+                from datatunerx_tpu.operator.webhook_server import (
+                    AdmissionWebhookServer,
+                    CertManager,
+                    install_webhooks,
+                )
 
-            wh_host, _, wh_port = args.webhook_bind_address.rpartition(":")
-            certs = CertManager(args.webhook_cert_dir)
-            wh_srv = AdmissionWebhookServer(
-                certs, host=wh_host or "0.0.0.0", port=int(wh_port or 9443))
-            base = (args.webhook_url_base
-                    or f"https://{certs.dns_names[0]}:{wh_srv.port}")
-            rotate = (3600.0 if str(args.enable_cert_rotator).lower()
-                      in ("true", "1", "yes") else 0.0)
-            wh_srv.start(
-                rotation_check_s=rotate,
-                on_rotate=lambda ca: install_webhooks(client, ca, base),
-            )
-            install_webhooks(client, certs.ca_bundle_b64(), base)
-            print(f"[controller-manager] admission webhooks on :{wh_srv.port}",
-                  flush=True)
+                wh_host, _, wh_port = args.webhook_bind_address.rpartition(":")
+                # SANs must cover service-style routing (failurePolicy Fail
+                # would otherwise reject every CREATE/UPDATE cluster-wide).
+                # The Service lives in the OPERATOR's namespace (the pod's
+                # own, per the serviceaccount file), which is not the same
+                # thing as --kube-namespace (the CR scope).
+                from datatunerx_tpu.operator.config import (
+                    get_operator_namespace,
+                )
+
+                wh_ns = (args.webhook_service_namespace
+                         or get_operator_namespace())
+                certs = CertManager(
+                    args.webhook_cert_dir,
+                    dns_names=webhook_cert_sans(args.webhook_service_name,
+                                                wh_ns))
+                wh_srv = AdmissionWebhookServer(
+                    certs, host=wh_host or "0.0.0.0",
+                    port=int(wh_port or 9443))
+                base = (args.webhook_url_base
+                        or f"https://{certs.dns_names[0]}:{wh_srv.port}")
+                rotate = (3600.0 if str(args.enable_cert_rotator).lower()
+                          in ("true", "1", "yes") else 0.0)
+                wh_srv.start(
+                    rotation_check_s=rotate,
+                    on_rotate=lambda ca: install_webhooks(client, ca, base),
+                )
+                install_webhooks(client, certs.ca_bundle_b64(), base)
+                print("[controller-manager] admission webhooks on "
+                      f":{wh_srv.port}", flush=True)
+            except ImportError as e:
+                # cryptography missing (webhook_server defers its imports
+                # into the cert paths, so the failure surfaces at cert
+                # generation, not module import): degrade rather than crash
+                # a kube deployment — CRs through THIS process are still
+                # validated by AdmittingStore; only kubectl-direct admission
+                # is lost. Existing failurePolicy:Fail configurations from a
+                # prior run would otherwise keep rejecting EVERY kubectl
+                # CREATE/UPDATE against an unserved :9443 — neutralize them.
+                print("[controller-manager] WARNING: admission webhook "
+                      f"server disabled ({e}); install 'cryptography' to "
+                      "enforce validation on kubectl-applied CRs "
+                      "(in-process admission via AdmittingStore remains "
+                      "active)", flush=True)
+                _neutralize_webhook_configs(client)
 
         elector = None
         if str(args.leader_elect).lower() in ("true", "1", "yes"):

@@ -150,12 +150,34 @@ class CertManager:
         except (FileNotFoundError, ValueError):
             return None
 
+    def _cert_names(self) -> Optional[set]:
+        """DNS + IP SANs of the cert on disk (None if absent/garbled)."""
+        try:
+            from cryptography import x509
+            from cryptography.x509.oid import ExtensionOID
+
+            with open(self.cert_path, "rb") as f:
+                cert = x509.load_pem_x509_certificate(f.read())
+            sans = cert.extensions.get_extension_for_oid(
+                ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+            return {str(v) for v in sans.get_values_for_type(x509.DNSName)} | {
+                str(v) for v in sans.get_values_for_type(x509.IPAddress)}
+        except Exception:  # noqa: BLE001 — absent/unsupported = regenerate
+            return None
+
     def needs_rotation(self) -> bool:
         exp = self._expiry()
         if exp is None:
             return True
         now = datetime.datetime.now(datetime.timezone.utc)
-        return exp - now < self.refresh_margin
+        if exp - now < self.refresh_margin:
+            return True
+        # SAN drift: a persisted cert dir from an older deploy (e.g. the
+        # pre-service-SAN localhost-only cert) must regenerate even though
+        # it has months of validity left — otherwise apiserver TLS
+        # verification of service-style routing keeps failing cluster-wide
+        names = self._cert_names()
+        return names is None or not set(self.dns_names) <= names
 
     def ensure(self) -> bool:
         """Generate certs if absent or within the refresh margin.
@@ -250,7 +272,16 @@ def review_mutate(request: dict) -> dict:
             "allowed": False,
             "status": {"code": 422, "message": f"defaulting failed: {e}"},
         }
-    ops = _json_patch(obj.get("spec") or {}, shim.spec, path="/spec")
+    if not isinstance(obj.get("spec"), dict):
+        # RFC 6902: 'add /spec/foo' fails when /spec is absent OR null
+        # (`spec:` with no value in YAML) — a real apiserver would reject
+        # the patch (and failurePolicy Fail would then deny the create).
+        # Add/replace the whole spec in one op.
+        op = "replace" if "spec" in obj else "add"
+        ops = [{"op": op, "path": "/spec", "value": shim.spec}] \
+            if shim.spec else []
+    else:
+        ops = _json_patch(obj["spec"], shim.spec, path="/spec")
     resp = {"uid": uid, "allowed": True}
     if ops:
         resp["patchType"] = "JSONPatch"

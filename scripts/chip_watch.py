@@ -109,56 +109,73 @@ def run_phase(name: str, argv: list[str], timeout_s: float,
             "seconds": round(dt, 1), "json": parsed}
 
 
-def capture(args) -> list[dict]:
-    """The full evidence sequence, with re-probes between phases."""
-    phases = []
+ARTIFACTS = ("BENCH_TPU.json", "BENCH_7B_TPU.json",
+             "BENCH_SERVING_TPU.json", "TPU_VALIDATE.log")
 
-    def alive() -> bool:
-        ok = probe(args.probe_timeout)
-        if not ok:
-            log("re-probe failed — relay wedged mid-window; waiting for the "
-                "next healthy window for remaining phases")
-        return ok
 
-    phases.append(run_phase(
-        "tpu_validate",
-        [sys.executable, os.path.join(REPO, "scripts", "tpu_validate.py")],
-        timeout_s=1500, logfile="TPU_VALIDATE.log"))
+def phase_plan(args) -> list[tuple[str, list, float, str | None]]:
+    """(name, argv, timeout_s, logfile) in capture order."""
+    py = sys.executable
+    return [
+        ("tpu_validate",
+         [py, os.path.join(REPO, "scripts", "tpu_validate.py")],
+         1500, "TPU_VALIDATE.log"),
+        ("bench_7b_pallas",
+         [py, os.path.join(REPO, "scripts", "bench_7b.py"),
+          "--quant_impl", "pallas", "--steps", str(args.bench_7b_steps)],
+         2400, "TPU_VALIDATE.log"),
+        ("bench_7b_xla",
+         [py, os.path.join(REPO, "scripts", "bench_7b.py"),
+          "--quant_impl", "xla", "--steps", str(args.bench_7b_steps)],
+         2400, "TPU_VALIDATE.log"),
+        ("bench", [py, os.path.join(REPO, "bench.py")], 900, None),
+        ("bench_serving",
+         [py, os.path.join(REPO, "scripts", "bench_serving.py")],
+         1200, None),
+    ]
 
-    results7b = []
-    for impl in ("pallas", "xla"):
-        if not alive():
-            return phases
-        rec = run_phase(
-            f"bench_7b_{impl}",
-            [sys.executable, os.path.join(REPO, "scripts", "bench_7b.py"),
-             "--quant_impl", impl, "--steps", str(args.bench_7b_steps)],
-            timeout_s=2400, logfile="TPU_VALIDATE.log")
-        phases.append(rec)
-        if rec["json"] is not None:
-            results7b.append(rec["json"])
-    if results7b:
-        with open(os.path.join(REPO, "BENCH_7B_TPU.json"), "w") as f:
-            json.dump({"timestamp": now(),
-                       "hardware": "TPU v5e-1 (tunneled)",
-                       "lines": results7b}, f, indent=1)
-            f.write("\n")
-        log("persisted BENCH_7B_TPU.json")
 
-    if not alive():
-        return phases
-    phases.append(run_phase(
-        "bench", [sys.executable, os.path.join(REPO, "bench.py")],
-        timeout_s=900))  # persists BENCH_TPU.json on success
+MAX_ATTEMPTS = 3
 
-    if not alive():
-        return phases
-    phases.append(run_phase(
-        "bench_serving",
-        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py")],
-        timeout_s=1200))  # persists BENCH_SERVING_TPU.json on success
 
-    return phases
+def capture(args, done: dict, attempts: dict) -> bool:
+    """Run the not-yet-settled phases of the evidence sequence, re-probing
+    between phases. ``done`` maps phase name → record and persists across
+    windows, so a mid-window wedge resumes (not restarts) at the next
+    healthy window. Returns True when every phase has a settled outcome.
+
+    Settled = the phase succeeded, OR it failed (rc != 0 / timeout) while
+    the relay stayed healthy — a genuine failure, not wedge collateral —
+    OR it has burned MAX_ATTEMPTS windows. A failure with a wedged relay
+    stays eligible for retry."""
+    for name, argv, timeout_s, logfile in phase_plan(args):
+        if name in done:
+            continue
+        attempts[name] = attempts.get(name, 0) + 1
+        rec = run_phase(name, argv, timeout_s, logfile)
+        relay_ok = probe(args.probe_timeout)
+        failed = rec["timed_out"] or rec["rc"] != 0
+        if failed and not relay_ok and attempts[name] < MAX_ATTEMPTS:
+            log(f"phase {name}: failed (rc={rec['rc']}) with the relay "
+                f"wedged (attempt {attempts[name]}/{MAX_ATTEMPTS}) — will "
+                "retry in the next healthy window")
+            return False
+        done[name] = rec
+        if name.startswith("bench_7b") and rec["json"] is not None:
+            lines = [done[k]["json"] for k in ("bench_7b_pallas",
+                                               "bench_7b_xla")
+                     if k in done and done[k]["json"] is not None]
+            with open(os.path.join(REPO, "BENCH_7B_TPU.json"), "w") as f:
+                json.dump({"timestamp": now(),
+                           "hardware": "TPU v5e-1 (tunneled)",
+                           "lines": lines}, f, indent=1)
+                f.write("\n")
+            log("persisted BENCH_7B_TPU.json")
+        if not relay_ok:
+            log("re-probe failed — relay wedged mid-window; waiting for "
+                "the next healthy window for remaining phases")
+            return False
+    return True
 
 
 def main() -> int:
@@ -174,35 +191,47 @@ def main() -> int:
     args = ap.parse_args()
 
     t_start = time.monotonic()
+    # only artifacts WRITTEN BY THIS RUN may be reported — a stale file from
+    # a previous round must not read as captured by this window
+    t_wall_start = time.time()
     log(f"chip_watch start pid={os.getpid()} interval={args.interval:.0f}s "
         f"deadline={args.deadline_hours:.1f}h")
     n = 0
+    done: dict = {}
+    attempts: dict = {}
+
+    def finish(code: int) -> int:
+        fresh = [p for p in ARTIFACTS
+                 if os.path.exists(os.path.join(REPO, p))
+                 and os.path.getmtime(os.path.join(REPO, p)) >= t_wall_start]
+        result = {
+            "timestamp": now(), "probes": n, "complete": code == 0,
+            "wait_seconds": round(time.monotonic() - t_start, 0),
+            "phases": list(done.values()), "artifacts": fresh,
+        }
+        with open(os.path.join(REPO, "CHIPWATCH_RESULT.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        log(f"{'capture complete' if code == 0 else 'exiting incomplete'}: "
+            f"fresh artifacts={fresh}")
+        return code
+
     while True:
         n += 1
         ok = probe(args.probe_timeout)
         log(f"probe #{n}: {'HEALTHY' if ok else 'wedged/hung'}")
-        if ok:
-            phases = capture(args)
-            artifacts = [p for p in (
-                "BENCH_TPU.json", "BENCH_7B_TPU.json",
-                "BENCH_SERVING_TPU.json", "TPU_VALIDATE.log")
-                if os.path.exists(os.path.join(REPO, p))]
-            result = {
-                "timestamp": now(), "probes": n,
-                "wait_seconds": round(time.monotonic() - t_start, 0),
-                "phases": phases, "artifacts": artifacts,
-            }
-            with open(os.path.join(REPO, "CHIPWATCH_RESULT.json"), "w") as f:
-                json.dump(result, f, indent=1)
-                f.write("\n")
-            log(f"capture complete: artifacts={artifacts}")
-            return 0
+        if ok and capture(args, done, attempts):
+            return finish(0)
         if args.once:
-            return 3
+            return finish(3)
         if time.monotonic() - t_start > args.deadline_hours * 3600:
-            log("deadline reached with no healthy window — relay never "
-                "answered; the probe log above is the evidence")
-            return 3
+            if done:
+                log("deadline reached with capture incomplete — partial "
+                    "phases recorded")
+            else:
+                log("deadline reached with no healthy window — relay never "
+                    "answered; the probe log above is the evidence")
+            return finish(3)
         time.sleep(args.interval)
 
 

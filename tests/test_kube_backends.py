@@ -112,6 +112,23 @@ def test_kube_serving_backend(cluster):
     assert backend.status("s1") == "NotFound"
 
 
+def test_kube_serving_backend_renders_slots(cluster):
+    """ADVICE r3 low: serveConfig.slots must reach the kube serving
+    Deployment args, not just the local backend."""
+    srv, client, workdir = cluster
+    backend = KubeServingBackend(client, out_dir=os.path.join(workdir, "s2"))
+    backend.deploy("s2", {"llmPath": "/models/m", "checkpointPath": "/ckpt",
+                          "slots": 4})
+    dep = client.get("apps", "v1", "deployments", "default", "s2")
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    i = args.index("--slots")
+    assert args[i + 1] == "4"
+    # absent slots -> flag omitted (server default applies)
+    backend.deploy("s3", {"llmPath": "/models/m"})
+    dep = client.get("apps", "v1", "deployments", "default", "s3")
+    assert "--slots" not in dep["spec"]["template"]["spec"]["containers"][0]["args"]
+
+
 # ------------------------------------- full manifest-mode Finetune lifecycle
 
 def test_finetune_transitions_from_jobset_conditions(cluster):

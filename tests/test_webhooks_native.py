@@ -231,3 +231,91 @@ def test_webhook_configuration_shape():
     covered = {r for rule in val["rules"] for r in rule["resources"]}
     assert covered == {"finetunejobs", "finetuneexperiments", "llms",
                        "hyperparameters", "datasets"}
+
+
+# -------------------------------------------------- round-4 ADVICE fixes
+
+def test_serving_cert_sans_cover_service_dns(tmp_path):
+    """ADVICE r3 high: in-cluster admission routes via
+    <service>.<ns>.svc and the apiserver verifies the serving cert against
+    that DNS name — the cert must carry the Service SANs, not just
+    localhost."""
+    from cryptography import x509
+    from cryptography.x509.oid import ExtensionOID
+
+    from datatunerx_tpu.operator.manager import webhook_cert_sans
+
+    sans = webhook_cert_sans("datatunerx-webhook-service", "dtx-ns")
+    assert sans[0] == "localhost"  # default url-base derives from [0]
+    assert "datatunerx-webhook-service.dtx-ns.svc" in sans
+    assert "datatunerx-webhook-service.dtx-ns.svc.cluster.local" in sans
+
+    cm = CertManager(str(tmp_path / "certs"), dns_names=sans)
+    cm.ensure()
+    with open(cm.cert_path, "rb") as f:
+        cert = x509.load_pem_x509_certificate(f.read())
+    ext = cert.extensions.get_extension_for_oid(
+        ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+    dns = set(ext.get_values_for_type(x509.DNSName))
+    assert "datatunerx-webhook-service.dtx-ns.svc" in dns
+    assert "datatunerx-webhook-service.dtx-ns.svc.cluster.local" in dns
+
+
+def test_review_mutate_specless_object_adds_whole_spec():
+    """ADVICE r3 low: RFC 6902 'add /spec/foo' is invalid when /spec does
+    not exist — a specless object must get a single 'add /spec' op."""
+    import base64
+
+    resp = review_mutate({
+        "uid": "u3",
+        "kind": {"kind": "Hyperparameter"},
+        "object": {
+            "apiVersion": f"{GROUP_CORE}/v1beta1",
+            "kind": "Hyperparameter",
+            "metadata": {"name": "nospec", "namespace": "default"},
+        },
+    })
+    assert resp["allowed"] is True
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert len(ops) == 1
+    assert ops[0]["op"] == "add" and ops[0]["path"] == "/spec"
+    assert ops[0]["value"]["parameters"]["optimizer"]  # defaulted inside
+
+
+def test_cert_rotates_on_san_drift(tmp_path):
+    """A persisted cert dir from an older deploy (localhost-only SANs) must
+    regenerate when the configured dns_names grow — months of remaining
+    validity notwithstanding — or service-style TLS keeps failing."""
+    d = str(tmp_path / "certs")
+    old = CertManager(d, dns_names=["localhost", "127.0.0.1"])
+    assert old.ensure() is True
+    # same dir, new deploy wants service SANs
+    new = CertManager(d, dns_names=["localhost", "127.0.0.1",
+                                    "svc.ns.svc", "svc.ns.svc.cluster.local"])
+    assert new.needs_rotation()
+    assert new.ensure() is True
+    assert not new.needs_rotation()
+    # old manager config against the regenerated superset cert: no churn
+    assert old.needs_rotation() is False
+
+
+def test_review_mutate_null_spec_replaces_whole_spec():
+    """`spec:` with no value in YAML arrives as spec: null — 'add /spec/foo'
+    would fail RFC 6902 evaluation; must replace /spec wholesale."""
+    import base64
+
+    resp = review_mutate({
+        "uid": "u4",
+        "kind": {"kind": "Hyperparameter"},
+        "object": {
+            "apiVersion": f"{GROUP_CORE}/v1beta1",
+            "kind": "Hyperparameter",
+            "metadata": {"name": "nullspec", "namespace": "default"},
+            "spec": None,
+        },
+    })
+    assert resp["allowed"] is True
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert len(ops) == 1
+    assert ops[0]["op"] == "replace" and ops[0]["path"] == "/spec"
+    assert ops[0]["value"]["parameters"]["optimizer"]
