@@ -264,7 +264,24 @@ def main(argv=None):
         # register the configurations so kubectl-applied CRs are validated by
         # the apiserver itself, not just by this process's AdmittingStore.
         if args.webhook_bind_address != "disabled":
-            try:
+            import importlib.util
+
+            if importlib.util.find_spec("cryptography") is None:
+                # precise probe, NOT a broad except ImportError around the
+                # setup block: a genuine packaging/refactor bug in our own
+                # modules must crash loudly, while a host without
+                # cryptography degrades to in-process-only admission.
+                # Existing failurePolicy:Fail configurations from a prior
+                # run would keep rejecting EVERY kubectl CREATE/UPDATE
+                # against an unserved :9443 — neutralize them (a later
+                # healthy start's install_webhooks restores Fail).
+                print("[controller-manager] WARNING: admission webhook "
+                      "server disabled (no module named 'cryptography'); "
+                      "install 'cryptography' to enforce validation on "
+                      "kubectl-applied CRs (in-process admission via "
+                      "AdmittingStore remains active)", flush=True)
+                _neutralize_webhook_configs(client)
+            else:
                 from datatunerx_tpu.operator.webhook_server import (
                     AdmissionWebhookServer,
                     CertManager,
@@ -301,21 +318,6 @@ def main(argv=None):
                 install_webhooks(client, certs.ca_bundle_b64(), base)
                 print("[controller-manager] admission webhooks on "
                       f":{wh_srv.port}", flush=True)
-            except ImportError as e:
-                # cryptography missing (webhook_server defers its imports
-                # into the cert paths, so the failure surfaces at cert
-                # generation, not module import): degrade rather than crash
-                # a kube deployment — CRs through THIS process are still
-                # validated by AdmittingStore; only kubectl-direct admission
-                # is lost. Existing failurePolicy:Fail configurations from a
-                # prior run would otherwise keep rejecting EVERY kubectl
-                # CREATE/UPDATE against an unserved :9443 — neutralize them.
-                print("[controller-manager] WARNING: admission webhook "
-                      f"server disabled ({e}); install 'cryptography' to "
-                      "enforce validation on kubectl-applied CRs "
-                      "(in-process admission via AdmittingStore remains "
-                      "active)", flush=True)
-                _neutralize_webhook_configs(client)
 
         elector = None
         if str(args.leader_elect).lower() in ("true", "1", "yes"):

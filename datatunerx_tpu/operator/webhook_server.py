@@ -446,11 +446,14 @@ def install_webhooks(client, ca_bundle_b64: str, base_url: str):
     the cert-rotator's caBundle-injection step.
 
     When a configuration already exists (e.g. the deploy-time
-    ``deploy/webhooks.yaml`` with a service-style clientConfig), ONLY the
-    caBundle is injected: the deployed routing (service vs url) is the
-    cluster operator's choice and must survive operator restarts. Fresh
-    configurations (dev / fake-apiserver runs) are created url-style against
-    ``base_url``."""
+    ``deploy/webhooks.yaml`` with a service-style clientConfig), the
+    caBundle is injected and failurePolicy restored to the rendered
+    fail-closed value (a degraded no-cryptography boot flips it to Ignore —
+    see manager._neutralize_webhook_configs — and a later healthy start
+    must undo that, or one degraded run permanently converts admission to
+    fail-open); the deployed ROUTING (service vs url) is the cluster
+    operator's choice and survives restarts. Fresh configurations (dev /
+    fake-apiserver runs) are created url-style against ``base_url``."""
     for cfg in webhook_configurations(ca_bundle_b64, base_url):
         plural = cfg["kind"].lower() + "s"
         path = (f"/apis/admissionregistration.k8s.io/v1/{plural}/"
@@ -463,6 +466,10 @@ def install_webhooks(client, ca_bundle_b64: str, base_url: str):
                 body=cfg)
             continue
         cur = copy.deepcopy(cur)
+        rendered_policy = {wh["name"]: wh.get("failurePolicy", "Fail")
+                           for wh in cfg["webhooks"]}
         for wh in cur.get("webhooks") or []:
             wh.setdefault("clientConfig", {})["caBundle"] = ca_bundle_b64
+            if wh.get("name") in rendered_policy:
+                wh["failurePolicy"] = rendered_policy[wh["name"]]
         client.request("PUT", path, body=cur)

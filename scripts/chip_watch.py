@@ -171,7 +171,8 @@ def capture(args, done: dict, attempts: dict) -> bool:
                            "lines": lines}, f, indent=1)
                 f.write("\n")
             log("persisted BENCH_7B_TPU.json")
-        if not relay_ok:
+        remaining = [n for n, *_ in phase_plan(args) if n not in done]
+        if not relay_ok and remaining:
             log("re-probe failed — relay wedged mid-window; waiting for "
                 "the next healthy window for remaining phases")
             return False
