@@ -136,6 +136,30 @@ class FinetuneController:
                 hyperparameter.spec.get("parameters", {}),
                 hp_ref.get("overrides"),
             )
+            # HBM capacity admission (parallel/memory.py): a job whose
+            # training state provably exceeds the slice's per-chip HBM is
+            # failed HERE with a byte breakdown, not after minutes of
+            # on-slice compilation (the reference has no equivalent — its
+            # worker just OOMs)
+            n_chips = (placement.chips if placement is not None
+                       else max(1, int(ft.spec.get("node", 1) or 1)) * 4)
+            from datatunerx_tpu.operator.capacity import check_admission
+
+            denied = check_admission(
+                ft.spec.get("image", {}).get("path") or "",
+                params, n_chips=n_chips,
+                generation=os.environ.get("DTX_TPU_GENERATION", "v5e"))
+            if denied is not None:
+                reason, breakdown = denied
+                if self.slice_pool is not None and placement is not None:
+                    self.slice_pool.release(meta.name)
+                    ft.status.pop("placement", None)
+                ft.status["state"] = Finetune.STATE_FAILED
+                ft.status["admissionDenied"] = reason
+                if breakdown:
+                    ft.status["hbmEstimateGB"] = breakdown
+                store.update(ft)
+                return None
             args = build_trainer_args(ft, dataset.spec, params, uid=meta.uid,
                                       num_workers=hosts)
             spec = generate_training_spec(ft, args, num_hosts=hosts)

@@ -147,8 +147,7 @@ def build_trainer_args(
         # an --stage rm run directory (<storage_path>/<uid>)
         args += ["--reward_model", str(parameters["rewardModel"])]
 
-    peft = str(parameters.get("PEFT", "true")).lower() in ("true", "1", "")
-    args += ["--finetuning_type", "lora" if peft else "full"]
+    args += ["--finetuning_type", "lora" if is_peft(parameters) else "full"]
     for flag, key in (
         ("--lora_rank", "loRA_R"),
         ("--lora_alpha", "loRA_Alpha"),
@@ -188,6 +187,13 @@ def build_trainer_args(
 
 def _truthy(v) -> bool:
     return str(v).lower() in ("true", "1", "yes")
+
+
+def is_peft(parameters: dict) -> bool:
+    """The PEFT truthiness contract (default true, empty string counts as
+    set-true — reference quirk). THE single definition: webhooks.py and
+    capacity.py admission must model exactly the job this module renders."""
+    return str(parameters.get("PEFT", "true")).lower() in ("true", "1", "")
 
 
 def generate_training_spec(finetune: Finetune, args: List[str],

@@ -76,10 +76,9 @@ def validate_hyperparameter(obj: CustomResource):
             # catch the unrunnable combo at admission, not after the JobSet
             # burned its retries: DPO needs the LoRA policy/reference trick,
             # RM keeps the reward model a frozen-base adapter + value head.
-            # Truthiness MUST mirror generate.py's PEFT test — any value
-            # generate would render as --finetuning_type full is rejected
-            # here.
-            _require(str(p.get("PEFT", "true")).lower() in ("true", "1", ""),
+            from datatunerx_tpu.operator.generate import is_peft
+
+            _require(is_peft(p),
                      f"trainerType {tt} requires PEFT (LoRA) — the frozen "
                      "base serves as DPO reference policy / RM backbone")
 
