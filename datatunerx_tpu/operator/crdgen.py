@@ -47,7 +47,11 @@ HYPERPARAMETERS = obj({
     "trainerType": STR, "PEFT": STR, "FP16": STR,
     # TPU additions (SURVEY.md §7.1 Hyperparameter row)
     "topology": STR,
-    "meshShape": obj({"dcn": INT, "dp": INT, "fsdp": INT, "tp": INT, "sp": INT}),
+    # CLOSED node (open_ended=False): the SPMD driver consumes exactly these
+    # axes (tuning/train.py:149-157) — unknown keys here are typos that
+    # would silently change the mesh, so the apiserver prunes them
+    "meshShape": obj({"dcn": INT, "dp": INT, "fsdp": INT, "tp": INT,
+                      "sp": INT}, open_ended=False),
     "packSequences": STR,
     "loRATarget": STR, "attention": STR,
     "rewardModel": STR,  # trainerType ppo: rm-stage run dir
@@ -104,7 +108,9 @@ SPECS = {
     "Scoring": obj({
         "inferenceService": STR,
         "plugin": obj({"loadPlugin": BOOL, "name": STR, "parameters": STR}),
-        "probes": arr(obj({"prompt": STR, "reference": STR})),
+        # closed: the scorer consumes exactly prompt/reference per probe
+        "probes": arr(obj({"prompt": STR, "reference": STR},
+                          open_ended=False)),
         # dataset-driven scoring (beyond the reference's probe-only sibling)
         "datasetRef": STR,
         "metric": {"type": "string", "enum": ["generation", "perplexity"]},

@@ -3,6 +3,8 @@ pkg/util/handlererr/handler.go)."""
 
 from __future__ import annotations
 
+import os
+
 from typing import Optional, Tuple
 
 
@@ -11,8 +13,10 @@ class ErrRecalibrate(Exception):
     (reference valueobject/err.go:5-7)."""
 
 
-RECALIBRATE_REQUEUE_S = 10.0  # reference handlererr/handler.go:13
-ERROR_REQUEUE_S = 30.0  # reference handlererr/handler.go:16
+# reference handlererr/handler.go:13,16 parity defaults; env-tunable for
+# fast test suites (see tests/conftest.py)
+RECALIBRATE_REQUEUE_S = float(os.environ.get("DTX_RECALIBRATE_REQUEUE_S", "10.0"))
+ERROR_REQUEUE_S = float(os.environ.get("DTX_ERROR_REQUEUE_S", "30.0"))
 
 
 def handle_err(err: Optional[BaseException]) -> Tuple[Optional[float], Optional[BaseException]]:

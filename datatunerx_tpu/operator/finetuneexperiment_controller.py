@@ -15,6 +15,8 @@ finetuneexperiment_controller.go:54-227).
 
 from __future__ import annotations
 
+import os
+
 import time
 from typing import Optional
 
@@ -28,7 +30,7 @@ from datatunerx_tpu.operator.api import (
 from datatunerx_tpu.operator.reconciler import Result
 from datatunerx_tpu.operator.store import AlreadyExists, NotFound, ObjectStore, set_owner
 
-POLL_S = 5.0
+POLL_S = float(os.environ.get("DTX_EXPERIMENT_POLL_S", "5.0"))
 
 
 def parse_score(s) -> float:

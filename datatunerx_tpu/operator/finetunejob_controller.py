@@ -14,6 +14,8 @@ internal/controller/finetune/finetunejob_controller.go:71-560).
 
 from __future__ import annotations
 
+import os
+
 import time
 from typing import Optional
 
@@ -37,7 +39,7 @@ from datatunerx_tpu.operator.generate import (
 from datatunerx_tpu.operator.reconciler import Result
 from datatunerx_tpu.operator.store import AlreadyExists, NotFound, ObjectStore
 
-SERVE_POLL_S = 5.0
+SERVE_POLL_S = float(os.environ.get("DTX_SERVE_POLL_S", "5.0"))
 
 
 class FinetuneJobController:

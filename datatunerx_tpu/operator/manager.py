@@ -187,10 +187,15 @@ def main(argv=None):
                         "controller_manager.go:70); ':0' picks a free port, "
                         "'disabled' turns the webhook server off")
     p.add_argument("--webhook-cert-dir", default="/tmp/dtx-webhook-certs",
-                   help="TLS cert dir for the webhook server; in HA "
-                        "deployments mount a shared Secret here so every "
-                        "replica serves the same CA (the caBundle in the "
-                        "webhook configs is last-writer-wins)")
+                   help="local TLS cert dir for the webhook server (with "
+                        "--webhook-cert-secret: the materialization dir for "
+                        "the shared Secret)")
+    p.add_argument("--webhook-cert-secret", default=None,
+                   help="name of a Secret holding the webhook CA + serving "
+                        "cert, shared by every replica (HA; rotation is "
+                        "gated on the election leader). Unset: certs are "
+                        "generated per-process under --webhook-cert-dir, "
+                        "which is only correct at replicas=1")
     p.add_argument("--webhook-url-base", default=None,
                    help="externally reachable base URL of this webhook "
                         "server, written into the webhook configurations "
@@ -259,6 +264,25 @@ def main(argv=None):
         mgr = build_manager(store, training, serving,
                             storage_path=args.storage_path,
                             slice_pool=pool_from_env())
+        mgr.leader_callbacks = []
+
+        # Leader election BEFORE webhook setup: the cert-rotation loop gates
+        # generation on leadership (standbys only hot-reload the shared
+        # Secret), so the webhook server needs the elector handle.
+        elector = None
+        if str(args.leader_elect).lower() in ("true", "1", "yes"):
+            import os as _os
+
+            from datatunerx_tpu.operator.leaderelection import LeaderElector
+
+            # lost leadership = exit; the Deployment restarts the replica,
+            # which re-enters the election (controller-runtime's contract)
+            elector = LeaderElector(
+                client, namespace=args.kube_namespace,
+                lease_duration_s=args.leader_lease_duration,
+                renew_period_s=args.leader_renew_period,
+                on_stopped_leading=lambda: _os._exit(1),
+            )
 
         # Kubernetes-native admission: serve the webhook rules over TLS and
         # register the configurations so kubectl-applied CRs are validated by
@@ -300,10 +324,22 @@ def main(argv=None):
 
                 wh_ns = (args.webhook_service_namespace
                          or get_operator_namespace())
-                certs = CertManager(
-                    args.webhook_cert_dir,
-                    dns_names=webhook_cert_sans(args.webhook_service_name,
-                                                wh_ns))
+                sans = webhook_cert_sans(args.webhook_service_name, wh_ns)
+                if args.webhook_cert_secret:
+                    from datatunerx_tpu.operator.webhook_server import (
+                        SecretBackedCertManager,
+                    )
+
+                    # HA: one CA for the whole Deployment, held in a Secret.
+                    # Boot is leaderless-CAS (first writer wins, losers
+                    # converge); ongoing rotation is leader-gated below.
+                    certs = SecretBackedCertManager(
+                        client, namespace=wh_ns,
+                        secret_name=args.webhook_cert_secret,
+                        cert_dir=args.webhook_cert_dir, dns_names=sans)
+                else:
+                    certs = CertManager(args.webhook_cert_dir,
+                                        dns_names=sans)
                 wh_srv = AdmissionWebhookServer(
                     certs, host=wh_host or "0.0.0.0",
                     port=int(wh_port or 9443))
@@ -314,25 +350,26 @@ def main(argv=None):
                 wh_srv.start(
                     rotation_check_s=rotate,
                     on_rotate=lambda ca: install_webhooks(client, ca, base),
+                    is_leader=(None if elector is None
+                               else lambda: elector.is_leader),
                 )
                 install_webhooks(client, certs.ca_bundle_b64(), base)
+
+                def _reassert_ca():
+                    # A leader can rotate the Secret and crash before
+                    # re-patching the caBundle; whoever takes over converges
+                    # on the Secret (rotating it if it went stale), reloads
+                    # its own TLS, and re-asserts the CURRENT CA into the
+                    # webhook configs on promotion.
+                    if certs.ensure(as_leader=True):
+                        wh_srv._ssl_ctx.load_cert_chain(
+                            certs.cert_path, certs.key_path)
+                    install_webhooks(client, certs.ca_bundle_b64(), base)
+
+                mgr.leader_callbacks.append(_reassert_ca)
                 print("[controller-manager] admission webhooks on "
                       f":{wh_srv.port}", flush=True)
 
-        elector = None
-        if str(args.leader_elect).lower() in ("true", "1", "yes"):
-            import os as _os
-
-            from datatunerx_tpu.operator.leaderelection import LeaderElector
-
-            # lost leadership = exit; the Deployment restarts the replica,
-            # which re-enters the election (controller-runtime's contract)
-            elector = LeaderElector(
-                client, namespace=args.kube_namespace,
-                lease_duration_s=args.leader_lease_duration,
-                renew_period_s=args.leader_renew_period,
-                on_stopped_leading=lambda: _os._exit(1),
-            )
         return _run_manager(args, store, mgr, elector=elector)
 
     store = AdmittingStore(ObjectStore(persist_dir=args.persist_dir))
@@ -383,6 +420,14 @@ def _run_manager(args, store, mgr: Manager, elector=None) -> int:
         def lead():
             print(f"[controller-manager] became leader as {elector.identity}",
                   flush=True)
+            for cb in getattr(mgr, "leader_callbacks", None) or []:
+                try:
+                    cb()
+                except Exception as e:  # noqa: BLE001 — a failed CA
+                    # re-assert must not block promotion; the rotation loop
+                    # retries on its next check
+                    print(f"[controller-manager] leader callback failed: {e}",
+                          flush=True)
             if getattr(mgr, "slice_pool", None) is not None:
                 # re-read assignments at takeover: the boot-time snapshot of
                 # a standby predates jobs the previous leader placed

@@ -14,6 +14,7 @@ Controllers implement:
 
 from __future__ import annotations
 
+import os
 import dataclasses
 import heapq
 import threading
@@ -107,10 +108,18 @@ class Manager:
         if result and result.requeue_after is not None:
             self.enqueue(kind, namespace, name, after=result.requeue_after)
 
-    def run_until_idle(self, max_wall_s: float = 30.0, treat_delayed_as_idle: float = 0.5):
+    # test suites that shrink the poll intervals (conftest DTX_*_S envs) must
+    # shrink the idle horizon below the smallest interval, or run_until_idle
+    # would spin-reconcile poll-style waits until max_wall_s
+    IDLE_HORIZON_S = float(os.environ.get("DTX_IDLE_HORIZON_S", "0.5"))
+
+    def run_until_idle(self, max_wall_s: float = 30.0,
+                       treat_delayed_as_idle: float = None):
         """Process the queue synchronously until it only holds far-future
         requeues (poll-style waits) or is empty. Virtual time: delayed items
         under `treat_delayed_as_idle`s run immediately."""
+        if treat_delayed_as_idle is None:
+            treat_delayed_as_idle = self.IDLE_HORIZON_S
         deadline = time.monotonic() + max_wall_s
         while time.monotonic() < deadline:
             with self._cv:

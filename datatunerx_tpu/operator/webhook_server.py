@@ -114,6 +114,30 @@ def _generate_ca_and_cert(
     return ca_pem, cert_pem, key_pem
 
 
+def _pem_expiry(cert_pem: bytes) -> Optional[datetime.datetime]:
+    try:
+        from cryptography import x509
+
+        return x509.load_pem_x509_certificate(cert_pem).not_valid_after_utc
+    except Exception:  # noqa: BLE001 — absent/garbled = treat as expired
+        return None
+
+
+def _pem_sans(cert_pem: bytes) -> Optional[set]:
+    """DNS + IP SANs of a PEM cert (None if absent/garbled)."""
+    try:
+        from cryptography import x509
+        from cryptography.x509.oid import ExtensionOID
+
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        sans = cert.extensions.get_extension_for_oid(
+            ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
+        return {str(v) for v in sans.get_values_for_type(x509.DNSName)} | {
+            str(v) for v in sans.get_values_for_type(x509.IPAddress)}
+    except Exception:  # noqa: BLE001 — absent/unsupported = regenerate
+        return None
+
+
 class CertManager:
     """Provision + rotate the webhook serving cert (cert-rotator equivalent,
     reference controller_manager.go:83-111). Certs live under ``cert_dir`` as
@@ -140,64 +164,193 @@ class CertManager:
     def ca_path(self) -> str:
         return os.path.join(self.cert_dir, "ca.crt")
 
-    def _expiry(self) -> Optional[datetime.datetime]:
+    def _cert_pem(self) -> Optional[bytes]:
         try:
-            from cryptography import x509
-
             with open(self.cert_path, "rb") as f:
-                cert = x509.load_pem_x509_certificate(f.read())
-            return cert.not_valid_after_utc
-        except (FileNotFoundError, ValueError):
+                return f.read()
+        except FileNotFoundError:
             return None
+
+    def _expiry(self) -> Optional[datetime.datetime]:
+        pem = self._cert_pem()
+        return _pem_expiry(pem) if pem else None
 
     def _cert_names(self) -> Optional[set]:
         """DNS + IP SANs of the cert on disk (None if absent/garbled)."""
-        try:
-            from cryptography import x509
-            from cryptography.x509.oid import ExtensionOID
+        pem = self._cert_pem()
+        return _pem_sans(pem) if pem else None
 
-            with open(self.cert_path, "rb") as f:
-                cert = x509.load_pem_x509_certificate(f.read())
-            sans = cert.extensions.get_extension_for_oid(
-                ExtensionOID.SUBJECT_ALTERNATIVE_NAME).value
-            return {str(v) for v in sans.get_values_for_type(x509.DNSName)} | {
-                str(v) for v in sans.get_values_for_type(x509.IPAddress)}
-        except Exception:  # noqa: BLE001 — absent/unsupported = regenerate
-            return None
-
-    def needs_rotation(self) -> bool:
-        exp = self._expiry()
+    def _pem_stale(self, cert_pem: Optional[bytes]) -> bool:
+        """Rotation test on raw PEM (shared with the Secret-backed variant):
+        absent, inside the refresh margin, or SAN drift — a cert from an
+        older deploy (e.g. pre-service-SAN localhost-only) must regenerate
+        even with months of validity left, or apiserver TLS verification of
+        service-style routing keeps failing cluster-wide."""
+        if not cert_pem:
+            return True
+        exp = _pem_expiry(cert_pem)
         if exp is None:
             return True
         now = datetime.datetime.now(datetime.timezone.utc)
         if exp - now < self.refresh_margin:
             return True
-        # SAN drift: a persisted cert dir from an older deploy (e.g. the
-        # pre-service-SAN localhost-only cert) must regenerate even though
-        # it has months of validity left — otherwise apiserver TLS
-        # verification of service-style routing keeps failing cluster-wide
-        names = self._cert_names()
+        names = _pem_sans(cert_pem)
         return names is None or not set(self.dns_names) <= names
 
-    def ensure(self) -> bool:
+    def needs_rotation(self) -> bool:
+        return self._pem_stale(self._cert_pem())
+
+    def _write_local(self, ca: bytes, cert: bytes, key: bytes):
+        os.makedirs(self.cert_dir, exist_ok=True)
+        for path, data in ((self.ca_path, ca), (self.cert_path, cert),
+                           (self.key_path, key)):
+            with open(path, "wb") as f:
+                f.write(data)
+
+    def ensure(self, as_leader: bool = True) -> bool:
         """Generate certs if absent or within the refresh margin.
         Returns True when new certs were written (callers must then re-patch
-        the caBundle into the webhook configurations and reload TLS)."""
+        the caBundle into the webhook configurations and reload TLS).
+
+        ``as_leader`` is accepted for interface parity with the HA
+        Secret-backed variant; a local cert dir has exactly one writer
+        (replicas=1 by construction), so it is ignored here."""
+        del as_leader
         with self._lock:
             if not self.needs_rotation():
                 return False
             ca, cert, key = _generate_ca_and_cert(
                 self.dns_names, self.validity_days)
-            os.makedirs(self.cert_dir, exist_ok=True)
-            for path, data in ((self.ca_path, ca), (self.cert_path, cert),
-                               (self.key_path, key)):
-                with open(path, "wb") as f:
-                    f.write(data)
+            self._write_local(ca, cert, key)
             return True
 
     def ca_bundle_b64(self) -> str:
         with open(self.ca_path, "rb") as f:
             return base64.b64encode(f.read()).decode()
+
+
+class SecretBackedCertManager(CertManager):
+    """HA cert manager (VERDICT r3 #6): the CA + serving cert live in one
+    Kubernetes Secret, so every replica serves TLS from the SAME chain — the
+    reference's cert-rotator keeps its certs in a Secret shared by replicas
+    for exactly this reason (reference controller_manager.go:83-111).
+
+    Protocol:
+    - ``ensure(as_leader=True)`` (boot, or the elected leader's rotation
+      loop): if the Secret is absent/stale, generate fresh certs and
+      create-or-CAS-replace the Secret. A lost write race (409) converges on
+      the winner's certs — at most one generation survives, so a fresh HA
+      install booting N replicas still ends with ONE CA.
+    - ``ensure(as_leader=False)`` (standby rotation loop): NEVER generates;
+      pulls whatever the Secret currently holds, returning True when the
+      local materialization changed so the caller hot-reloads its TLS
+      context. Rotation is thereby gated on the election leader.
+
+    ``cert_dir`` is a local materialization of the Secret (ssl needs file
+    paths); it is not shared between replicas and needs no volume."""
+
+    SECRET_KEYS = ("ca.crt", "tls.crt", "tls.key")
+
+    def __init__(self, client, namespace: str, secret_name: str,
+                 cert_dir: str, dns_names: Optional[List[str]] = None,
+                 validity_days: int = 365, refresh_margin_days: int = 30):
+        super().__init__(cert_dir, dns_names=dns_names,
+                         validity_days=validity_days,
+                         refresh_margin_days=refresh_margin_days)
+        self.client = client
+        self.namespace = namespace
+        self.secret_name = secret_name
+
+    # ------------------------------------------------------------ secret io
+    def _read_secret(self) -> Optional[dict]:
+        from datatunerx_tpu.operator.kubeclient import ApiError
+
+        try:
+            return self.client.get("", "v1", "secrets", self.namespace,
+                                   self.secret_name)
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    @staticmethod
+    def _decode(data: dict) -> Dict[str, bytes]:
+        out = {}
+        for k, v in (data or {}).items():
+            try:
+                out[k] = base64.b64decode(v)
+            except Exception:  # noqa: BLE001 — garbled entry = stale
+                out[k] = b""
+        return out
+
+    def _materialize(self, data: dict) -> bool:
+        """Write the Secret payload into cert_dir; True when changed."""
+        decoded = self._decode(data)
+        if not all(decoded.get(k) for k in self.SECRET_KEYS):
+            return False
+        changed = False
+        os.makedirs(self.cert_dir, exist_ok=True)
+        for k in self.SECRET_KEYS:
+            path = os.path.join(self.cert_dir, k)
+            try:
+                with open(path, "rb") as f:
+                    cur = f.read()
+            except FileNotFoundError:
+                cur = None
+            if cur != decoded[k]:
+                with open(path, "wb") as f:
+                    f.write(decoded[k])
+                changed = True
+        return changed
+
+    # ------------------------------------------------------------- rotation
+    def needs_rotation(self) -> bool:
+        sec = self._read_secret()
+        data = self._decode((sec or {}).get("data") or {})
+        return self._pem_stale(data.get("tls.crt"))
+
+    def ensure(self, as_leader: bool = True) -> bool:
+        from datatunerx_tpu.operator.kubeclient import ApiError
+
+        with self._lock:
+            sec = self._read_secret()
+            data = (sec or {}).get("data") or {}
+            stale = self._pem_stale(self._decode(data).get("tls.crt"))
+            if not stale or not as_leader:
+                # healthy Secret (or standby waiting on the leader): converge
+                # the local materialization on whatever the cluster holds
+                return self._materialize(data)
+
+            ca, cert, key = _generate_ca_and_cert(
+                self.dns_names, self.validity_days)
+            body = {
+                "apiVersion": "v1", "kind": "Secret",
+                "metadata": {"name": self.secret_name,
+                             "namespace": self.namespace},
+                "type": "kubernetes.io/tls",
+                "data": {
+                    "ca.crt": base64.b64encode(ca).decode(),
+                    "tls.crt": base64.b64encode(cert).decode(),
+                    "tls.key": base64.b64encode(key).decode(),
+                },
+            }
+            try:
+                if sec is None:
+                    self.client.create("", "v1", "secrets", self.namespace,
+                                       body)
+                else:
+                    body["metadata"]["resourceVersion"] = (
+                        sec.get("metadata") or {}).get("resourceVersion")
+                    self.client.replace("", "v1", "secrets", self.namespace,
+                                        self.secret_name, body)
+            except ApiError as e:
+                if e.status != 409:
+                    raise
+                # lost the generation race: exactly one writer wins; adopt
+                # the winner's certs instead of fighting over the CA
+                sec = self._read_secret()
+                return self._materialize((sec or {}).get("data") or {})
+            return self._materialize(body["data"])
 
 
 # --------------------------------------------------------- admission logic
@@ -357,21 +510,35 @@ class AdmissionWebhookServer:
         return self.server.server_port
 
     def start(self, rotation_check_s: float = 0.0,
-              on_rotate=None) -> "AdmissionWebhookServer":
+              on_rotate=None, is_leader=None) -> "AdmissionWebhookServer":
         """``rotation_check_s`` > 0 starts a background expiry check: when
         the cert enters the refresh margin it is regenerated, the TLS context
         reloaded in place, and ``on_rotate(ca_bundle_b64)`` invoked so the
-        caller can re-patch the webhook configurations."""
+        caller can re-patch the webhook configurations.
+
+        ``is_leader`` (HA): a zero-arg callable consulted each check. Only
+        the leader generates new certs; a standby whose Secret-backed cert
+        manager observes a rotation still hot-reloads its own TLS context
+        (so it keeps serving the shared chain) but leaves the caBundle
+        re-patch to the leader that performed the rotation."""
         self._thread.start()
         if rotation_check_s > 0:
             def loop():
                 while not self._stop.wait(rotation_check_s):
-                    if self.certs.ensure():
-                        # live reload: new handshakes pick up the new chain
-                        self._ssl_ctx.load_cert_chain(
-                            self.certs.cert_path, self.certs.key_path)
-                        if on_rotate is not None:
-                            on_rotate(self.certs.ca_bundle_b64())
+                    try:
+                        leader = True if is_leader is None \
+                            else bool(is_leader())
+                        if self.certs.ensure(as_leader=leader):
+                            # live reload: new handshakes get the new chain
+                            self._ssl_ctx.load_cert_chain(
+                                self.certs.cert_path, self.certs.key_path)
+                            if on_rotate is not None and leader:
+                                on_rotate(self.certs.ca_bundle_b64())
+                    except Exception as e:  # noqa: BLE001 — transient
+                        # apiserver errors must not kill the rotator thread:
+                        # a dead rotator means certs silently expire later
+                        print(f"[webhook-server] rotation check failed: {e}",
+                              flush=True)
 
             self._rotator = threading.Thread(target=loop, daemon=True)
             self._rotator.start()

@@ -9,6 +9,8 @@ named plugin (reference plugin contract, generate.go:343-358).
 
 from __future__ import annotations
 
+import os
+
 from typing import Optional
 
 from datatunerx_tpu.operator.api import Scoring
@@ -21,7 +23,7 @@ from datatunerx_tpu.scoring.dataset_scoring import (
 )
 from datatunerx_tpu.scoring.plugin import resolve_plugin
 
-RETRY_S = 10.0
+RETRY_S = float(os.environ.get("DTX_SCORING_RETRY_S", "10.0"))
 
 
 class ScoringController:

@@ -13,6 +13,23 @@ import os
 
 os.environ.setdefault("TF_ENABLE_ONEDNN_OPTS", "0")
 
+# Fast-poll the controller state machines (VERDICT r3 #7): the suite spent
+# most of its 17 min in 3-30s requeue sleeps. The reference-parity defaults
+# are unchanged in production; these envs only shrink the WAITS — every
+# transition and assertion is identical. Must be set before any
+# datatunerx_tpu.operator import reads them at module load.
+for _k, _v in (
+    ("DTX_POLL_INTERVAL_S", "0.1"),
+    ("DTX_RUNNING_POLL_S", "0.2"),
+    ("DTX_EXPERIMENT_POLL_S", "0.1"),
+    ("DTX_SERVE_POLL_S", "0.1"),
+    ("DTX_SCORING_RETRY_S", "0.2"),
+    ("DTX_RECALIBRATE_REQUEUE_S", "0.2"),
+    ("DTX_ERROR_REQUEUE_S", "0.3"),
+    ("DTX_IDLE_HORIZON_S", "0.05"),
+):
+    os.environ.setdefault(_k, _v)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

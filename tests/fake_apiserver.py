@@ -20,6 +20,13 @@ controllers + KubeObjectStore depend on, with high fidelity:
   config's caBundle), applies returned JSONPatches, and surfaces denials as
   400s, exactly like a real apiserver front-running the operator's webhook
   server
+- structural-schema enforcement (VERDICT r3 #5): stored
+  CustomResourceDefinition objects drive type/enum/required validation AND
+  unknown-field pruning on create/update of their resources, honoring
+  x-kubernetes-preserve-unknown-fields exactly as written, in the real
+  apiserver's phase order (mutating webhooks → prune+validate → validating
+  webhooks). Resources with no stored CRD pass through untouched (builtin
+  kinds). This makes the published deploy/crds/ schemas load-bearing.
 
 Single global revision counter (etcd-style); resourceVersions are digit
 strings as on a real cluster.
@@ -265,10 +272,113 @@ class FakeKubeApiServer:
             node[parts[-1]] = op["value"]
         return obj
 
+    # ------------------------------------------------- structural schemas
+
+    def _crd_schema(self, group: str, plural: str):
+        """openAPIV3Schema of the stored CRD serving (group, plural), or
+        None when no CRD is registered (builtin kinds stay ungated)."""
+        with self.state.lock:
+            for (g, p, _, _), o in self.state.objects.items():
+                if g != "apiextensions.k8s.io" or \
+                        p != "customresourcedefinitions":
+                    continue
+                spec = o.get("spec") or {}
+                names = spec.get("names") or {}
+                if spec.get("group") != group or \
+                        names.get("plural") != plural:
+                    continue
+                for v in spec.get("versions") or []:
+                    if v.get("served"):
+                        return (v.get("schema") or {}).get("openAPIV3Schema")
+        return None
+
+    @classmethod
+    def _prune_validate(cls, schema: dict, value, path: str, errors: list):
+        """Structural-schema semantics (types, enums, required, pruning with
+        x-kubernetes-preserve-unknown-fields honored as written). Returns the
+        pruned value; appends apiserver-shaped messages to ``errors``."""
+        if schema is None:
+            return value
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields") is True
+        t = schema.get("type")
+        if "enum" in schema and value not in schema["enum"]:
+            errors.append(
+                f'{path}: Unsupported value: {json.dumps(value)}: supported'
+                f' values: {", ".join(json.dumps(e) for e in schema["enum"])}')
+            return value
+        if t == "object" or (t is None and "properties" in schema):
+            if not isinstance(value, dict):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected object")
+                return value
+            props = schema.get("properties") or {}
+            for req in schema.get("required") or []:
+                if req not in value:
+                    errors.append(f"{path}.{req}: Required value")
+            out = {}
+            for k, v in value.items():
+                if k in props:
+                    out[k] = cls._prune_validate(props[k], v, f"{path}.{k}",
+                                                 errors)
+                elif preserve or not props:
+                    # open node (explicit preserve, or a bare object with no
+                    # declared properties): unknown fields survive untouched
+                    out[k] = v
+                # else: pruned (a real structural schema drops it silently)
+            return out
+        if t == "array":
+            if not isinstance(value, list):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected array")
+                return value
+            items = schema.get("items")
+            return [cls._prune_validate(items, v, f"{path}[{i}]", errors)
+                    for i, v in enumerate(value)]
+        if t == "string":
+            if not isinstance(value, str):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected string")
+        elif t == "integer":
+            if isinstance(value, bool) or not isinstance(value, int):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected integer")
+        elif t == "number":
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected number")
+        elif t == "boolean":
+            if not isinstance(value, bool):
+                errors.append(f"{path}: Invalid value: {json.dumps(value)}: "
+                              f"expected boolean")
+        return value
+
+    def _enforce_crd_schema(self, group, plural, body):
+        """→ (pruned body, None) or (None, (code, reason, message)).
+        metadata/apiVersion/kind are apiserver-owned and never schema-pruned;
+        status is subresource-managed (stripped on create, preserved on
+        update) so only spec-level data fields go through the schema."""
+        schema = self._crd_schema(group, plural)
+        if schema is None:
+            return body, None
+        errors: list = []
+        props = (schema.get("properties") or {})
+        out = dict(body)
+        for k, sub in props.items():
+            if k in ("metadata", "status") or k not in body:
+                continue
+            out[k] = self._prune_validate(sub, body[k], k, errors)
+        if errors:
+            kind = body.get("kind") or plural[:-1].capitalize()
+            name = (body.get("metadata") or {}).get("name", "")
+            return None, (
+                422, "Invalid",
+                f'{kind}.{group} "{name}" is invalid: ' + "; ".join(errors))
+        return out, None
+
     def _admit(self, group, version, plural, ns, body, operation):
-        """Run the stored webhook chain (mutating first, then validating —
-        apiserver phase order). Returns (possibly-mutated body, None) or
-        (None, (code, reason, message)) on denial/failure."""
+        """Mutating webhooks → structural-schema prune+validate → validating
+        webhooks (the real apiserver's phase order). Returns
+        (possibly-mutated body, None) or (None, (code, reason, message))."""
         if group == self.WEBHOOK_GROUP:
             return body, None  # configurations themselves are not gated
         kind = body.get("kind") or plural[:-1].capitalize()
@@ -285,10 +395,7 @@ class FakeKubeApiServer:
                 "object": obj,
             },
         }
-        for cfg_plural, phase in (
-            ("mutatingwebhookconfigurations", "mutate"),
-            ("validatingwebhookconfigurations", "validate"),
-        ):
+        def run_phase(cfg_plural, phase, body):
             for cfg in self._webhook_configs(cfg_plural):
                 for wh in cfg.get("webhooks") or []:
                     if not self._rules_match(wh.get("rules"), group, version,
@@ -315,7 +422,19 @@ class FakeKubeApiServer:
                         except Exception as e:  # noqa: BLE001
                             return None, (500, "InternalError",
                                           f"bad webhook patch: {e}")
-        return body, None
+            return body, None
+
+        body, denial = run_phase("mutatingwebhookconfigurations", "mutate",
+                                 body)
+        if denial is not None:
+            return None, denial
+        # prune + schema-validate AFTER mutation, BEFORE validating webhooks
+        # (kube-apiserver order: defaulted fields are pruned/validated too,
+        # and validating webhooks see the object as it will be persisted)
+        body, denial = self._enforce_crd_schema(group, plural, body)
+        if denial is not None:
+            return None, denial
+        return run_phase("validatingwebhookconfigurations", "validate", body)
 
     def _post(self, h):
         r = self._parse(h.path)

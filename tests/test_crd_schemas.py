@@ -1,0 +1,245 @@
+"""CRD structural-schema enforcement (VERDICT r3 #5): the PUBLISHED
+deploy/crds/ manifests drive validation and field pruning on the fake
+apiserver, making crdgen.py's schemas load-bearing instead of decorative.
+
+Differential contract:
+- every example/deploy CR manifest round-trips UNCHANGED through a
+  schema-enforcing apiserver (install CRDs first, then create);
+- a corpus of deliberately-wrong manifests is rejected with
+  apiserver-shaped 422 Invalid errors naming the bad field;
+- unknown fields are pruned exactly where the schema closes a node
+  (meshShape, scoring probes) and preserved everywhere
+  x-kubernetes-preserve-unknown-fields is written;
+- the status subresource split is strict: status is stripped on create,
+  immutable through main-resource writes, and only writable via /status.
+"""
+
+import copy
+import glob
+import json
+import os
+
+import pytest
+import yaml
+
+from datatunerx_tpu.operator.api import KIND_BY_NAME
+from datatunerx_tpu.operator.kubeclient import ApiError, KubeClient
+from tests.fake_apiserver import FakeKubeApiServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_published_crds():
+    """The deploy/crds/ YAML files as shipped — NOT all_crds() directly, so
+    a stale checked-in manifest fails these tests."""
+    docs = []
+    for path in sorted(glob.glob(os.path.join(REPO, "deploy", "crds", "*.yaml"))):
+        with open(path) as f:
+            docs.extend(d for d in yaml.safe_load_all(f) if d)
+    assert len(docs) == 8, [d["metadata"]["name"] for d in docs]
+    return docs
+
+
+@pytest.fixture()
+def cluster():
+    srv = FakeKubeApiServer().start()
+    client = KubeClient(base_url=srv.url)
+    for crd in _load_published_crds():
+        client.request(
+            "POST",
+            "/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+            body=crd)
+    yield srv, client
+    srv.stop()
+
+
+def _path_for(doc, name=None):
+    cls = KIND_BY_NAME[doc["kind"]]
+    group, _, version = cls.api_version.partition("/")
+    plural = cls.kind.lower() + "s"
+    ns = doc.get("metadata", {}).get("namespace", "default")
+    base = f"/apis/{group}/{version}/namespaces/{ns}/{plural}"
+    return f"{base}/{name}" if name else base
+
+
+def _create(client, doc):
+    return client.request("POST", _path_for(doc), body=doc)
+
+
+def test_published_crds_match_crdgen():
+    """deploy/crds/ is generated — drift between the checked-in YAML and
+    crdgen.py means the published schemas are stale."""
+    from datatunerx_tpu.operator.crdgen import all_crds
+
+    published = {d["metadata"]["name"]: d for d in _load_published_crds()}
+    for crd in all_crds():
+        assert published[crd["metadata"]["name"]] == crd, \
+            f"stale deploy/crds/{crd['metadata']['name']}.yaml — " \
+            "run scripts/gen_crds.py"
+
+
+def test_all_example_manifests_roundtrip_unchanged(cluster):
+    """Every CR in examples/ creates cleanly and the stored spec is
+    byte-identical to what was sent (no field was pruned or rejected)."""
+    srv, client = cluster
+    n = 0
+    for path in sorted(glob.glob(os.path.join(REPO, "examples", "*.json"))):
+        with open(path) as f:
+            docs = json.load(f)
+        for doc in docs:
+            if doc["kind"] not in KIND_BY_NAME:
+                continue
+            sent_spec = copy.deepcopy(doc.get("spec", {}))
+            created = _create(client, doc)
+            assert created["spec"] == sent_spec, (path, doc["metadata"])
+            n += 1
+    assert n >= 6  # quickstart + rlhf corpora
+
+
+REJECT_CORPUS = [
+    # (kind, spec, expected fragment of the apiserver error)
+    ("Finetune", {"llm": "m"}, "spec.dataset: Required value"),
+    ("Finetune", {"llm": "m", "dataset": "d", "node": "two"},
+     "spec.node: Invalid value"),
+    ("Finetune", {"llm": "m", "dataset": "d", "backoffLimit": True},
+     "spec.backoffLimit: Invalid value"),
+    ("Hyperparameter", {"parameters": {"scheduler": "warp"}},
+     "spec.parameters.scheduler: Unsupported value"),
+    ("Hyperparameter", {"parameters": {"optimizer": "sgd9000"}},
+     "Unsupported value"),
+    ("Hyperparameter", {"parameters": {"quantImpl": "cuda"}},
+     "spec.parameters.quantImpl: Unsupported value"),
+    ("Hyperparameter", {"parameters": {"batchSize": 4}},
+     "spec.parameters.batchSize: Invalid value"),  # reference quirk: strings
+    ("Hyperparameter", {"parameters": {"meshShape": {"dp": "four"}}},
+     "spec.parameters.meshShape.dp: Invalid value"),
+    ("Hyperparameter", {"parameters": "r=8"},
+     "spec.parameters: Invalid value"),
+    ("FinetuneJob", {}, "spec.finetune: Required value"),
+    ("FinetuneJob", {"finetune": {"name": "x"}},
+     "spec.finetune.finetuneSpec: Required value"),
+    ("FinetuneExperiment", {"pending": True},
+     "spec.finetuneJobs: Required value"),
+    ("FinetuneExperiment", {"finetuneJobs": {"name": "a"}},
+     "spec.finetuneJobs: Invalid value"),
+    ("Dataset", {}, "spec.datasetMetadata: Required value"),
+    ("Dataset", {"datasetMetadata": {"datasetInfo": {"subsets": "train"}}},
+     "subsets: Invalid value"),
+    ("Scoring", {"metric": "vibes"}, "spec.metric: Unsupported value"),
+    ("Scoring", {"probes": [{"prompt": 42}]},
+     "spec.probes[0].prompt: Invalid value"),
+]
+
+
+@pytest.mark.parametrize(
+    "kind,spec,fragment",
+    REJECT_CORPUS,
+    ids=[f"{k}-{frag.split(':')[0].replace('.', '_')}"
+         for k, _, frag in REJECT_CORPUS])
+def test_wrong_manifests_rejected_with_apiserver_errors(cluster, kind, spec,
+                                                        fragment):
+    srv, client = cluster
+    cls = KIND_BY_NAME[kind]
+    doc = {"apiVersion": cls.api_version, "kind": kind,
+           "metadata": {"name": "bad", "namespace": "default"},
+           "spec": spec}
+    with pytest.raises(ApiError) as ei:
+        _create(client, doc)
+    assert ei.value.status == 422, ei.value.body
+    assert "is invalid" in ei.value.body
+    assert fragment in ei.value.body, (fragment, ei.value.body)
+
+
+def test_unknown_fields_pruned_in_closed_meshshape(cluster):
+    """meshShape is a CLOSED node: a typo'd axis is pruned (so it can never
+    silently change the mesh) while unknown fields under the open
+    parameters node survive (x-kubernetes-preserve-unknown-fields)."""
+    srv, client = cluster
+    doc = {"apiVersion": "core.datatunerx.io/v1beta1",
+           "kind": "Hyperparameter",
+           "metadata": {"name": "prune", "namespace": "default"},
+           "spec": {"parameters": {
+               "meshShape": {"dp": 2, "fspd": 4},     # typo'd axis
+               "customAnnotation": "kept",            # open node: preserved
+           }}}
+    created = _create(client, doc)
+    assert created["spec"]["parameters"]["meshShape"] == {"dp": 2}
+    assert created["spec"]["parameters"]["customAnnotation"] == "kept"
+
+
+def test_unknown_fields_pruned_in_closed_probes(cluster):
+    srv, client = cluster
+    doc = {"apiVersion": "extension.datatunerx.io/v1beta1", "kind": "Scoring",
+           "metadata": {"name": "prune-probe", "namespace": "default"},
+           "spec": {"probes": [{"prompt": "p", "reference": "r",
+                                "weight": 2}]}}
+    created = _create(client, doc)
+    assert created["spec"]["probes"] == [{"prompt": "p", "reference": "r"}]
+
+
+def test_open_nodes_preserve_unknown_fields(cluster):
+    """LLM.spec is open: arbitrary extra fields (quickstart's `family`)
+    must survive exactly as written."""
+    srv, client = cluster
+    doc = {"apiVersion": "core.datatunerx.io/v1beta1", "kind": "LLM",
+           "metadata": {"name": "open", "namespace": "default"},
+           "spec": {"path": "preset:debug", "family": "llama",
+                    "extra": {"nested": [1, 2]}}}
+    created = _create(client, doc)
+    assert created["spec"] == doc["spec"]
+
+
+def test_update_also_schema_gated(cluster):
+    srv, client = cluster
+    doc = {"apiVersion": "core.datatunerx.io/v1beta1",
+           "kind": "Hyperparameter",
+           "metadata": {"name": "upd", "namespace": "default"},
+           "spec": {"parameters": {"scheduler": "cosine"}}}
+    created = _create(client, doc)
+    bad = copy.deepcopy(created)
+    bad["spec"]["parameters"]["scheduler"] = "warp"
+    with pytest.raises(ApiError) as ei:
+        client.request("PUT", _path_for(doc, "upd"), body=bad)
+    assert ei.value.status == 422
+    assert "Unsupported value" in ei.value.body
+
+
+def test_status_subresource_split_strict(cluster):
+    """Create strips status; main-resource PUT cannot touch status; /status
+    PUT writes only status."""
+    srv, client = cluster
+    doc = {"apiVersion": "finetune.datatunerx.io/v1beta1", "kind": "Finetune",
+           "metadata": {"name": "st", "namespace": "default"},
+           "spec": {"llm": "m", "dataset": "d"},
+           "status": {"state": "SUCCESSFUL"}}
+    created = _create(client, doc)
+    assert created["status"] == {}  # stripped on create
+
+    smuggle = copy.deepcopy(created)
+    smuggle["status"] = {"state": "SUCCESSFUL"}
+    updated = client.request("PUT", _path_for(doc, "st"), body=smuggle)
+    assert updated["status"] == {}  # main write cannot set status
+
+    st = copy.deepcopy(updated)
+    st["status"] = {"state": "RUNNING"}
+    via_sub = client.request("PUT", _path_for(doc, "st") + "/status", body=st)
+    assert via_sub["status"] == {"state": "RUNNING"}
+    # and a status write cannot smuggle spec changes
+    st2 = copy.deepcopy(via_sub)
+    st2["spec"] = {"llm": "other", "dataset": "d"}
+    st2["status"] = {"state": "RUNNING", "x": 1}
+    via_sub2 = client.request("PUT", _path_for(doc, "st") + "/status",
+                              body=st2)
+    assert via_sub2["spec"] == {"llm": "m", "dataset": "d"}
+
+
+def test_builtin_kinds_stay_ungated(cluster):
+    """No CRD stored for jobsets: arbitrary shapes pass through (the fake
+    mirrors a real apiserver's builtin handling, which we don't model)."""
+    srv, client = cluster
+    created = client.request(
+        "POST", "/apis/jobset.x-k8s.io/v1alpha2/namespaces/default/jobsets",
+        body={"apiVersion": "jobset.x-k8s.io/v1alpha2", "kind": "JobSet",
+              "metadata": {"name": "js", "namespace": "default"},
+              "spec": {"replicatedJobs": "whatever"}})
+    assert created["spec"] == {"replicatedJobs": "whatever"}

@@ -168,7 +168,9 @@ def test_controller_dataset_missing_retries():
                        "datasetRef": "absent"})
     store.create(sc)
     res = ScoringController(timeout=1).reconcile(store, store.get(Scoring, "s-miss"))
-    assert res is not None and res.requeue_after == 10.0
+    from datatunerx_tpu.scoring.controller import RETRY_S
+
+    assert res is not None and res.requeue_after == RETRY_S
     assert "not found" in store.get(Scoring, "s-miss").status["lastError"]
 
 

@@ -91,7 +91,7 @@ def test_minimum_end_to_end_slice(tmp_path):
         state = store.get(FinetuneJob, "e2e").status.get("state")
         if state in (FinetuneJob.STATE_SUCCESSFUL, FinetuneJob.STATE_FAILED):
             break
-        time.sleep(2)
+        time.sleep(0.2)
 
     ft = store.try_get(Finetune, "e2e-finetune")
     job = store.get(FinetuneJob, "e2e")
@@ -186,7 +186,7 @@ def test_concurrent_experiment_two_live_jobs(tmp_path):
         state = store.get(FinetuneExperiment, "exp-live").status.get("state", "")
         if state in ("Success", "Failed"):
             break
-        time.sleep(2)
+        time.sleep(0.2)
 
     exp = store.get(FinetuneExperiment, "exp-live")
     diag = json.dumps(exp.status, default=str)[:1200]
@@ -293,7 +293,7 @@ def test_four_concurrent_jobs_through_slice_placement(tmp_path):
         state = store.get(FinetuneExperiment, "exp4").status.get("state", "")
         if state in ("Success", "Failed"):
             break
-        time.sleep(2)
+        time.sleep(0.2)
 
     exp = store.get(FinetuneExperiment, "exp4")
     diag = json.dumps(exp.status, default=str)[:1500]
