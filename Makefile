@@ -4,7 +4,7 @@
 IMG_OPERATOR ?= datatunerx-tpu/operator:latest
 IMG_TRAINER  ?= datatunerx-tpu/trainer:latest
 
-.PHONY: test test-fast native bench graft-check docker-build deploy undeploy fmt
+.PHONY: test test-fast native bench graft-check aot-certify docker-build deploy undeploy fmt
 
 test:            ## full test suite (8-device virtual CPU mesh)
 	python -m pytest tests/ -q
@@ -20,6 +20,9 @@ bench:           ## headline benchmark (one JSON line)
 
 graft-check:     ## driver contract: entry() + dryrun_multichip(8)
 	python scripts/graft_check.py
+
+aot-certify:     ## deviceless Mosaic/XLA-TPU compile certification (v5e)
+	python scripts/aot_certify.py
 
 docker-build:    ## operator + trainer images
 	docker build -t $(IMG_OPERATOR) -f Dockerfile .

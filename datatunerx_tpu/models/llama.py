@@ -24,6 +24,7 @@ Param tree (HF-compatible leaf names so weight conversion is mechanical):
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional
 
 import jax
@@ -385,7 +386,14 @@ def forward(
         cache["v_scale"] if quant_kv else None,
         jnp.arange(cfg.num_layers, dtype=jnp.int32),
     )
-    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(block, x, xs)
+    # DTX_SCAN_UNROLL: cost-analysis instrumentation (scripts/aot_certify.py).
+    # XLA's cost_analysis counts a while-loop body ONCE regardless of trip
+    # count, so a compiled train step under-reports flops/bytes by ~L×;
+    # compiling at unroll=1 vs unroll=2 and differencing recovers the exact
+    # per-layer cost. Default 1 = production behavior, byte-identical program.
+    _unroll = int(os.environ.get("DTX_SCAN_UNROLL", "1"))
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(block, x, xs,
+                                                     unroll=_unroll)
 
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
     logits = None if skip_logits else lm_logits(params, x, cfg)

@@ -237,6 +237,15 @@ class ApiHandler(BaseHTTPRequestHandler):
                     or obj.metadata.namespace != (m["ns"] or "default")):
                 return self._send(400, {
                     "error": "metadata.name/namespace must match the URL path"})
+            # kube semantics: a main-resource PUT cannot write .status (that
+            # is the /status subresource, which this server doesn't expose) —
+            # keep the stored status so a UI/CLI spec edit can't wipe
+            # controller bookkeeping (scores, checkpoint refs)
+            try:
+                obj.status = self.store.get(
+                    kind, m["name"], m["ns"] or "default").status
+            except NotFound:
+                pass
             updated = self.store.update(obj)
             return self._send(200, updated.to_dict())
         except AdmissionError as e:

@@ -107,6 +107,9 @@ SPECS = {
     }, required=["datasetMetadata"]),
     "Scoring": obj({
         "inferenceService": STR,
+        # named adapter on a multi-adapter engine: N Scorings against ONE
+        # endpoint compare N tuned checkpoints side-by-side (BASELINE row 6)
+        "model": STR,
         "plugin": obj({"loadPlugin": BOOL, "name": STR, "parameters": STR}),
         # closed: the scorer consumes exactly prompt/reference per probe
         "probes": arr(obj({"prompt": STR, "reference": STR},

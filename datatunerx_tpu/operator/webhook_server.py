@@ -618,9 +618,15 @@ def install_webhooks(client, ca_bundle_b64: str, base_url: str):
     fail-closed value (a degraded no-cryptography boot flips it to Ignore —
     see manager._neutralize_webhook_configs — and a later healthy start
     must undo that, or one degraded run permanently converts admission to
-    fail-open); the deployed ROUTING (service vs url) is the cluster
-    operator's choice and survives restarts. Fresh configurations (dev /
-    fake-apiserver runs) are created url-style against ``base_url``."""
+    fail-open). Routing: a service-style clientConfig (in-cluster DNS, the
+    apiserver resolves it to whatever pod currently backs the Service) is
+    the cluster operator's choice and survives restarts untouched; a
+    url-style clientConfig names ONE process's address, so the caller that
+    is now serving admission must re-point it at its own ``base_url`` — on
+    HA failover the promoted standby re-installs, and leaving the URL at
+    the dead leader would keep fail-closed admission returning Connection
+    refused cluster-wide. Fresh configurations (dev / fake-apiserver runs)
+    are created url-style against ``base_url``."""
     for cfg in webhook_configurations(ca_bundle_b64, base_url):
         plural = cfg["kind"].lower() + "s"
         path = (f"/apis/admissionregistration.k8s.io/v1/{plural}/"
@@ -635,8 +641,13 @@ def install_webhooks(client, ca_bundle_b64: str, base_url: str):
         cur = copy.deepcopy(cur)
         rendered_policy = {wh["name"]: wh.get("failurePolicy", "Fail")
                            for wh in cfg["webhooks"]}
+        rendered_url = {wh["name"]: wh["clientConfig"]["url"]
+                        for wh in cfg["webhooks"]}
         for wh in cur.get("webhooks") or []:
-            wh.setdefault("clientConfig", {})["caBundle"] = ca_bundle_b64
+            cc = wh.setdefault("clientConfig", {})
+            cc["caBundle"] = ca_bundle_b64
+            if "url" in cc and wh.get("name") in rendered_url:
+                cc["url"] = rendered_url[wh["name"]]
             if wh.get("name") in rendered_policy:
                 wh["failurePolicy"] = rendered_policy[wh["name"]]
         client.request("PUT", path, body=cur)

@@ -1,0 +1,26 @@
+"""Shared interpret-mode gate for the Pallas kernels.
+
+Default: interpret (emulate with standard JAX ops) everywhere except on a
+real TPU backend — CPU tests exercise kernel numerics without Mosaic.
+
+``DTX_PALLAS_INTERPRET=0`` forces REAL Mosaic lowering regardless of the
+default backend: deviceless AOT certification (scripts/aot_certify.py)
+compiles against a TPU topology while ``jax_platforms=cpu`` is set (the
+wedged-relay workaround, VERDICT r4 next #1), where ``default_backend()``
+says "cpu" but the compile target is the real XLA-TPU/Mosaic pipeline —
+without the override the certification would silently compile the
+emulation path and prove nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def interpret_default() -> bool:
+    env = (os.environ.get("DTX_PALLAS_INTERPRET") or "").strip()
+    if env:  # empty/unset -> backend default ("VAR= cmd" must not force Mosaic)
+        return env.lower() not in ("0", "false", "no")
+    return jax.default_backend() != "tpu"

@@ -43,7 +43,9 @@ def _seg3d(q_seg: jnp.ndarray, kv_seg: jnp.ndarray):
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from datatunerx_tpu.ops._pallas import interpret_default
+
+    return interpret_default()
 
 
 # ------------------------------------------------------------- forward

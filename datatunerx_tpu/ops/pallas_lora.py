@@ -19,7 +19,9 @@ from jax.experimental import pallas as pl
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from datatunerx_tpu.ops._pallas import interpret_default
+
+    return interpret_default()
 
 
 def _lora_kernel(x_ref, w_ref, a_ref, b_ref, o_ref, *, scale: float):

@@ -22,7 +22,9 @@ from datatunerx_tpu.ops.quant import NF4_CODE
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from datatunerx_tpu.ops._pallas import interpret_default
+
+    return interpret_default()
 
 
 def _pad_rows(x2d: jnp.ndarray, bm: int) -> Tuple[jnp.ndarray, int]:

@@ -45,14 +45,20 @@ def validate_probes(probes) -> Optional[List[Dict[str, str]]]:
 
 
 def query_chat(endpoint: str, prompt: str, timeout: float = 60.0,
-               max_tokens: int = 64) -> str:
+               max_tokens: int = 64, model: Optional[str] = None) -> str:
+    body = {
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+    }
+    if model:
+        # routes to a named LoRA adapter on multi-adapter engines
+        # (serving/server.py "model" handling) — side-by-side scoring of N
+        # tuned checkpoints through ONE engine (BASELINE row 6)
+        body["model"] = model
     req = urllib.request.Request(
         endpoint,
-        data=json.dumps({
-            "messages": [{"role": "user", "content": prompt}],
-            "max_tokens": max_tokens,
-            "temperature": 0.0,
-        }).encode(),
+        data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
@@ -65,6 +71,7 @@ def score_endpoint(
     inference_url: str,
     probes: Optional[List[Dict[str, str]]] = None,
     timeout: float = 60.0,
+    model: Optional[str] = None,
 ) -> Dict:
     """Returns {"score": "NN.N", "details": [...]}; raises on transport errors
     so the controller can retry."""
@@ -72,7 +79,8 @@ def score_endpoint(
     details = []
     total = 0.0
     for probe in probes:
-        answer = query_chat(inference_url, probe["prompt"], timeout=timeout)
+        answer = query_chat(inference_url, probe["prompt"], timeout=timeout,
+                            model=model)
         s = generation_scores(answer, probe["reference"], strict_bleu=True)
         per = max(s["rouge-l"], s["bleu-4"])
         total += per

@@ -87,10 +87,15 @@ class ScoringController:
                     return Result(requeue_after=RETRY_S)
                 result = score_dataset(url, ds.spec, metric=metric,
                                        max_examples=max_examples,
-                                       timeout=self.timeout)
+                                       timeout=self.timeout,
+                                       model=scoring.spec.get("model"))
                 score, details = result["score"], result["details"]
             else:
-                result = score_endpoint(url, probes=probes, timeout=self.timeout)
+                result = score_endpoint(
+                    url, probes=probes, timeout=self.timeout,
+                    # spec.model: named adapter on a multi-adapter engine —
+                    # N Scorings against ONE endpoint compare N checkpoints
+                    model=scoring.spec.get("model"))
                 score, details = result["score"], result["details"]
         except Exception as e:  # endpoint not ready / transient — retry
             scoring.status["lastError"] = str(e)[:500]

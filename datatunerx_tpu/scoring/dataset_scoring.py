@@ -79,12 +79,15 @@ def load_eval_records(dataset_spec: dict,
 
 
 def query_perplexity(endpoint: str, prompt: str, completion: str,
-                     timeout: float = 60.0) -> dict:
+                     timeout: float = 60.0, model=None) -> dict:
     """POST the serving /perplexity endpoint (serving/server.py)."""
     url = endpoint.rsplit("/chat/completions", 1)[0].rstrip("/") + "/perplexity"
+    body = {"prompt": prompt, "completion": completion}
+    if model:
+        body["model"] = model  # adapter routing, serving/server.py
     req = urllib.request.Request(
         url,
-        data=json.dumps({"prompt": prompt, "completion": completion}).encode(),
+        data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
@@ -98,6 +101,7 @@ def score_dataset(
     metric: str = "generation",
     max_examples: int = DEFAULT_MAX_EXAMPLES,
     timeout: float = 60.0,
+    model=None,
 ) -> Dict:
     """Returns {"score": "NN.N", "details": {…}} over the dataset's eval split."""
     records = load_eval_records(dataset_spec, max_examples=max_examples)
@@ -107,7 +111,7 @@ def score_dataset(
         total_nll, total_tokens = 0.0, 0
         for r in records:
             resp = query_perplexity(inference_url, r["prompt"], r["reference"],
-                                    timeout=timeout)
+                                    timeout=timeout, model=model)
             total_nll += float(resp["nll_sum"])
             total_tokens += int(resp["num_tokens"])
         mean_nll = total_nll / max(total_tokens, 1)
@@ -125,7 +129,8 @@ def score_dataset(
     total = 0.0
     agg = {"rouge-1": 0.0, "rouge-2": 0.0, "rouge-l": 0.0, "bleu-4": 0.0}
     for r in records:
-        answer = query_chat(inference_url, r["prompt"], timeout=timeout)
+        answer = query_chat(inference_url, r["prompt"], timeout=timeout,
+                            model=model)
         s = generation_scores(answer, r["reference"], strict_bleu=True)
         total += max(s["rouge-l"], s["bleu-4"])
         for k in agg:

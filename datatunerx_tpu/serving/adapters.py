@@ -1,0 +1,41 @@
+"""Adapter-checkpoint utilities for multi-adapter serving.
+
+``make_adapter_checkpoint`` synthesizes a LoRA adapter checkpoint with
+random weights — the shape/layout of a real training run's Orbax state
+(``{"lora": {"layers": ...}}``, loadable by
+``batched_engine.load_checkpoint_state``) without paying for a training
+run. Used by the side-by-side serving bench
+(``scripts/bench_serving.py::bench_multi_adapter``, BASELINE row 6) and its
+test (``tests/test_sidebyside_serving.py``); numerics are meaningless by
+design — only routing, throughput, and isolation are measured.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models import get_config
+from datatunerx_tpu.models.lora import init_lora_params
+
+
+def make_adapter_checkpoint(path: str, model: str, seed: int,
+                            rank: int = 4,
+                            targets=("q_proj", "v_proj")) -> str:
+    """Write a synthetic LoRA adapter checkpoint under ``path`` and return
+    it. Random A AND B (init's zero-B would make the adapter a no-op and
+    every adapter identical)."""
+    from datatunerx_tpu.training.checkpoint import CheckpointManager
+
+    cfg = get_config(model.split(":")[-1])
+    key = jax.random.PRNGKey(seed)
+    lora = init_lora_params(cfg, key, rank=rank, targets=tuple(targets))
+    layers = {}
+    for i, (t, leaf) in enumerate(sorted(lora["layers"].items())):
+        b = 0.05 * jax.random.normal(
+            jax.random.fold_in(key, 1000 + i), leaf["b"].shape, jnp.float32)
+        layers[t] = {"a": leaf["a"], "b": b}
+    mngr = CheckpointManager(path)
+    mngr.maybe_save({"lora": {"layers": layers}}, step=1, force=True)
+    mngr.close()
+    return path

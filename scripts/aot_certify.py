@@ -1,0 +1,511 @@
+"""Deviceless AOT certification against the v5e TPU target (VERDICT r4 #1).
+
+The tunneled TPU relay has been wedged for most of rounds 1-4, so no Pallas
+kernel had compile evidence from a real TPU toolchain since round 2. This
+script removes the relay from the loop entirely: JAX topology-based AOT
+compilation against the locally-installed libtpu runs the REAL Mosaic /
+XLA-TPU pipeline — lowering, tiling, buffer assignment — with zero devices
+attached:
+
+    jax.config.update("jax_platforms", "cpu")     # never touch the relay
+    topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+    jax.jit(fn).lower(<abstract args on topo.devices[0]>).compile()
+
+``DTX_PALLAS_INTERPRET=0`` (set below) is load-bearing: with the platform
+forced to cpu the kernels' default interpret gate would silently swap in
+the emulated pallas path and the "certification" would prove nothing
+(ops/_pallas.py).
+
+Certified artifacts (each records compile status + compiler cost analysis +
+buffer-assignment memory analysis into ``AOT_CERTIFY.json``):
+
+  kernels   flash attention fwd/bwd (causal GQA + packed segments), int8
+            matmul fwd/bwd, nf4 matmul fwd, TRANSPOSED nf4 backward (the
+            default training path, never compiled by a real toolchain
+            before this script), fused LoRA
+  steps     full Llama-2-7B QLoRA train step under both --quant_impl
+            values (BASELINE row 2 geometry); Qwen1.5-14B nf4 B1 + B2
+            (BASELINE row 5 + its stated over-budget point); Mistral-7B
+            full-param fsdp=16 per-shard program on a 16-chip v5e
+            topology (BASELINE row 4)
+  serving   BatchedEngine decode step (debug scale; the decode graph's
+            Mosaic lowering is scale-independent)
+  memory    compiler buffer-assignment bytes vs parallel/memory.py's
+            ``estimate_footprint`` for the three BASELINE configs
+            (VERDICT r4 #3)
+  roofline  per-step flops + HBM bytes for the pallas vs xla 7B paths →
+            bandwidth/compute-bound tokens/s/chip upper bounds on v5e
+            (197 TFLOP/s bf16, 819 GB/s HBM; VERDICT r4 #4)
+
+Run:  python scripts/aot_certify.py [--only PATTERN] [--out AOT_CERTIFY.json]
+Make: make aot-certify
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+import time
+import traceback
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Must be set before the kernels' interpret gates are consulted; platform
+# must be cpu before anything touches the (possibly wedged) relay backend.
+os.environ["DTX_PALLAS_INTERPRET"] = "0"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import (  # noqa: E402
+    NamedSharding,
+    PartitionSpec as P,
+    SingleDeviceSharding,
+)
+
+# v5e peaks for the roofline (How to Scale Your Model, v5e spec sheet).
+V5E_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_S = 819e9
+
+TOPOLOGY_1CHIP = "v5e:2x2"   # v5e:1x1 is rejected (chips_per_host_bounds 2x2)
+TOPOLOGY_16CHIP = "v5e:4x4"
+
+
+def _topo(name: str):
+    return topologies.get_topology_desc(platform="tpu", topology_name=name)
+
+
+def _sds(tree, sharding):
+    """Attach `sharding` to every leaf of an abstract (eval_shape) tree."""
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
+        tree)
+
+
+def _cost(compiled) -> dict:
+    ca = compiled.cost_analysis() or {}
+    return {
+        "flops": ca.get("flops"),
+        "bytes_accessed": ca.get("bytes accessed"),
+    }
+
+
+def _memory(compiled) -> dict:
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return {}
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    tmp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "alias_bytes": alias,
+        # live HBM while the program runs: args + outputs + scratch, minus
+        # donated buffers counted on both sides
+        "peak_bytes": arg + out + tmp - alias,
+    }
+
+
+class Certifier:
+    def __init__(self, out_path: str, only: str | None):
+        self.out_path = out_path
+        self.only = only
+        self.records = []
+        self.meta = {
+            "date": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+            "jax": jax.__version__,
+            "topology": {"single": TOPOLOGY_1CHIP, "sharded": TOPOLOGY_16CHIP},
+            "pallas_interpret": False,
+        }
+
+    def run(self, name: str, fn):
+        if self.only and not fnmatch.fnmatch(name, self.only):
+            return None
+        t0 = time.perf_counter()
+        rec = {"name": name}
+        try:
+            extra = fn() or {}
+            rec.update(extra)
+            rec["ok"] = True
+        except Exception as e:  # noqa: BLE001 — each artifact independent
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec["traceback"] = traceback.format_exc(limit=8)
+        rec["compile_s"] = round(time.perf_counter() - t0, 1)
+        self.records.append(rec)
+        self.flush()
+        status = "OK " if rec["ok"] else "FAIL"
+        print(f"[{status}] {name} ({rec['compile_s']}s)"
+              + ("" if rec["ok"] else f"  {rec['error']}"), flush=True)
+        return rec
+
+    def flush(self):
+        doc = dict(self.meta)
+        doc["artifacts"] = self.records
+        doc["ok"] = all(r["ok"] for r in self.records)
+        with open(self.out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+# ------------------------------------------------------------------ kernels
+
+def kernel_artifacts(cert: Certifier, dev):
+    from datatunerx_tpu.ops.flash_attention import flash_attention
+    from datatunerx_tpu.ops.pallas_lora import pallas_lora_matmul
+    from datatunerx_tpu.ops.pallas_quant import (
+        pallas_matmul_int8,
+        pallas_matmul_nf4,
+    )
+    from datatunerx_tpu.ops.quant import quantize_int8, quantize_nf4
+
+    sh = SingleDeviceSharding(dev)
+    B, T, H, KV, D = 1, 1024, 8, 2, 64  # GQA 4:1
+    q = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16, sharding=sh)
+    kv = jax.ShapeDtypeStruct((B, T, KV, D), jnp.bfloat16, sharding=sh)
+    seg = jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=sh)
+
+    def _lower(fn, *args, mosaic: bool = True):
+        lo = jax.jit(fn).lower(*args)
+        if mosaic:
+            assert "tpu_custom_call" in lo.as_text(), "not Mosaic-lowered"
+        c = lo.compile()
+        return {"cost": _cost(c), "memory": _memory(c)}
+
+    cert.run("kernel/flash_fwd_causal_gqa",
+             lambda: _lower(lambda q, k, v: flash_attention(q, k, v), q, kv, kv))
+    cert.run("kernel/flash_bwd_causal_gqa", lambda: _lower(
+        lambda q, k, v: jax.grad(
+            lambda q, k, v: flash_attention(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v), q, kv, kv))
+    cert.run("kernel/flash_fwd_segmented", lambda: _lower(
+        lambda q, k, v, s: flash_attention(q, k, v, segment_ids=s),
+        q, kv, kv, seg))
+    cert.run("kernel/flash_bwd_segmented", lambda: _lower(
+        lambda q, k, v, s: jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, segment_ids=s).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v), q, kv, kv, seg))
+
+    K, N, M = 4096, 4096, 512
+    x = jax.ShapeDtypeStruct((M, K), jnp.bfloat16, sharding=sh)
+    qw = _sds(jax.eval_shape(
+        quantize_nf4, jax.ShapeDtypeStruct((K, N), jnp.bfloat16)), sh)
+    q8 = _sds(jax.eval_shape(
+        quantize_int8, jax.ShapeDtypeStruct((K, N), jnp.bfloat16)), sh)
+
+    cert.run("kernel/nf4_matmul_fwd", lambda: _lower(
+        lambda x, qw: pallas_matmul_nf4(x, qw, (K, N)), x, qw))
+    cert.run("kernel/nf4_matmul_bwd_transposed", lambda: _lower(
+        lambda x, qw: jax.grad(
+            lambda x: pallas_matmul_nf4(
+                x, qw, (K, N)).astype(jnp.float32).sum())(x), x, qw))
+    cert.run("kernel/int8_matmul_fwd", lambda: _lower(
+        lambda x, q8: pallas_matmul_int8(x, q8["q"], q8["scale"]), x, q8))
+    # int8's custom VJP is deliberately XLA (dx = (g*scale) @ qT einsum —
+    # pallas_quant.py:64-71): certify it compiles for TPU, not that it's Mosaic
+    cert.run("kernel/int8_matmul_bwd_xla_vjp", lambda: _lower(
+        lambda x, q8: jax.grad(
+            lambda x: pallas_matmul_int8(
+                x, q8["q"], q8["scale"]).astype(jnp.float32).sum())(x),
+        x, q8, mosaic=False))
+
+    w = jax.ShapeDtypeStruct((K, N), jnp.bfloat16, sharding=sh)
+    a = jax.ShapeDtypeStruct((K, 8), jnp.bfloat16, sharding=sh)
+    b = jax.ShapeDtypeStruct((8, N), jnp.bfloat16, sharding=sh)
+    cert.run("kernel/lora_fused_fwd", lambda: _lower(
+        lambda x, w, a, b: pallas_lora_matmul(x, w, a, b, scale=4.0),
+        x, w, a, b))
+
+
+# -------------------------------------------------------------- train steps
+
+def _abstract_params(cfg):
+    from datatunerx_tpu.models import init_params
+
+    def build(key):
+        p = init_params(cfg, key, dtype=jnp.bfloat16)
+        if cfg.quantization:
+            from datatunerx_tpu.ops.quant import quantize_model_params
+
+            p = quantize_model_params(p, cfg.quantization)
+        return p
+
+    return jax.eval_shape(build, jax.random.PRNGKey(0))
+
+
+def _single_chip_step(cfg, train_cfg, batch: int, seq: int, dev):
+    """Compile one full Trainer.train_step on one topology device; returns
+    (compiled, trainer)."""
+    from datatunerx_tpu.training import Trainer
+    from datatunerx_tpu.training.loss import IGNORE_INDEX  # noqa: F401
+
+    sh = SingleDeviceSharding(dev)
+    tr = Trainer(cfg, train_cfg)
+    params_abs = _abstract_params(cfg)
+    state_abs = _sds(
+        jax.eval_shape(tr.init_state, params_abs, jax.random.PRNGKey(1)), sh)
+    batch_abs = {
+        "input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=sh),
+        "labels": jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=sh),
+    }
+    compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+        state_abs, batch_abs).compile()
+    return compiled
+
+
+def _estimate(cfg, train_cfg, batch, seq, mesh_shape=None):
+    from datatunerx_tpu.parallel.memory import estimate_footprint
+
+    fp = estimate_footprint(cfg, train_cfg, batch=batch, seq=seq,
+                            mesh_shape=mesh_shape)
+    return fp
+
+
+def _mem_vs_estimate(compiled, fp) -> dict:
+    mem = _memory(compiled)
+    est = fp.total
+    peak = mem.get("peak_bytes")
+    out = {
+        "memory": mem,
+        "estimate_bytes": int(est),
+        "estimate_gb": fp.gb(),
+    }
+    if peak:
+        out["compiler_peak_gb"] = round(peak / 1e9, 3)
+        out["estimate_over_compiler"] = round(est / peak, 3)
+    return out
+
+
+def _lora_cfg(**kw):
+    from datatunerx_tpu.training import TrainConfig
+
+    return TrainConfig(
+        finetuning_type="lora", lora_rank=8, lora_alpha=32.0,
+        lora_dropout=0.05, lora_targets=("q_proj", "v_proj"),
+        learning_rate=2e-4, scheduler="cosine", optimizer="adamw",
+        total_steps=1000, compute_dtype=jnp.bfloat16, **kw)
+
+
+def step_artifacts(cert: Certifier, dev):
+    from datatunerx_tpu.models import get_config
+
+    tokens = {}
+
+    def seven_b(quant_impl):
+        def go():
+            cfg = get_config("llama2-7b", remat="full", attention_impl="flash",
+                             quantization="int4", quant_impl=quant_impl)
+            tc = _lora_cfg()
+            compiled = _single_chip_step(cfg, tc, 4, 1024, dev)
+            fp = _estimate(cfg, tc, 4, 1024)
+            rec = _mem_vs_estimate(compiled, fp)
+            rec["cost"] = _cost(compiled)
+            rec["cost_note"] = ("XLA cost_analysis counts the layer scan "
+                                "body ONCE (trip count invisible) and sees "
+                                "no flops inside Mosaic custom calls — see "
+                                "analysis/roofline_7b_v5e for the corrected "
+                                "per-step totals")
+            rec["tokens_per_step"] = 4 * 1024
+            tokens[quant_impl] = rec
+            return rec
+        return go
+
+    cert.run("step/train_7b_qlora_pallas", seven_b("pallas"))
+    cert.run("step/train_7b_qlora_xla", seven_b("xla"))
+
+    # Roofline from compiler-derived per-layer costs (VERDICT r4 #4).
+    # Method: cost_analysis counts a lax.scan body once, so compile the SAME
+    # step at DTX_SCAN_UNROLL=1 and =2 and difference: the unroll=2 program
+    # inlines two layers per loop iteration, so C2 - C1 = one layer's exact
+    # cost, nonscan = C1 - (C2 - C1), per-step total = L*(C2-C1) + nonscan.
+    # Mosaic custom-call flops are invisible to the compiler either way, so
+    # kernel matmul flops (exact by construction: 2*b*t*K*N per projection)
+    # are added analytically for the pallas path; bytes_accessed DOES count
+    # custom-call operands, so HBM traffic needs no correction.
+    def roofline():
+        from datatunerx_tpu.models import get_config as _gc
+
+        out = {}
+        L = 32
+        B, T = 4, 1024
+        tok = B * T
+        # exact matmul flops inside the Mosaic kernels, per layer per step:
+        # 7 quantized projections (q,k,v,o 4096x4096; gate,up 4096x11008;
+        # down 11008x4096) x (fwd + remat-refwd + bwd dx) = 3 passes
+        D, F = 4096, 11008
+        proj_flops = 2 * tok * (4 * D * D + 3 * D * F)
+        kernel_flops_per_layer = 3 * proj_flops
+        for impl in ("pallas", "xla"):
+            c1 = tokens[impl]["cost"]
+            os.environ["DTX_SCAN_UNROLL"] = "2"
+            try:
+                cfg = _gc("llama2-7b", remat="full", attention_impl="flash",
+                          quantization="int4", quant_impl=impl)
+                compiled2 = _single_chip_step(cfg, _lora_cfg(), B, T, dev)
+                c2 = _cost(compiled2)
+            finally:
+                os.environ["DTX_SCAN_UNROLL"] = "1"
+            layer = {k: c2[k] - c1[k] for k in ("flops", "bytes_accessed")}
+            nonscan = {k: c1[k] - layer[k] for k in layer}
+            fl = L * layer["flops"] + nonscan["flops"]
+            by = L * layer["bytes_accessed"] + nonscan["bytes_accessed"]
+            if impl == "pallas":
+                fl += L * kernel_flops_per_layer
+            t_flops = fl / V5E_BF16_FLOPS
+            t_hbm = by / V5E_HBM_BYTES_S
+            out[impl] = {
+                "per_layer": layer,
+                "nonscan": nonscan,
+                "kernel_flops_per_layer": (kernel_flops_per_layer
+                                           if impl == "pallas" else 0),
+                "flops_per_step": fl,
+                "hbm_bytes_per_step": by,
+                "flops_time_s": round(t_flops, 5),
+                "hbm_time_s": round(t_hbm, 5),
+                "bound": "hbm" if t_hbm > t_flops else "flops",
+                "tokens_per_sec_upper_bound": round(
+                    tok / max(t_flops, t_hbm), 1),
+                "mfu_at_bound": round(
+                    (fl / max(t_flops, t_hbm)) / V5E_BF16_FLOPS, 3),
+            }
+        return {"roofline": out, "tokens_per_step": tok, "layers": L}
+
+    cert.run("analysis/roofline_7b_v5e", roofline)
+
+    def qwen(batch):
+        def go():
+            cfg = get_config("qwen1.5-14b", remat="full",
+                             attention_impl="flash", quantization="int4",
+                             quant_impl="pallas")
+            tc = _lora_cfg()
+            compiled = _single_chip_step(cfg, tc, batch, 1024, dev)
+            fp = _estimate(cfg, tc, batch, 1024)
+            rec = _mem_vs_estimate(compiled, fp)
+            rec["cost"] = _cost(compiled)
+            from datatunerx_tpu.parallel.memory import hbm_budget
+
+            rec["hbm_budget_bytes"] = hbm_budget("v5e")
+            peak = rec["memory"].get("peak_bytes")
+            if peak:
+                rec["fits_v5e1_by_compiler"] = peak <= rec["hbm_budget_bytes"]
+            return rec
+        return go
+
+    cert.run("step/train_qwen14b_qlora_b1", qwen(1))
+    cert.run("step/train_qwen14b_qlora_b2_overbudget", qwen(2))
+
+
+def mistral_fsdp_artifact(cert: Certifier):
+    from datatunerx_tpu.models import get_config
+    from datatunerx_tpu.parallel.mesh import make_mesh
+    from datatunerx_tpu.parallel.sharding import batch_shardings, tree_shardings
+    from datatunerx_tpu.training import TrainConfig, Trainer
+
+    def go():
+        topo = _topo(TOPOLOGY_16CHIP)
+        mesh = make_mesh(devices=topo.devices, fsdp=16)
+        cfg = get_config("mistral-7b", remat="full", attention_impl="flash")
+        tc = TrainConfig(finetuning_type="full", compute_dtype=jnp.bfloat16)
+        tr = Trainer(cfg, tc, mesh=mesh)
+        params_abs = _abstract_params(cfg)
+        params_sh = tree_shardings(params_abs, mesh)
+        params_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            params_abs, params_sh)
+        repl = NamedSharding(mesh, P())
+        rng_in = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
+        # let the compiler propagate state shardings from init_state itself —
+        # the same program the trainer runs, so the per-shard train step below
+        # sees exactly the trainer's layouts
+        init_c = jax.jit(tr.init_state).lower(params_in, rng_in).compile()
+        state_sh = init_c.output_shardings
+        state_abs = jax.eval_shape(tr.init_state, params_abs,
+                                   jax.random.PRNGKey(1))
+        state_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            state_abs, state_sh)
+        B, T = 16, 1024
+        batch_abs = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                     "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        bsh = batch_shardings(batch_abs, mesh)
+        batch_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            batch_abs, bsh)
+        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+            state_in, batch_in).compile()
+        fp = _estimate(cfg, tc, B, T, mesh_shape={"fsdp": 16})
+        rec = _mem_vs_estimate(compiled, fp)
+        rec["cost"] = _cost(compiled)
+        rec["mesh"] = {"fsdp": 16}
+        return rec
+
+    cert.run("step/train_mistral7b_full_fsdp16", go)
+
+
+# ----------------------------------------------------------------- serving
+
+def serving_artifact(cert: Certifier, dev):
+    def go():
+        from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+        eng = BatchedEngine("preset:debug", template="vanilla",
+                            max_seq_len=256, slots=4, decode_chunk=8)
+        try:
+            sh = SingleDeviceSharding(dev)
+            to_sds = lambda t: _sds(  # noqa: E731
+                jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t), sh)
+            args = (eng.params, eng._cache, eng._logits, eng._pos,
+                    eng._remaining, eng._active, eng._rng, eng._temps,
+                    eng._top_ps, eng._stops, eng._adapter_idx)
+            abs_args = tuple(to_sds(a) for a in args)
+            compiled = jax.jit(
+                eng._decode_impl, static_argnames=("K",)).lower(
+                *abs_args, K=8).compile()
+            return {"cost": _cost(compiled), "memory": _memory(compiled),
+                    "scale": "debug (decode graph lowering is "
+                             "scale-independent)"}
+        finally:
+            eng.close()
+
+    cert.run("serving/decode_step", go)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "AOT_CERTIFY.json"))
+    ap.add_argument("--only", default=None,
+                    help="fnmatch pattern over artifact names")
+    args = ap.parse_args()
+
+    cert = Certifier(args.out, args.only)
+    dev = _topo(TOPOLOGY_1CHIP).devices[0]
+
+    kernel_artifacts(cert, dev)
+    step_artifacts(cert, dev)
+    mistral_fsdp_artifact(cert)
+    serving_artifact(cert, dev)
+
+    cert.flush()
+    n_ok = sum(r["ok"] for r in cert.records)
+    print(f"\n{n_ok}/{len(cert.records)} artifacts certified "
+          f"-> {args.out}", flush=True)
+    return 0 if n_ok == len(cert.records) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,6 +149,90 @@ def bench_prefix_cache(model: str, prompt_len: int, max_seq: int,
         eng.close()
 
 
+def bench_multi_adapter(model: str, n_adapters: int, gen_tokens: int,
+                        prompt_len: int, max_seq: int,
+                        cpu_fallback: bool) -> list:
+    """BASELINE row 6 schema: N tuned checkpoints served side-by-side by ONE
+    engine (stacked adapters, per-slot indexing) — per-adapter admission
+    latency + per-slot decode tok/s while all N decode concurrently."""
+    import tempfile
+
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    tag = f"{model.split(':')[-1]},adapters={n_adapters}"
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = {
+            f"a{i}": make_adapter_checkpoint(f"{tmp}/ckpt{i}", model, seed=i,
+                                             rank=8)
+            for i in range(n_adapters)
+        }
+        eng = BatchedEngine(model, adapters=paths, template="vanilla",
+                            max_seq_len=max_seq, slots=n_adapters,
+                            decode_chunk=8)
+        try:
+            import numpy as np
+
+            rng = np.random.default_rng(3)
+            prompts = {name: [int(t) for t in rng.integers(10, 1000,
+                                                           prompt_len)]
+                       for name in paths}
+            # warm compile (prefill + decode with adapter indexing)
+            eng.generate(prompts["a0"], max_new_tokens=4, adapter="a0",
+                         timeout=900)
+
+            lines = []
+            # per-adapter admission latency: prefill + first token
+            for name in paths:
+                t0 = time.perf_counter()
+                eng.generate(prompts[name], max_new_tokens=1, adapter=name,
+                             timeout=900)
+                lines.append({
+                    "metric": (f"serving_admission_latency_ms[{tag},"
+                               f"slot={name}]"),
+                    "value": round((time.perf_counter() - t0) * 1e3, 2),
+                    "unit": "ms",
+                    "vs_baseline": None,
+                })
+
+            # concurrent decode: one request per adapter, all slots busy
+            t0 = time.perf_counter()
+            reqs = {name: eng.submit(prompts[name],
+                                     max_new_tokens=gen_tokens,
+                                     temperature=0.0, stop_ids={-1},
+                                     adapter=name)
+                    for name in paths}
+            per_slot = {}
+            for name, r in reqs.items():
+                if not r.done.wait(timeout=900):
+                    raise TimeoutError(f"adapter {name} decode timed out")
+                if r.error:
+                    raise RuntimeError(r.error)
+                per_slot[name] = len(r.tokens)
+            dt = time.perf_counter() - t0
+            for name, n_tok in sorted(per_slot.items()):
+                lines.append({
+                    "metric": (f"serving_multi_adapter_decode_tokens_per_sec"
+                               f"[{tag},slot={name}]"),
+                    "value": round(n_tok / dt, 1),
+                    "unit": "tokens/s",
+                    "vs_baseline": None,
+                })
+            lines.append({
+                "metric": (f"serving_multi_adapter_decode_tokens_per_sec"
+                           f"[{tag},aggregate]"),
+                "value": round(sum(per_slot.values()) / dt, 1),
+                "unit": "tokens/s",
+                "vs_baseline": None,
+            })
+            if cpu_fallback:
+                for line in lines:
+                    line["cpu_fallback"] = True
+            return lines
+        finally:
+            eng.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", default="1,4,8")
@@ -174,6 +258,10 @@ def main():
             results.append(line)
     for line in bench_prefix_cache(model, prompt_len, max_seq,
                                    cpu_fallback=not on_tpu):
+        print(json.dumps(line), flush=True)
+        results.append(line)
+    for line in bench_multi_adapter(model, 3, gen_tokens, prompt_len, max_seq,
+                                    cpu_fallback=not on_tpu):
         print(json.dumps(line), flush=True)
         results.append(line)
 

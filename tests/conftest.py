@@ -13,6 +13,17 @@ import os
 
 os.environ.setdefault("TF_ENABLE_ONEDNN_OPTS", "0")
 
+# Persistent XLA compilation cache (VERDICT r4 #5): the suite's dominant cost
+# is recompiling the same debug-model programs — in-process jits AND every
+# spawned tuning.train / serving.server subprocess (env vars inherit). Keyed
+# by HLO+config, so correctness-neutral; measured 43s -> 16s on one CLI e2e.
+# Repo-local dir so repeat suite runs start warm (gitignored).
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_compilation_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # Fast-poll the controller state machines (VERDICT r3 #7): the suite spent
 # most of its 17 min in 3-30s requeue sleeps. The reference-parity defaults
 # are unchanged in production; these envs only shrink the WAITS — every
@@ -34,6 +45,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# the env vars above bind spawned subprocesses (fresh interpreters read them
+# at import); for THIS process jax was already imported by sitecustomize, so
+# the config must be set explicitly — from the env values, so a user's own
+# JAX_COMPILATION_CACHE_DIR override keeps process and subprocesses aligned
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                  float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                  int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
 
 import pytest  # noqa: E402
 

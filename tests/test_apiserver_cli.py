@@ -262,3 +262,112 @@ def test_ui_served_and_trainermetrics(tmp_path):
         assert code == 404
     finally:
         srv.shutdown()
+
+
+def test_ui_crud_workflow_templates_pass_admission(api):
+    """The web UI's write path (ui.html r5: resource CRUD + job/experiment
+    submission) drives the same endpoints with the same prefill templates;
+    every template must clear admission or the '+ new' buttons ship broken."""
+    store, base = api
+
+    # the UI's TEMPLATES map, verbatim shapes (ui.html)
+    templates = {
+        "datasets": {
+            "apiVersion": "extension.datatunerx.io/v1beta1", "kind": "Dataset",
+            "metadata": {"name": "my-dataset", "namespace": "default"},
+            "spec": {"datasetMetadata": {"datasetInfo": {
+                "subsets": [{"splits": {
+                    "train": {"file": "/data/train.csv"},
+                    "validate": {"file": "/data/val.csv"}}}],
+                "features": [{"name": "instruction", "mapTo": "q"},
+                             {"name": "response", "mapTo": "a"}]}}},
+        },
+        "llms": {
+            "apiVersion": "core.datatunerx.io/v1beta1", "kind": "LLM",
+            "metadata": {"name": "my-llm", "namespace": "default"},
+            "spec": {"path": "/models/llama2-7b"},
+        },
+        "hyperparameters": {
+            "apiVersion": "core.datatunerx.io/v1beta1", "kind": "Hyperparameter",
+            "metadata": {"name": "my-hp", "namespace": "default"},
+            "spec": {"parameters": {
+                "scheduler": "cosine", "optimizer": "adamw", "loRA_R": "8",
+                "loRA_Alpha": "32", "loRA_Dropout": "0.1",
+                "learningRate": "2e-4", "epochs": "1", "blockSize": "1024",
+                "batchSize": "4", "gradAccSteps": "1", "PEFT": "true",
+                "FP16": "false"}},
+        },
+        "scorings": {
+            "apiVersion": "extension.datatunerx.io/v1beta1", "kind": "Scoring",
+            "metadata": {"name": "my-scoring", "namespace": "default"},
+            "spec": {"inferenceService": "http://127.0.0.1:8000/chat/completions",
+                     "probes": [{"prompt": "What is a TPU?",
+                                 "reference": "An ML accelerator."}]},
+        },
+    }
+    groups = {"datasets": "extension.datatunerx.io",
+              "llms": "core.datatunerx.io",
+              "hyperparameters": "core.datatunerx.io",
+              "scorings": "extension.datatunerx.io"}
+    for plural, obj in templates.items():
+        code, body = _req(
+            "POST", f"{base}/apis/{groups[plural]}/v1beta1/{plural}", obj)
+        assert code == 201, (plural, body)
+
+    # the UI's jobSpec() builder, then submit + edit + delete round trip
+    job = {
+        "apiVersion": "finetune.datatunerx.io/v1beta1", "kind": "FinetuneJob",
+        "metadata": {"name": "my-job", "namespace": "default"},
+        "spec": {"finetune": {"name": "my-job-finetune", "finetuneSpec": {
+            "llm": "my-llm", "dataset": "my-dataset",
+            "hyperparameter": {"hyperparameterRef": "my-hp"},
+            "image": {"name": "my-job-img", "path": ""}, "node": 1}}},
+    }
+    code, body = _req(
+        "POST", f"{base}/apis/finetune.datatunerx.io/v1beta1/finetunejobs", job)
+    assert code == 201, body
+
+    # experiment with the UI's learningRate sweep shape
+    exp = {
+        "apiVersion": "finetune.datatunerx.io/v1beta1",
+        "kind": "FinetuneExperiment",
+        "metadata": {"name": "my-exp", "namespace": "default"},
+        "spec": {"finetuneJobs": [
+            {"name": f"my-exp-v{i}", "spec": {"finetune": {
+                "name": f"my-exp-v{i}-finetune", "finetuneSpec": {
+                    "llm": "my-llm", "dataset": "my-dataset",
+                    "hyperparameter": {"hyperparameterRef": "my-hp",
+                                       "overrides": {"learningRate": v}},
+                    "image": {"name": f"my-exp-v{i}-img", "path": ""},
+                    "node": 1}}}}
+            for i, v in enumerate(["1e-4", "2e-4"])]},
+    }
+    code, body = _req(
+        "POST",
+        f"{base}/apis/finetune.datatunerx.io/v1beta1/finetuneexperiments", exp)
+    assert code == 201, body
+
+    # edit (UI PUT path): bump a hyperparameter value
+    code, cur = _req(
+        "GET", f"{base}/apis/core.datatunerx.io/v1beta1/hyperparameters/default/my-hp")
+    assert code == 200
+    cur.pop("status", None)
+    cur["spec"]["parameters"]["learningRate"] = "1e-4"
+    code, body = _req(
+        "PUT", f"{base}/apis/core.datatunerx.io/v1beta1/hyperparameters/default/my-hp", cur)
+    assert code == 200, body
+
+    # delete (UI DELETE path)
+    for path in ("finetune.datatunerx.io/v1beta1/finetuneexperiments/default/my-exp",
+                 "finetune.datatunerx.io/v1beta1/finetunejobs/default/my-job"):
+        code, _ = _req("DELETE", f"{base}/apis/{path}")
+        assert code == 200
+
+    # the served page carries the CRUD surface markers
+    import urllib.request
+
+    with urllib.request.urlopen(base + "/", timeout=10) as r:
+        html = r.read().decode()
+    for marker in ("newResource", "newJob", "newExperiment", "TEMPLATES",
+                   "m-json"):
+        assert marker in html, marker
