@@ -19,6 +19,24 @@ import os
 import jax
 
 
+def pick_block_n(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is ≤ cap and lane-aligned (multiple of
+    128), preferred; else the largest power-of-two divisor ≤ cap.
+
+    ``min(cap, n)`` + divisibility assert is NOT enough in general: real
+    model dims are not all multiples of 256 (Qwen1.5-14B intermediate size
+    13696 = 128 × 107 broke the nf4 path's ``assert N % 256 == 0`` — caught
+    by AOT certification, never reachable while the relay was wedged)."""
+    cap = min(cap, n)
+    for bn in range(cap - cap % 128, 0, -128):
+        if n % bn == 0:
+            return bn
+    bn = 1
+    while bn * 2 <= cap and n % (bn * 2) == 0:
+        bn *= 2
+    return bn
+
+
 def interpret_default() -> bool:
     env = (os.environ.get("DTX_PALLAS_INTERPRET") or "").strip()
     if env:  # empty/unset -> backend default ("VAR= cmd" must not force Mosaic)

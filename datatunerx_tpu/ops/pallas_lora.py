@@ -52,8 +52,9 @@ def pallas_lora_matmul(
     if pad:
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
     M = x2d.shape[0]
-    bn = min(block_n, N)
-    assert N % bn == 0, (N, bn)
+    from datatunerx_tpu.ops._pallas import pick_block_n
+
+    bn = pick_block_n(N, block_n)
     r = a.shape[1]
 
     out = pl.pallas_call(

@@ -85,8 +85,9 @@ def _pallas_matmul_int8_impl(
     x2d = x.reshape(-1, K)
     x2d, m_real = _pad_rows(x2d, block_m)
     M = x2d.shape[0]
-    bn = min(block_n, N)
-    assert N % bn == 0, (N, bn)
+    from datatunerx_tpu.ops._pallas import pick_block_n
+
+    bn = pick_block_n(N, block_n)
 
     out = pl.pallas_call(
         _int8_kernel,
@@ -266,8 +267,9 @@ def _pallas_matmul_nf4_t_impl(
     g2d = g.reshape(-1, N)
     g2d, m_real = _pad_rows(g2d, block_m)
     M = g2d.shape[0]
-    bn = min(block_n, N)
-    assert N % bn == 0, (N, bn)
+    from datatunerx_tpu.ops._pallas import pick_block_n
+
+    bn = pick_block_n(N, block_n)
     nn = N // bn
 
     packedk = qw["packed"].reshape(N, nk, nb_chunk, half)
@@ -321,8 +323,9 @@ def _pallas_matmul_nf4_impl(
     x2d = x.reshape(-1, K)
     x2d, m_real = _pad_rows(x2d, block_m)
     M = x2d.shape[0]
-    bn = min(block_n, N)
-    assert N % bn == 0, (N, bn)
+    from datatunerx_tpu.ops._pallas import pick_block_n
+
+    bn = pick_block_n(N, block_n)
 
     xk = x2d.reshape(M, nk, ck).transpose(1, 0, 2)  # [nk, M, ck]
     packedk = qw["packed"].reshape(N, nk, nb_chunk, half).transpose(1, 0, 2, 3)

@@ -56,11 +56,12 @@ class Footprint:
     grads: int
     activations: int
     logits: int
+    fsdp_gather: int = 0  # XLA's whole-stack weight gathers (fsdp>1 only)
 
     @property
     def total(self) -> int:
         return (self.params + self.lora + self.opt_state + self.grads
-                + self.activations + self.logits)
+                + self.activations + self.logits + self.fsdp_gather)
 
     def gb(self) -> Dict[str, float]:
         d = {f.name: round(getattr(self, f.name) / 1e9, 3)
@@ -172,6 +173,24 @@ def estimate_footprint(
     I = model_cfg.intermediate_size  # noqa: E741
     V = model_cfg.vocab_size
 
+    # ---- fsdp weight-gather live set: with parameters sharded over fsdp,
+    # XLA all-gathers weights to compute. For the scan-stacked layout it
+    # chooses to gather some stacked kernels WHOLE (outside the loop), not
+    # per-layer: compiler buffer assignment for Mistral-7B full-param
+    # fsdp=16 shows ~9 GB of temps ≈ the two largest stacked kernels
+    # gathered in full (AOT_CERTIFY.json step/train_mistral7b_full_fsdp16,
+    # r5). Model that observed behavior: the two largest fsdp-sharded
+    # stacked leaves, un-sharded. Zero when fsdp == 1 (nothing to gather).
+    fsdp = mesh_shape.get("fsdp", 1)
+    gather_bytes = 0
+    if fsdp > 1:
+        stacked = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params_shape)[0]:
+            if _shard_divisor(path, leaf, {"fsdp": fsdp}) > 1:
+                size = math.prod(leaf.shape) if leaf.shape else 1
+                stacked.append(size * jnp.dtype(leaf.dtype).itemsize)
+        gather_bytes = sum(sorted(stacked, reverse=True)[:2])
+
     if model_cfg.remat in ("full", "dots"):
         # stored across the whole fwd: the per-layer boundary residual
         # stream (fwd copy + its gradient in the bwd sweep)
@@ -207,6 +226,7 @@ def estimate_footprint(
     return Footprint(
         params=params_bytes, lora=lora_bytes, opt_state=opt_bytes,
         grads=grad_bytes, activations=act_bytes, logits=logits_bytes,
+        fsdp_gather=gather_bytes,
     )
 
 

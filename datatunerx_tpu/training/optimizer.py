@@ -87,4 +87,32 @@ def make_optimizer(
     if max_grad_norm and max_grad_norm > 0:
         chain.append(optax.clip_by_global_norm(max_grad_norm))
     chain.append(core)
-    return optax.chain(*chain)
+    return _dtype_stable(optax.chain(*chain))
+
+
+def _dtype_stable(inner):
+    """Pin every optimizer-state leaf to its init dtype across updates.
+
+    optax moment updates compute in the GRADS dtype (fp32), so bf16 moments
+    (full-param bf16 training inits bf16 moments) silently promote to fp32
+    after one step: state no longer matches the Orbax restore template from
+    ``init_state``, and train-step buffer donation stops aliasing (output
+    dtypes differ from the donated inputs) — found by AOT buffer-assignment
+    analysis (scripts/aot_certify.py r5). The cast-back happens AFTER the
+    fp32 update math, so update precision is unchanged; only storage dtype
+    is held stable."""
+    import jax
+
+    def init(params):
+        return inner.init(params)
+
+    def update(updates, state, params=None):
+        new_updates, new_state = inner.update(updates, state, params)
+        new_state = jax.tree_util.tree_map(
+            lambda new, old: (new.astype(old.dtype)
+                              if hasattr(old, "dtype") and hasattr(new, "astype")
+                              and new.dtype != old.dtype else new),
+            new_state, state)
+        return new_updates, new_state
+
+    return optax.GradientTransformation(init, update)

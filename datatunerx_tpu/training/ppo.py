@@ -301,7 +301,10 @@ class PPOTrainer(Trainer):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.lora)
         updates, opt_state = self.optimizer.update(
             grads, state.opt_state, state.lora)
-        new_lora = jax.tree_util.tree_map(jnp.add, state.lora, updates)
+        # cast back to the param dtype (bare add would promote against fp32
+        # updates — see train_lib._train_step_impl)
+        new_lora = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), state.lora, updates)
         metrics = dict(aux)
         metrics["loss"] = loss
         metrics["lr"] = self.schedule(state.step)

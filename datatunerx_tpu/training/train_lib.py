@@ -377,7 +377,13 @@ class Trainer:
         updates, opt_state = self.optimizer.update(grads, state.opt_state, trainable)
         if cfg.finetuning_type == "freeze":
             updates = jax.tree_util.tree_map(jnp.multiply, updates, mask)
-        new_trainable = jax.tree_util.tree_map(jnp.add, trainable, updates)
+        # apply in the update dtype, then cast back to the param dtype: a bare
+        # jnp.add promotes bf16 params against fp32 updates, so one full-param
+        # step silently doubled the whole state (and broke train-step buffer
+        # donation, since output dtypes no longer matched the donated inputs)
+        # — caught by AOT buffer-assignment analysis, scripts/aot_certify.py
+        new_trainable = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), trainable, updates)
 
         grad_norm = optax_global_norm(grads)
         metrics = {

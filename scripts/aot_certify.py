@@ -303,8 +303,6 @@ def _lora_cfg(**kw):
 def step_artifacts(cert: Certifier, dev):
     from datatunerx_tpu.models import get_config
 
-    tokens = {}
-
     def seven_b(quant_impl):
         def go():
             cfg = get_config("llama2-7b", remat="full", attention_impl="flash",
@@ -320,7 +318,6 @@ def step_artifacts(cert: Certifier, dev):
                                 "analysis/roofline_7b_v5e for the corrected "
                                 "per-step totals")
             rec["tokens_per_step"] = 4 * 1024
-            tokens[quant_impl] = rec
             return rec
         return go
 
@@ -328,14 +325,19 @@ def step_artifacts(cert: Certifier, dev):
     cert.run("step/train_7b_qlora_xla", seven_b("xla"))
 
     # Roofline from compiler-derived per-layer costs (VERDICT r4 #4).
-    # Method: cost_analysis counts a lax.scan body once, so compile the SAME
-    # step at DTX_SCAN_UNROLL=1 and =2 and difference: the unroll=2 program
-    # inlines two layers per loop iteration, so C2 - C1 = one layer's exact
-    # cost, nonscan = C1 - (C2 - C1), per-step total = L*(C2-C1) + nonscan.
-    # Mosaic custom-call flops are invisible to the compiler either way, so
-    # kernel matmul flops (exact by construction: 2*b*t*K*N per projection)
-    # are added analytically for the pallas path; bytes_accessed DOES count
-    # custom-call operands, so HBM traffic needs no correction.
+    # Method: cost_analysis counts a lax.scan body ONCE (trip count is
+    # invisible), so the full-step numbers above under-report by ~L×. To
+    # recover exact per-layer cost WITHOUT compiling a 32-layer unrolled
+    # program (measured pathological: >1 h), compile the same step for
+    # num_layers=1 and num_layers=2 models of identical geometry with the
+    # scan FULLY unrolled (DTX_SCAN_UNROLL = L, so the loop is inlined and
+    # every op is counted): C2 - C1 = one layer's exact fwd+remat+bwd cost,
+    # nonscan (embed+lm_head+loss) = C1 - (C2 - C1), per-step total =
+    # L*(C2-C1) + nonscan. Mosaic custom-call flops are invisible to the
+    # compiler either way, so kernel matmul flops (exact by construction:
+    # 2*b*t*K*N per projection) are added analytically for the pallas path;
+    # bytes_accessed DOES count custom-call operands, so HBM traffic needs
+    # no correction.
     def roofline():
         from datatunerx_tpu.models import get_config as _gc
 
@@ -350,15 +352,19 @@ def step_artifacts(cert: Certifier, dev):
         proj_flops = 2 * tok * (4 * D * D + 3 * D * F)
         kernel_flops_per_layer = 3 * proj_flops
         for impl in ("pallas", "xla"):
-            c1 = tokens[impl]["cost"]
-            os.environ["DTX_SCAN_UNROLL"] = "2"
-            try:
-                cfg = _gc("llama2-7b", remat="full", attention_impl="flash",
-                          quantization="int4", quant_impl=impl)
-                compiled2 = _single_chip_step(cfg, _lora_cfg(), B, T, dev)
-                c2 = _cost(compiled2)
-            finally:
-                os.environ["DTX_SCAN_UNROLL"] = "1"
+            cs = {}
+            for n_layers in (1, 2):
+                os.environ["DTX_SCAN_UNROLL"] = str(n_layers)
+                try:
+                    cfg = _gc("llama2-7b", remat="full",
+                              attention_impl="flash", quantization="int4",
+                              quant_impl=impl, num_layers=n_layers)
+                    compiled_n = _single_chip_step(cfg, _lora_cfg(), B, T,
+                                                   dev)
+                    cs[n_layers] = _cost(compiled_n)
+                finally:
+                    os.environ["DTX_SCAN_UNROLL"] = "1"
+            c1, c2 = cs[1], cs[2]
             layer = {k: c2[k] - c1[k] for k in ("flops", "bytes_accessed")}
             nonscan = {k: c1[k] - layer[k] for k in layer}
             fl = L * layer["flops"] + nonscan["flops"]
@@ -422,19 +428,16 @@ def mistral_fsdp_artifact(cert: Certifier):
         tc = TrainConfig(finetuning_type="full", compute_dtype=jnp.bfloat16)
         tr = Trainer(cfg, tc, mesh=mesh)
         params_abs = _abstract_params(cfg)
-        params_sh = tree_shardings(params_abs, mesh)
-        params_in = jax.tree_util.tree_map(
-            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
-            params_abs, params_sh)
-        repl = NamedSharding(mesh, P())
-        rng_in = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
-        # let the compiler propagate state shardings from init_state itself —
-        # the same program the trainer runs, so the per-shard train step below
-        # sees exactly the trainer's layouts
-        init_c = jax.jit(tr.init_state).lower(params_in, rng_in).compile()
-        state_sh = init_c.output_shardings
         state_abs = jax.eval_shape(tr.init_state, params_abs,
                                    jax.random.PRNGKey(1))
+        # shard the abstract state by the trainer's OWN rules (the same
+        # _spec_for path rules shard_tree applies on device): adam moment
+        # trees mirror the param tree's paths, so tree_shardings covers
+        # params + opt state; scalars/rng fall to P() (replicated). Relying
+        # on XLA output-sharding propagation through an AOT init compile
+        # instead replicated the moments and "OOM"ed the per-shard step at
+        # 27.8 GB of arguments.
+        state_sh = tree_shardings(state_abs, mesh)
         state_in = jax.tree_util.tree_map(
             lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
             state_abs, state_sh)
@@ -445,7 +448,16 @@ def mistral_fsdp_artifact(cert: Certifier):
         batch_in = jax.tree_util.tree_map(
             lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
             batch_abs, bsh)
-        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+        # pin the new state to the input layouts so donation aliases (else
+        # XLA may re-shard outputs, no buffers alias, and "peak" double
+        # counts the whole state); metrics are replicated scalars
+        metrics_abs = jax.eval_shape(tr._train_step_impl, state_abs,
+                                     batch_abs)[1]
+        repl = NamedSharding(mesh, P())
+        out_sh = (state_sh, jax.tree_util.tree_map(lambda _: repl,
+                                                   metrics_abs))
+        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,),
+                           out_shardings=out_sh).lower(
             state_in, batch_in).compile()
         fp = _estimate(cfg, tc, B, T, mesh_shape={"fsdp": 16})
         rec = _mem_vs_estimate(compiled, fp)

@@ -57,8 +57,11 @@ def _eventually(mgr, predicate, timeout_s: float = 20.0, gap_s: float = 0.05):
         except Exception:
             pass
         if time.monotonic() > deadline:
-            if predicate():  # reached exactly at the deadline — not a failure
-                return
+            try:
+                if predicate():  # reached at the deadline — not a failure
+                    return
+            except Exception:
+                pass  # a raising predicate is still "not reached"
             raise AssertionError(f"condition not reached in {timeout_s}s")
         time.sleep(gap_s)
 

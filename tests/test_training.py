@@ -204,3 +204,27 @@ def test_freeze_opt_state_skips_frozen_leaves():
     # moments only for gate/up/down kernels (3 leaves x mu+nu + counts) — far
     # fewer arrays than 2x all params
     assert n_opt < n_params, (n_opt, n_params)
+
+
+def test_full_param_step_preserves_param_dtype():
+    """One full-param train step must keep bf16 params bf16: a bare
+    params+updates add promotes to fp32 (updates are fp32), silently
+    doubling the state and breaking train-step buffer donation — caught by
+    AOT buffer-assignment analysis (scripts/aot_certify.py, round 5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from datatunerx_tpu.models import get_config, init_params
+    from datatunerx_tpu.training import TrainConfig, Trainer
+
+    cfg = get_config("debug", attention_impl="xla", remat="none")
+    tr = Trainer(cfg, TrainConfig(finetuning_type="full",
+                                  compute_dtype=jnp.bfloat16))
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    state = tr.init_state(params, jax.random.PRNGKey(1))
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                              cfg.vocab_size, jnp.int32)
+    state2, _ = tr.train_step(state, {"input_ids": toks, "labels": toks})
+    before = jax.tree_util.tree_map(lambda x: x.dtype, state.params)
+    after = jax.tree_util.tree_map(lambda x: x.dtype, state2.params)
+    assert before == after, "param dtypes drifted after one step"
