@@ -351,6 +351,14 @@ def step_artifacts(cert: Certifier, dev):
         D, F = 4096, 11008
         proj_flops = 2 * tok * (4 * D * D + 3 * D * F)
         kernel_flops_per_layer = 3 * proj_flops
+        # flash attention also lives in Mosaic custom calls (invisible to
+        # cost_analysis on BOTH paths): per layer, causal-halved QK^T/AV
+        # matmuls — fwd 2, bwd 4 (dQ/dK/dV/dS) + remat refwd 2 = 8 passes of
+        # 2*B*H*T^2*Dh*0.5 each (~1.4e11 at T=1024, ~2.8% of a layer; grows
+        # quadratically with T, so omitting it would eventually flip the
+        # compute-vs-HBM verdict at long context)
+        Hh, Dh = 32, 128
+        flash_flops_per_layer = 8 * (2 * B * Hh * T * T * Dh // 2)
         for impl in ("pallas", "xla"):
             cs = {}
             for n_layers in (1, 2):
@@ -369,6 +377,7 @@ def step_artifacts(cert: Certifier, dev):
             nonscan = {k: c1[k] - layer[k] for k in layer}
             fl = L * layer["flops"] + nonscan["flops"]
             by = L * layer["bytes_accessed"] + nonscan["bytes_accessed"]
+            fl += L * flash_flops_per_layer  # flash kernels, both paths
             if impl == "pallas":
                 fl += L * kernel_flops_per_layer
             t_flops = fl / V5E_BF16_FLOPS
@@ -378,6 +387,7 @@ def step_artifacts(cert: Certifier, dev):
                 "nonscan": nonscan,
                 "kernel_flops_per_layer": (kernel_flops_per_layer
                                            if impl == "pallas" else 0),
+                "flash_flops_per_layer": flash_flops_per_layer,
                 "flops_per_step": fl,
                 "hbm_bytes_per_step": by,
                 "flops_time_s": round(t_flops, 5),
@@ -497,6 +507,134 @@ def serving_artifact(cert: Certifier, dev):
     cert.run("serving/decode_step", go)
 
 
+def extra_artifacts(cert: Certifier, dev):
+    """The remaining compute paths: preference stages (dpo/rm), PPO
+    rollout+update, ring-SP sharded training, int8-KV decode. Certified at
+    debug/1B scale — lowering legality is geometry-independent; the 7B/14B
+    artifacts above already cover full-scale memory."""
+    from datatunerx_tpu.models import get_config
+    from datatunerx_tpu.training import TrainConfig, Trainer
+
+    sh = SingleDeviceSharding(dev)
+
+    def stage_step(stage):
+        def go():
+            cfg = get_config("debug", attention_impl="flash", remat="full")
+            tc = TrainConfig(stage=stage, finetuning_type="lora",
+                             lora_rank=4, lora_dropout=0.0,
+                             compute_dtype=jnp.bfloat16)
+            tr = Trainer(cfg, tc)
+            params_abs = _abstract_params(cfg)
+            state_abs = _sds(jax.eval_shape(
+                tr.init_state, params_abs, jax.random.PRNGKey(1)), sh)
+            B, T = 2, 128
+            ids = jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=sh)
+            batch = {"chosen_ids": ids, "chosen_labels": ids,
+                     "rejected_ids": ids, "rejected_labels": ids}
+            compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)
+                               ).lower(state_abs, batch).compile()
+            return {"cost": _cost(compiled), "memory": _memory(compiled)}
+        return go
+
+    cert.run("extra/train_dpo_step", stage_step("dpo"))
+    cert.run("extra/train_rm_step", stage_step("rm"))
+
+    def ppo():
+        from datatunerx_tpu.models.lora import init_lora_params, lora_scaling
+        from datatunerx_tpu.training.ppo import PPOConfig, PPOTrainer
+
+        cfg = get_config("debug", attention_impl="xla", remat="none")
+        tc = TrainConfig(stage="ppo", finetuning_type="lora", lora_rank=4,
+                         lora_dropout=0.0, scheduler="constant",
+                         compute_dtype=None)
+        rwd = jax.eval_shape(
+            lambda k: init_lora_params(cfg, k, rank=4), jax.random.PRNGKey(7))
+        rwd = dict(rwd)
+        rwd["v_head"] = jax.ShapeDtypeStruct((cfg.hidden_size,), jnp.float32)
+        # reward tree must be concrete for trainer construction; zeros have
+        # the right shapes and PPO numerics are irrelevant to lowering
+        rwd = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), rwd)
+        tr = PPOTrainer(cfg, tc, PPOConfig(gen_len=16),
+                        reward_lora=rwd, reward_scaling=lora_scaling(32.0, 4),
+                        eos_id=2, pad_id=0)
+        params_abs = _abstract_params(cfg)
+        state_abs = _sds(jax.eval_shape(
+            tr.init_state, params_abs, jax.random.PRNGKey(1)), sh)
+        B, T = 2, 32
+        batch = {"prompt_ids": jax.ShapeDtypeStruct((B, T), jnp.int32,
+                                                    sharding=sh),
+                 "prompt_mask": jax.ShapeDtypeStruct((B, T), jnp.int32,
+                                                     sharding=sh)}
+        ro_lower = jax.jit(tr._rollout_impl).lower(state_abs, batch,
+                                                   jnp.float32(0.2))
+        ro_c = ro_lower.compile()
+        ro_abs = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            jax.eval_shape(tr._rollout_impl, state_abs, batch,
+                           jnp.float32(0.2))[0])  # (ro, stats) -> ro
+        up_c = jax.jit(tr._ppo_update_impl, donate_argnums=(0,)).lower(
+            state_abs, ro_abs).compile()
+        return {"rollout": {"cost": _cost(ro_c), "memory": _memory(ro_c)},
+                "update": {"cost": _cost(up_c), "memory": _memory(up_c)}}
+
+    cert.run("extra/ppo_rollout_and_update", ppo)
+
+    def ring_sp():
+        from datatunerx_tpu.parallel.mesh import make_mesh
+        from datatunerx_tpu.parallel.sharding import (
+            batch_shardings,
+            tree_shardings,
+        )
+
+        topo = _topo(TOPOLOGY_1CHIP)
+        mesh = make_mesh(devices=topo.devices, sp=4, dp=1)
+        cfg = get_config("tinyllama-1.1b", attention_impl="ring",
+                         remat="dots")
+        tc = _lora_cfg()
+        tr = Trainer(cfg, tc, mesh=mesh)
+        params_abs = _abstract_params(cfg)
+        state_abs = jax.eval_shape(tr.init_state, params_abs,
+                                   jax.random.PRNGKey(1))
+        state_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            state_abs, tree_shardings(state_abs, mesh))
+        B, T = 1, 4096  # sequence sharded 4-way over sp
+        babs = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        batch_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            babs, batch_shardings(babs, mesh))
+        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+            state_in, batch_in).compile()
+        return {"cost": _cost(compiled), "memory": _memory(compiled),
+                "mesh": {"sp": 4}}
+
+    cert.run("extra/train_ring_sp4_tinyllama", ring_sp)
+
+    def int8_kv_decode():
+        from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+        eng = BatchedEngine("preset:debug", template="vanilla",
+                            max_seq_len=256, slots=4, decode_chunk=8,
+                            kv_quant="int8")
+        try:
+            to_sds = lambda t: _sds(  # noqa: E731
+                jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t), sh)
+            args = (eng.params, eng._cache, eng._logits, eng._pos,
+                    eng._remaining, eng._active, eng._rng, eng._temps,
+                    eng._top_ps, eng._stops, eng._adapter_idx)
+            compiled = jax.jit(
+                eng._decode_impl, static_argnames=("K",)).lower(
+                *(to_sds(a) for a in args), K=8).compile()
+            return {"cost": _cost(compiled), "memory": _memory(compiled)}
+        finally:
+            eng.close()
+
+    cert.run("serving/decode_step_int8_kv", int8_kv_decode)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "AOT_CERTIFY.json"))
@@ -511,6 +649,7 @@ def main():
     step_artifacts(cert, dev)
     mistral_fsdp_artifact(cert)
     serving_artifact(cert, dev)
+    extra_artifacts(cert, dev)
 
     cert.flush()
     n_ok = sum(r["ok"] for r in cert.records)
