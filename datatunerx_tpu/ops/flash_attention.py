@@ -48,11 +48,44 @@ def _interpret() -> bool:
     return interpret_default()
 
 
+# Mosaic kernels cannot be auto-partitioned by GSPMD ("wrap the call in a
+# shard_map" — raised by the REAL TPU lowering, invisible in interpret mode;
+# caught by AOT certification of the dp4×fsdp4 train step, r5). The Trainer
+# sets this context when a mesh is active so the flash call runs under
+# shard_map: each device executes the kernel on its local (batch, head)
+# shard. Sequence stays unsharded here — sp-parallel attention is ring's job.
+_FLASH: dict = {"mesh": None, "batch_axes": ("dp", "fsdp"), "tp_axis": "tp"}
+
+
+def set_flash_context(mesh, batch_axes=("dp", "fsdp"),
+                      tp_axis: str = "tp") -> None:
+    _FLASH.update(mesh=mesh, batch_axes=batch_axes, tp_axis=tp_axis)
+
+
+def _flash_shard_mesh():
+    """The active mesh if any sharded axis is >1 (else None: plain call)."""
+    mesh = _FLASH["mesh"]
+    if mesh is None:
+        return None, None, None
+    batch_axes = tuple(a for a in _FLASH["batch_axes"]
+                       if a in mesh.shape)
+    tp = _FLASH["tp_axis"] if _FLASH["tp_axis"] in mesh.shape else None
+    sharded = 1
+    for a in batch_axes:
+        sharded *= mesh.shape[a]
+    if tp:
+        sharded *= mesh.shape[tp]
+    if sharded == 1:
+        return None, None, None
+    return mesh, batch_axes, tp
+
+
 # ------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
-                *, block_q: int, block_k: int, scale: float):
+                *, block_q: int, block_k: int, scale: float,
+                causal: bool = True):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -63,7 +96,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(j * block_k <= i * block_q + block_q - 1)  # not fully future
+    # causal=False (ring-of-flash past chunks): every block contributes and
+    # no triangular mask applies — the in/visible split is decided OUTSIDE
+    # the kernel per ring step (full vs none), so the kernel stays static
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(run)  # causal: skip fully-future blocks
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -73,7 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
 
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos <= q_pos
+        mask = (k_pos <= q_pos) if causal else (k_pos >= 0)
         # packed-segment isolation (all-equal ids = plain causal)
         mask &= qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :]
         s = jnp.where(mask, s, NEG_INF)
@@ -109,12 +147,14 @@ def _kv_index(H: int, G: int):
     return index
 
 
-def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
+def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G,
+         causal: bool = True):
     BH, T, d = q.shape
     S = k.shape[1]
     scale = 1.0 / (d ** 0.5)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        causal=causal,
     )
     kv_idx = _kv_index(H, G)
     q_seg3, kv_seg3 = _seg3d(q_seg, kv_seg)
@@ -150,7 +190,8 @@ def _fwd(q, k, v, q_seg, kv_seg, *, block_q, block_k, interpret, H, G):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
                    qseg_ref, kseg_ref, dq_ref,
-                   acc_ref, *, block_q: int, block_k: int, scale: float):
+                   acc_ref, *, block_q: int, block_k: int, scale: float,
+                   causal: bool = True):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -159,7 +200,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * block_k <= i * block_q + block_q - 1)
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(run)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -168,7 +211,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (k_pos <= q_pos) & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
+        mask = ((k_pos <= q_pos) if causal else (k_pos >= 0)) \
+            & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)
 
         do = do_ref[0].astype(jnp.float32)
@@ -189,7 +233,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
                     qseg_ref, kseg_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, block_q: int, block_k: int, scale: float):
+                    *, block_q: int, block_k: int, scale: float,
+                    causal: bool = True):
     j = pl.program_id(1)  # k tile
     i = pl.program_id(2)  # q tile (sequential)
     nq = pl.num_programs(2)
@@ -199,7 +244,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(i * block_q + block_q - 1 >= j * block_k)  # q tile not fully past
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else (i >= 0)
+
+    @pl.when(run)  # causal: skip q tiles fully in the past
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -208,7 +255,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         ) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (k_pos <= q_pos) & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
+        mask = ((k_pos <= q_pos) if causal else (k_pos >= 0)) \
+            & (qseg_ref[0][:, 0:1] == kseg_ref[0][0:1, :])
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, 0:1]), 0.0)  # [bq, bk]
 
         do = do_ref[0].astype(jnp.float32)  # [bq, d]
@@ -230,7 +278,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(block_q, block_k, interpret, G, res, do):
+def _bwd(block_q, block_k, interpret, G, res, do, causal: bool = True):
     """K/V arrive un-expanded [B*KV, S, d]; expand here (backward only) and
     group-sum dk/dv at the end — forward never materializes the repeat."""
     q, k, v, q_seg, kv_seg, out, lse = res
@@ -252,7 +300,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale),
+                          scale=scale, causal=causal),
         grid=(BH, T // block_q, S // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -272,7 +320,7 @@ def _bwd(block_q, block_k, interpret, G, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale),
+                          scale=scale, causal=causal),
         grid=(BH, S // block_k, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -355,7 +403,45 @@ def flash_attention(
     """GQA wrapper: fold (B, H) into the grid dim; KV stays un-expanded and the
     kernel's index_map routes each q head to its KV group. With segment_ids,
     attention is additionally confined within packed segments (self-attention:
-    T == S, ids shared between q and kv)."""
+    T == S, ids shared between q and kv).
+
+    Under an active mesh (set_flash_context) the call is wrapped in
+    shard_map over the batch (+tp head) axes — Mosaic custom calls cannot
+    be auto-partitioned by GSPMD, so without this the multi-chip train step
+    fails to lower on real TPU toolchains."""
+    mesh, batch_axes, tp = _flash_shard_mesh()
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        if tp is not None:
+            H_, KV_ = q.shape[2], k.shape[2]
+            if H_ % mesh.shape[tp] or KV_ % mesh.shape[tp]:
+                # GQA head counts that don't divide tp: keep heads whole in
+                # the wrap (GSPMD gathers them); batch still shards
+                tp = None
+        qkv_spec = P(batch_axes, None, tp, None)
+        seg_spec = P(batch_axes, None)
+
+        if segment_ids is None:
+            def local3(q, k, v):
+                return _flash_local(q, k, v, None, block_q, block_k,
+                                    interpret)
+
+            return jax.shard_map(local3, mesh=mesh, in_specs=(qkv_spec,) * 3,
+                                 out_specs=qkv_spec, check_vma=False)(q, k, v)
+
+        def local(q, k, v, seg):
+            return _flash_local(q, k, v, seg, block_q, block_k, interpret)
+
+        return jax.shard_map(local, mesh=mesh,
+                             in_specs=(qkv_spec, qkv_spec, qkv_spec,
+                                       seg_spec),
+                             out_specs=qkv_spec, check_vma=False)(
+            q, k, v, segment_ids)
+    return _flash_local(q, k, v, segment_ids, block_q, block_k, interpret)
+
+
+def _flash_local(q, k, v, segment_ids, block_q, block_k, interpret):
     B, T, H, d = q.shape
     S, KV = k.shape[1], k.shape[2]
     G = H // KV

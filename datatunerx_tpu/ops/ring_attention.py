@@ -106,6 +106,140 @@ def ring_attention(
     return out.reshape(B, T, H, d).astype(q.dtype)
 
 
+# ------------------------------------------------------- ring of flash
+
+def _ring_steps(axis_name: str):
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return n, my, perm
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_flash(static, qf, kf, vf, seg):
+    out, _ = _ring_flash_fwd_impl(static, qf, kf, vf, seg)
+    return out
+
+
+def _ring_flash_fwd_impl(static, qf, kf, vf, seg):
+    from datatunerx_tpu.ops.flash_attention import _fwd
+
+    axis_name, block_q, block_k, interpret, H, G = static
+    n, my, perm = _ring_steps(axis_name)
+    o0, lse0 = _fwd(qf, kf, vf, seg, seg, block_q=block_q, block_k=block_k,
+                    interpret=interpret, H=H, G=G, causal=True)
+    acc_o = o0.astype(jnp.float32)
+    acc_lse = lse0
+    if n == 1:
+        return acc_o.astype(qf.dtype), acc_lse
+
+    def step(carry, r):
+        kc, vc, acc_o, acc_lse = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        # after r rotations this device holds chunk src = (my - r) mod n:
+        # strictly past iff my >= r (wrapped chunks are the future — masked)
+        o_c, lse_c = _fwd(qf, kc, vc, seg, seg, block_q=block_q,
+                          block_k=block_k, interpret=interpret, H=H, G=G,
+                          causal=False)
+        valid = my >= r
+        lse_c = jnp.where(valid, lse_c, -jnp.inf)
+        m = jnp.maximum(acc_lse, lse_c)
+        wa = jnp.exp(acc_lse - m)
+        wb = jnp.exp(lse_c - m)
+        denom = wa + wb
+        acc_o = (acc_o * wa[..., None]
+                 + o_c.astype(jnp.float32) * wb[..., None]) / denom[..., None]
+        acc_lse = m + jnp.log(denom)
+        return (kc, vc, acc_o, acc_lse), None
+
+    (kc, vc, acc_o, acc_lse), _ = jax.lax.scan(
+        step, (kf, vf, acc_o, acc_lse), jnp.arange(1, n))
+    return acc_o.astype(qf.dtype), acc_lse
+
+
+def _ring_flash_vjp_fwd(static, qf, kf, vf, seg):
+    out, lse = _ring_flash_fwd_impl(static, qf, kf, vf, seg)
+    return out, (qf, kf, vf, seg, out, lse)
+
+
+def _ring_flash_vjp_bwd(static, res, do):
+    """Reverse ring: dq accumulates locally; (dk, dv) accumulators travel
+    WITH their K/V chunk around the ring and arrive home after n rotations."""
+    from datatunerx_tpu.ops.flash_attention import _bwd
+
+    axis_name, block_q, block_k, interpret, H, G = static
+    qf, kf, vf, seg, out, lse = res
+    n, my, perm = _ring_steps(axis_name)
+
+    dq0, dk0, dv0 = _bwd(block_q, block_k, interpret, G,
+                         (qf, kf, vf, seg, seg, out, lse), do, causal=True)
+    dq_acc = dq0.astype(jnp.float32)
+    dk_acc = dk0.astype(jnp.float32)
+    dv_acc = dv0.astype(jnp.float32)
+    if n == 1:
+        return dq_acc.astype(qf.dtype), dk_acc.astype(kf.dtype), \
+            dv_acc.astype(vf.dtype), None
+
+    def step(carry, r):
+        kc, vc, dk_acc, dv_acc, dq_acc = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        dq_c, dk_c, dv_c = _bwd(block_q, block_k, interpret, G,
+                                (qf, kc, vc, seg, seg, out, lse), do,
+                                causal=False)
+        valid = (my >= r).astype(jnp.float32)
+        dq_acc = dq_acc + valid * dq_c.astype(jnp.float32)
+        dk_acc = dk_acc + valid * dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + valid * dv_c.astype(jnp.float32)
+        return (kc, vc, dk_acc, dv_acc, dq_acc), None
+
+    (kc, vc, dk_acc, dv_acc, dq_acc), _ = jax.lax.scan(
+        step, (kf, vf, dk_acc, dv_acc, dq_acc), jnp.arange(1, n))
+    # one more rotation brings each chunk's accumulator home (n total)
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq_acc.astype(qf.dtype), dk_acc.astype(kf.dtype),
+            dv_acc.astype(vf.dtype), None)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(
+    q: jnp.ndarray,  # [B, T_local, H, d]  (local sequence shard)
+    k: jnp.ndarray,  # [B, T_local, KV, d]
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Ring attention whose per-chunk compute is the Pallas flash kernel:
+    O(T_local · block) memory instead of the XLA ring's O(T_local²) score
+    tensors (which OOM'd the T=32k AOT certification at 34 GB/step, r5).
+    Chunk visibility (self → causal kernel, past → full kernel, wrapped →
+    masked out via -inf lse weight) is decided per ring step OUTSIDE the
+    kernel, so the kernel itself stays static. Backward runs a reverse ring
+    of flash-backward kernels with (dk, dv) accumulators rotating alongside
+    their chunk."""
+    from datatunerx_tpu.ops.flash_attention import _pick_block
+
+    B, T, H, d = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    block_q = _pick_block(T)
+    block_k = _pick_block(T)
+    from datatunerx_tpu.ops.flash_attention import _interpret
+
+    static = (axis_name, block_q, block_k, _interpret(), H, G)
+    seg = jnp.ones((B, T), jnp.int32)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KV, T, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KV, T, d)
+    out = _ring_flash(static, qf, kf, vf, seg)
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
 def ring_attention_sharded(
     q: jnp.ndarray,  # [B, T_global, H, d]
     k: jnp.ndarray,
@@ -115,10 +249,19 @@ def ring_attention_sharded(
     batch_axes=("dp", "fsdp"),
 ) -> jnp.ndarray:
     """Convenience wrapper: shard_map over (batch, sequence) with KV/head dims
-    replicated; tp sharding of heads composes by adding 'tp' to the H spec."""
+    replicated; tp sharding of heads composes by adding 'tp' to the H spec.
+
+    ``DTX_RING_IMPL`` picks the per-chunk engine: ``flash`` (default — the
+    Pallas kernel per chunk, O(T_local) memory) or ``xla`` (the chunked
+    einsum reference path, O(T_local²) scores — parity baseline and
+    fallback)."""
+    import os
+
     spec_q = P(batch_axes, axis_name, None, None)
     spec_kv = P(batch_axes, axis_name, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name)
+    impl = os.environ.get("DTX_RING_IMPL", "flash").strip().lower()
+    base = ring_flash_attention if impl != "xla" else ring_attention
+    fn = functools.partial(base, axis_name=axis_name)
     return jax.shard_map(
         fn,
         mesh=mesh,

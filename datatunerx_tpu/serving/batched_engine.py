@@ -197,6 +197,11 @@ class BatchedEngine:
         kv_quant: Optional[str] = None,  # "int8" halves cache HBM
         prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
     ):
+        # serving is single-program: clear any mesh a Trainer left in the
+        # process-global flash context before the engine's jits first trace
+        from datatunerx_tpu.ops.flash_attention import set_flash_context
+
+        set_flash_context(None)
         self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
             model_path, dtype=dtype
         )

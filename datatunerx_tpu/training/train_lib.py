@@ -124,6 +124,15 @@ class Trainer:
             from datatunerx_tpu.ops.ring_attention import set_ring_context
 
             set_ring_context(mesh)
+        # Mosaic kernels can't be GSPMD-auto-partitioned: the flash call must
+        # run under shard_map on a multi-device mesh. ALWAYS (re)set the
+        # process-global context — a non-flash or mesh-less trainer must
+        # clear a previous trainer's mesh, or later single-device flash
+        # calls would wrap over a stale (possibly abstract/dead) mesh
+        from datatunerx_tpu.ops.flash_attention import set_flash_context
+
+        set_flash_context(mesh if model_cfg.attention_impl == "flash"
+                          else None)
         self.schedule = make_schedule(
             train_cfg.scheduler,
             train_cfg.learning_rate,

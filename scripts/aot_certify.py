@@ -634,6 +634,100 @@ def extra_artifacts(cert: Certifier, dev):
 
     cert.run("serving/decode_step_int8_kv", int8_kv_decode)
 
+    def dcn_hybrid():
+        """Multi-slice shape: dp-major crosses slices over DCN
+        (parallel/mesh.py make_mesh(dcn_dp=…)); without slice indices the
+        contiguous chunks of the topology's device list emulate slices —
+        the SAME program shape that runs on real multislice compiles here
+        for the TPU target."""
+        from datatunerx_tpu.parallel.mesh import make_mesh
+        from datatunerx_tpu.parallel.sharding import (
+            batch_shardings,
+            tree_shardings,
+        )
+
+        topo = _topo(TOPOLOGY_16CHIP)
+        mesh = make_mesh(devices=topo.devices, dp=4, fsdp=4, dcn_dp=2)
+        cfg = get_config("tinyllama-1.1b", attention_impl="flash",
+                         remat="dots")
+        tc = _lora_cfg()
+        tr = Trainer(cfg, tc, mesh=mesh)
+        params_abs = _abstract_params(cfg)
+        state_abs = jax.eval_shape(tr.init_state, params_abs,
+                                   jax.random.PRNGKey(1))
+        state_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            state_abs, tree_shardings(state_abs, mesh))
+        B, T = 16, 1024
+        babs = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        batch_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            babs, batch_shardings(babs, mesh))
+        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+            state_in, batch_in).compile()
+        return {"cost": _cost(compiled), "memory": _memory(compiled),
+                "mesh": {"dp": 4, "fsdp": 4, "dcn_dp": 2}}
+
+    cert.run("extra/train_dcn_hybrid_dp4x2_fsdp4", dcn_hybrid)
+
+    def ring_long_context():
+        """Long-context shape: ring SP at T=32k (8k tokens/device on sp=4)
+        — the O(T_local) memory claim is only real if the sharded program
+        actually compiles at long T for the TPU target."""
+        from datatunerx_tpu.parallel.mesh import make_mesh
+        from datatunerx_tpu.parallel.sharding import (
+            batch_shardings,
+            tree_shardings,
+        )
+
+        topo = _topo(TOPOLOGY_1CHIP)
+        mesh = make_mesh(devices=topo.devices, sp=4, dp=1)
+        cfg = get_config("tinyllama-1.1b", attention_impl="ring",
+                         remat="full", max_seq_len=32768)
+        tc = _lora_cfg()
+        tr = Trainer(cfg, tc, mesh=mesh)
+        params_abs = _abstract_params(cfg)
+        state_abs = jax.eval_shape(tr.init_state, params_abs,
+                                   jax.random.PRNGKey(1))
+        state_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            state_abs, tree_shardings(state_abs, mesh))
+        B, T = 1, 32768
+        babs = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        batch_in = jax.tree_util.tree_map(
+            lambda s, sd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd),
+            babs, batch_shardings(babs, mesh))
+        compiled = jax.jit(tr._train_step_impl, donate_argnums=(0,)).lower(
+            state_in, batch_in).compile()
+        return {"cost": _cost(compiled), "memory": _memory(compiled),
+                "mesh": {"sp": 4}, "seq_len": T}
+
+    cert.run("extra/train_ring_sp4_T32k_long_context", ring_long_context)
+
+    def serving_prefill():
+        from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+        eng = BatchedEngine("preset:debug", template="vanilla",
+                            max_seq_len=256, slots=4, decode_chunk=8)
+        try:
+            to_sds = lambda t: _sds(  # noqa: E731
+                jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t), sh)
+            plen = 64
+            tok = jax.ShapeDtypeStruct((1, plen), jnp.int32, sharding=sh)
+            aidx = jax.ShapeDtypeStruct((), jnp.int32, sharding=sh)
+            compiled = jax.jit(
+                eng._prefill_impl, static_argnames=("prompt_len",)).lower(
+                to_sds(eng.params), tok, tok, tok, aidx,
+                prompt_len=plen).compile()
+            return {"cost": _cost(compiled), "memory": _memory(compiled)}
+        finally:
+            eng.close()
+
+    cert.run("serving/prefill_step", serving_prefill)
+
 
 def main():
     ap = argparse.ArgumentParser()
