@@ -109,6 +109,17 @@ class FakeServingBackend:
 
 # ---------------------------------------------------------- local process
 
+class _PendingGroup:
+    """Placeholder for a multi-host process group queued behind the spawn
+    gate; unique per submission (identity-compared) so stale spawn threads
+    can never act on a resubmission under the same job name."""
+
+    __slots__ = ("failed",)
+
+    def __init__(self):
+        self.failed = False
+
+
 class LocalProcessBackend:
     """Runs the trainer CLI as subprocess(es) per job; completion detected via
     process exit + the completion manifest (training/checkpoint.py).
@@ -118,6 +129,17 @@ class LocalProcessBackend:
     ADDRESS/NUM_PROCESSES/PROCESS_ID, parallel/distributed.py) — the local
     backend is then a faithful multi-host simulator: one process per "host",
     jax.distributed bootstrap, cross-process collectives over local gRPC."""
+
+    # Multi-host spawn stagger (seconds between JOBS' process-group spawns,
+    # process-wide): gloo's cross-process rendezvous has a hard 30 s connect
+    # timeout baked into XLA, and N jobs × H hosts of simultaneous jax
+    # startups on shared cores skew past it — the late processes then fail
+    # collectives init even though nothing is wrong (observed: the 4-job e2e
+    # on a 1-core machine, where r4's fast-poll controllers un-staggered the
+    # submissions that used to spread out naturally). Real clusters (kube
+    # backend) are unaffected.
+    _spawn_gate = threading.Lock()
+    _last_group_spawn = [0.0]
 
     def __init__(self, workdir: str, extra_env: Optional[dict] = None):
         self.workdir = os.path.abspath(workdir)
@@ -151,36 +173,104 @@ class LocalProcessBackend:
             env.update(spec.get("env", {}))
 
             hosts = max(1, int(spec.get("num_hosts", 1) or 1))
-            procs = []
             if hosts == 1:
                 log = open(os.path.join(jobdir, "log.txt"), "w")
-                procs.append(subprocess.Popen(
+                self._procs[name] = [subprocess.Popen(
                     argv, cwd=jobdir, stdout=log, stderr=subprocess.STDOUT,
                     env=env,
-                ))
-            else:
-                coord = f"127.0.0.1:{self._free_port()}"
-                for pid in range(hosts):
-                    henv = dict(env)
-                    henv.update({
-                        "DTX_COORDINATOR_ADDRESS": coord,
-                        "DTX_NUM_PROCESSES": str(hosts),
-                        "DTX_PROCESS_ID": str(pid),
-                    })
-                    # pod-0 writes checkpoints/manifest; others log beside it
-                    log_name = "log.txt" if pid == 0 else f"log.{pid}.txt"
-                    log = open(os.path.join(jobdir, log_name), "w")
-                    procs.append(subprocess.Popen(
-                        argv, cwd=jobdir, stdout=log,
-                        stderr=subprocess.STDOUT, env=henv,
-                    ))
-            self._procs[name] = procs
+                )]
+                return
+            # multi-host: placeholder now (status() -> Pending), spawn the
+            # process group off-thread behind the stagger gate. The token is
+            # unique per submission so a queued thread from a deleted job can
+            # never act on a later resubmission under the same name.
+            token = _PendingGroup()
+            self._procs[name] = token
+
+        def _spawn_group():
+            import time as _t
+
+            stagger = float(os.environ.get("DTX_SIM_SUBMIT_STAGGER_S", "5"))
+            ready_timeout = float(
+                os.environ.get("DTX_SIM_SPAWN_READY_TIMEOUT_S", "300"))
+            procs = []
+            try:
+                with LocalProcessBackend._spawn_gate:
+                    wait = stagger - (
+                        _t.monotonic()
+                        - LocalProcessBackend._last_group_spawn[0])
+                    if wait > 0:
+                        _t.sleep(wait)
+                    with self._lock:
+                        if self._procs.get(name) is not token:
+                            return  # deleted/replaced while queued
+                    coord = f"127.0.0.1:{self._free_port()}"
+                    for pid in range(hosts):
+                        henv = dict(env)
+                        henv.update({
+                            "DTX_COORDINATOR_ADDRESS": coord,
+                            "DTX_NUM_PROCESSES": str(hosts),
+                            "DTX_PROCESS_ID": str(pid),
+                        })
+                        # simulated hosts share cores: a starved process must
+                        # not be declared dead (its peer would fatally abort
+                        # AFTER completing all work — parallel/distributed.py)
+                        henv.setdefault("DTX_DIST_HEARTBEAT_S", "600")
+                        henv.setdefault("DTX_DIST_SHUTDOWN_S", "600")
+                        # pod-0 writes checkpoints/manifest; rest log beside
+                        log_name = "log.txt" if pid == 0 else f"log.{pid}.txt"
+                        log = open(os.path.join(jobdir, log_name), "w")
+                        procs.append(subprocess.Popen(
+                            argv, cwd=jobdir, stdout=log,
+                            stderr=subprocess.STDOUT, env=henv,
+                        ))
+                    with self._lock:
+                        if self._procs.get(name) is token:
+                            self._procs[name] = procs
+                        else:  # deleted during spawn: tear the group down
+                            for p in procs:
+                                p.terminate()
+                            return
+                    # hold the gate until this group survives startup: the
+                    # first "[train]" line means jax.distributed + gloo
+                    # rendezvous succeeded and the step loop runs. Only then
+                    # may the next group pile onto the cores — startups
+                    # serialize, TRAINING still overlaps fully.
+                    log0 = os.path.join(jobdir, "log.txt")
+                    deadline = _t.monotonic() + ready_timeout
+                    while _t.monotonic() < deadline:
+                        if any(p.poll() is not None for p in procs):
+                            break  # died in startup; status() reports it
+                        try:
+                            with open(log0, errors="replace") as f:
+                                if "[train]" in f.read():
+                                    break
+                        except OSError:
+                            pass
+                        _t.sleep(1.0)
+                    LocalProcessBackend._last_group_spawn[0] = _t.monotonic()
+            except BaseException:  # noqa: BLE001 — stuck-Pending is worse
+                for p in procs:  # no orphans: reap anything already spawned
+                    try:
+                        p.terminate()
+                    except OSError:
+                        pass
+                with self._lock:
+                    if self._procs.get(name) is token:
+                        token.failed = True  # status() -> Failed, retryable
+                raise
+
+        threading.Thread(target=_spawn_group, daemon=True).start()
 
     def status(self, name: str) -> str:
         with self._lock:
             procs = self._procs.get(name)
         if procs is None:
             return "NotFound"
+        if isinstance(procs, _PendingGroup):
+            # multi-host group queued behind the spawn gate (or its spawn
+            # thread died — surfaced as a normal, retryable job failure)
+            return "Failed" if procs.failed else "Pending"
         rcs = [p.poll() for p in procs]
         if any(rc not in (None, 0) for rc in rcs):
             return "Failed"  # JobSet failure semantics: any host failing fails the job

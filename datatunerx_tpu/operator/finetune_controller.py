@@ -189,7 +189,18 @@ class FinetuneController:
             # reference has no retry at all): the trainer auto-resumes from its
             # latest Orbax checkpoint (same uid → same storage key), so a retry
             # continues rather than restarts
-            limit = int(ft.spec.get("backoffLimit", 0) or 0)
+            # DTX_DEFAULT_BACKOFF_LIMIT: fleet-wide retry default for specs
+            # that don't set backoffLimit (k8s Jobs default 6; ours stays 0
+            # so failure-propagation semantics are explicit). Retries resume
+            # from the latest checkpoint — a retry continues, not restarts.
+            default_limit = int(os.environ.get("DTX_DEFAULT_BACKOFF_LIMIT",
+                                               "0"))
+            raw = ft.spec.get("backoffLimit")
+            try:
+                limit = default_limit if raw in (None, "") else int(raw)
+            except (TypeError, ValueError):
+                limit = default_limit  # junk in the spec must not wedge
+                # the Failed transition in an error-requeue loop
             retries = int(ft.status.get("retries", 0))
             if retries < limit:
                 self.backend.delete(meta.name)

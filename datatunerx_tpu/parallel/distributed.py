@@ -34,10 +34,22 @@ def maybe_initialize_distributed(num_workers: int = 1) -> dict:
     pid = int(os.environ.get("DTX_PROCESS_ID", 0))
     if nproc <= 1:
         return {"initialized": False, "process_id": 0, "num_processes": 1}
+    # Liveness knobs (seconds). The jax defaults (heartbeat 100, shutdown
+    # 300) assume dedicated hosts; the local multi-host simulator runs many
+    # trainer processes on shared cores where one can legitimately stall
+    # past 100 s under load — the coordinator then declares it dead and its
+    # PEER fatally aborts after finishing all its work (observed: shutdown
+    # barrier failure in the 4-concurrent-jobs e2e on a 1-core machine).
+    # LocalProcessBackend raises these for simulated hosts; real pods keep
+    # the defaults unless the operator overrides.
+    heartbeat_s = int(os.environ.get("DTX_DIST_HEARTBEAT_S", "100"))
+    shutdown_s = int(os.environ.get("DTX_DIST_SHUTDOWN_S", "300"))
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=nproc,
         process_id=pid,
+        heartbeat_timeout_seconds=heartbeat_s,
+        shutdown_timeout_seconds=shutdown_s,
     )
     return {
         "initialized": True,

@@ -18,8 +18,31 @@ os.environ.setdefault("TF_ENABLE_ONEDNN_OPTS", "0")
 # spawned tuning.train / serving.server subprocess (env vars inherit). Keyed
 # by HLO+config, so correctness-neutral; measured 43s -> 16s on one CLI e2e.
 # Repo-local dir so repeat suite runs start warm (gitignored).
+#
+# The dir is fingerprinted by the HOST CPU: this VM can land on machines with
+# different CPU features between sessions, and XLA:CPU AOT blobs compiled for
+# one feature set SIGILL/abort on another (cpu_aot_loader warns exactly this;
+# one full-suite run died with Fatal Python error: Aborted mid-execution).
+# A migration just means a cold cache, never a crash.
+
+
+def _host_fingerprint() -> str:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = sorted({line for line in f
+                            if line.startswith(("flags", "model name"))})
+        return hashlib.sha256("".join(flags).encode()).hexdigest()[:12]
+    except OSError:
+        import platform
+
+        return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
+
+
 _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), ".jax_compilation_cache")
+    os.path.abspath(__file__))), ".jax_compilation_cache",
+    _host_fingerprint())
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")

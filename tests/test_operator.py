@@ -339,6 +339,9 @@ def test_finetunejob_failure_propagates(world):
     store, training, serving, mgr, storage = world
     job = FinetuneJob(metadata=ObjectMeta(name="jobF"), spec=_job_spec("F"))
     job.spec["finetune"]["name"] = "jobF-finetune"
+    # no retries: this test asserts the failure PROPAGATION path (the retry
+    # path has its own tests); the spec default is now k8s-style backoff
+    job.spec["finetune"]["finetuneSpec"]["backoffLimit"] = 0
     store.create(job)
     mgr.run_until_idle()
     mgr.drain_scheduled()
