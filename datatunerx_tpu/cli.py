@@ -14,6 +14,9 @@ Talks to the operator's REST API (operator/apiserver.py):
                                        operator Deployment + config
                                        (env → ConfigMap/Secret); --dry-run
                                        prints the manifests instead
+  dtx serve --model_path P             serve directly (no operator); with
+      [--replicas N] [--gateway]       N > 1 or --gateway the inference
+                                       gateway fronts the replicas
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.
@@ -187,6 +190,45 @@ def cmd_logs(args):
     print(resp.get("log", ""), end="")
 
 
+def cmd_serve(args):
+    """Launch serving directly (no operator): a single serving.server, or —
+    with --replicas N / --gateway — the inference gateway fronting N replica
+    subprocesses (routing, admission control, failover; gateway/server.py)."""
+    if args.replicas > 1 or args.gateway:
+        from datatunerx_tpu.gateway.server import main as gateway_main
+
+        argv = [
+            "--model_path", args.model_path,
+            "--checkpoint_path", args.checkpoint_path,
+            "--template", args.template,
+            "--max_seq_len", str(args.max_seq_len),
+            "--port", str(args.port),
+            "--quantization", args.quantization,
+            "--slots", str(args.slots),
+            "--adapters", args.adapters,
+            "--replicas", str(max(args.replicas, 1)),
+            "--policy", args.policy,
+            "--max_queue", str(args.max_queue),
+            "--token_budget", str(args.token_budget),
+        ]
+        if args.workdir:
+            argv += ["--workdir", args.workdir]
+        return gateway_main(argv)
+    from datatunerx_tpu.serving.server import main as serving_main
+
+    argv = [
+        "--model_path", args.model_path,
+        "--checkpoint_path", args.checkpoint_path,
+        "--template", args.template,
+        "--max_seq_len", str(args.max_seq_len),
+        "--port", str(args.port),
+        "--quantization", args.quantization,
+        "--slots", str(args.slots),
+        "--adapters", args.adapters,
+    ]
+    return serving_main(argv)
+
+
 def cmd_install(args):
     """One-command install (reference dtx-ctl + Helm, INSTALL.md:26-48)."""
     from datatunerx_tpu.operator.install import install, render_install_manifests
@@ -257,6 +299,33 @@ def main(argv=None):
     lp.add_argument("name")
     lp.add_argument("-n", "--namespace", default="default")
     lp.set_defaults(fn=cmd_logs)
+
+    vp = sub.add_parser(
+        "serve",
+        help="serve a model directly: single server, or --replicas N / "
+             "--gateway for the multi-replica inference gateway")
+    vp.add_argument("--model_path", required=True)
+    vp.add_argument("--checkpoint_path", default="")
+    vp.add_argument("--template", default="llama2")
+    vp.add_argument("--max_seq_len", type=int, default=1024)
+    vp.add_argument("--port", type=int, default=8000)
+    vp.add_argument("--quantization", default="",
+                    choices=["", "int8", "int4", "nf4"])
+    vp.add_argument("--slots", type=int, default=4)
+    vp.add_argument("--adapters", default="",
+                    help="named LoRA adapters: name=ckpt[,name=ckpt…]")
+    vp.add_argument("--replicas", type=int, default=1,
+                    help="replica count; > 1 puts the gateway in front")
+    vp.add_argument("--gateway", action="store_true",
+                    help="front even a single replica with the gateway "
+                         "(admission control + metrics + rolling restart)")
+    vp.add_argument("--policy", default="least_busy",
+                    choices=["least_busy", "round_robin"])
+    vp.add_argument("--max_queue", type=int, default=64)
+    vp.add_argument("--token_budget", type=int, default=32768)
+    vp.add_argument("--workdir", default="",
+                    help="gateway replica log directory")
+    vp.set_defaults(fn=cmd_serve)
 
     ip = sub.add_parser(
         "install",

@@ -1,0 +1,43 @@
+"""Inference gateway: multi-replica routing, admission control, failover.
+
+Replaces the Ray Serve tier of the reference (RayService CRs fronting
+LlamaDeployment replicas): the operator deploys N `serving.server` replicas
+behind ONE gateway endpoint that spreads load, sheds overload with 429 +
+Retry-After instead of OOMing a TPU replica, and survives a replica dying
+mid-request. CPU-only and jax-free — the gateway never touches the model.
+
+    replica_pool  — replica abstraction (in-process / HTTP), health checks,
+                    per-replica circuit breaker, graceful drain
+    router        — pluggable routing: least-busy-slots, round-robin,
+                    session/prefix affinity, LoRA-adapter awareness
+    admission     — bounded queue + prefill-token budget backpressure
+    metrics       — Prometheus text exposition (counters/gauges/histograms)
+    autoscale     — queue depth + p95 latency → replica-count hint the
+                    operator consumes (operator/capacity.py)
+    server        — the HTTP front-end + managed replica subprocess set
+"""
+
+from datatunerx_tpu.gateway.admission import AdmissionController, Overloaded
+from datatunerx_tpu.gateway.replica_pool import (
+    CircuitBreaker,
+    HTTPReplica,
+    InProcessReplica,
+    NoReplicaAvailable,
+    Replica,
+    ReplicaError,
+    ReplicaPool,
+)
+from datatunerx_tpu.gateway.router import Router
+
+__all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
+    "HTTPReplica",
+    "InProcessReplica",
+    "NoReplicaAvailable",
+    "Overloaded",
+    "Replica",
+    "ReplicaError",
+    "ReplicaPool",
+    "Router",
+]

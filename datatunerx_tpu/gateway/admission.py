@@ -1,0 +1,130 @@
+"""Admission control: bounded queue + prefill-token budget backpressure.
+
+Overload on a TPU replica is not graceful: an unbounded admission queue
+turns into unbounded prefill work and eventually an HBM OOM that kills every
+in-flight request on the chip. The gateway instead bounds BOTH the request
+count and the estimated queued prefill tokens; past either limit it sheds
+with 429 + Retry-After, so clients back off and in-flight requests finish
+untouched (the degradation mode Ray Serve's max_concurrent_queries provides
+in the reference).
+
+Retry-After is derived from observed drain throughput (EWMA of completed
+prefill tokens/s), so a shed client waits roughly one queue-drain, not a
+fixed guess.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+
+class Overloaded(Exception):
+    def __init__(self, reason: str, retry_after_s: int):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+def estimate_prompt_tokens(messages: List[dict]) -> int:
+    """Cheap prefill-cost estimate without a tokenizer: ~4 chars/token
+    (BPE English average) + a few tokens of template overhead per message.
+    Only relative magnitude matters — the budget is calibrated in the same
+    units."""
+    total = 0
+    for m in messages or []:
+        total += len(str(m.get("content", ""))) // 4 + 4
+    return max(1, total)
+
+
+class Ticket:
+    """An admitted request's reservation; release exactly once."""
+
+    def __init__(self, controller: "AdmissionController", tokens: int):
+        self._controller = controller
+        self.tokens = tokens
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._controller._release(self.tokens)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class AdmissionController:
+    def __init__(self, max_queue: int = 64, token_budget: int = 32768,
+                 min_retry_after_s: int = 1, max_retry_after_s: int = 30):
+        self.max_queue = max_queue
+        self.token_budget = token_budget
+        self.min_retry_after_s = min_retry_after_s
+        self.max_retry_after_s = max_retry_after_s
+        self._depth = 0
+        self._tokens = 0
+        self._shed = 0
+        self._lock = threading.Lock()
+        # drain-rate EWMA (tokens/s) for the Retry-After estimate
+        self._rate = 0.0
+        self._last_release = time.monotonic()
+
+    # ------------------------------------------------------------ admission
+    def try_admit(self, messages: List[dict],
+                  tokens: Optional[int] = None) -> Ticket:
+        n = tokens if tokens is not None else estimate_prompt_tokens(messages)
+        with self._lock:
+            if self._depth + 1 > self.max_queue:
+                self._shed += 1
+                raise Overloaded(
+                    f"queue full ({self._depth}/{self.max_queue} requests)",
+                    self._retry_after_locked())
+            if self._tokens + n > self.token_budget:
+                self._shed += 1
+                raise Overloaded(
+                    f"prefill token budget exhausted ({self._tokens}+{n}"
+                    f">{self.token_budget})",
+                    self._retry_after_locked())
+            self._depth += 1
+            self._tokens += n
+        return Ticket(self, n)
+
+    def _release(self, tokens: int):
+        now = time.monotonic()
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            self._tokens = max(0, self._tokens - tokens)
+            dt = max(1e-3, now - self._last_release)
+            self._last_release = now
+            inst = tokens / dt
+            self._rate = inst if self._rate == 0 else (
+                0.8 * self._rate + 0.2 * inst)
+
+    def _retry_after_locked(self) -> int:
+        if self._rate > 0:
+            est = self._tokens / self._rate
+        else:
+            est = float(self.max_retry_after_s)
+        return int(min(self.max_retry_after_s,
+                       max(self.min_retry_after_s, round(est))))
+
+    # -------------------------------------------------------------- reports
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    @property
+    def queued_tokens(self) -> int:
+        with self._lock:
+            return self._tokens
+
+    @property
+    def shed_count(self) -> int:
+        with self._lock:
+            return self._shed

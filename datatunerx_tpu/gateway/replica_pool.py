@@ -1,0 +1,384 @@
+"""Replica pool: the gateway's inventory of serving replicas.
+
+Two replica flavors share one interface:
+
+  InProcessReplica — wraps an engine object (BatchedEngine/InferenceEngine or
+                     any duck-typed stand-in) directly; the test/CI path.
+  HTTPReplica      — speaks the serving/server.py wire protocol (POST
+                     /chat/completions with SSE streaming, GET /healthz,
+                     GET /metrics for slot stats); the production path.
+
+Each replica carries a circuit breaker (closed → open on consecutive
+failures → half-open probe after a cooldown → closed on success), replacing
+KubeRay's pod-restart-only failure handling with request-level routing
+awareness, and a ``draining`` flag for graceful rolling restarts: a draining
+replica finishes in-flight requests but receives no new ones.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Iterator, List, Optional
+
+
+class ReplicaError(Exception):
+    """A replica failed to serve a request (connection refused, died
+    mid-stream, 5xx). The gateway fails over; the breaker records it."""
+
+
+class NoReplicaAvailable(Exception):
+    """No healthy, non-draining, circuit-closed replica to route to."""
+
+
+class CircuitBreaker:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._failures = 0
+        self._opened_at = 0.0
+        self._state = self.CLOSED
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (self._state == self.OPEN
+                    and time.monotonic() - self._opened_at >= self.cooldown_s):
+                self._state = self.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request be routed here? Open circuits reject until the
+        cooldown elapses; half-open admits (the probe) — its outcome decides
+        between re-open and close."""
+        return self.state != self.OPEN
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            if (self._state == self.HALF_OPEN
+                    or self._failures >= self.failure_threshold):
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+
+
+class Replica:
+    """Interface + shared bookkeeping. Subclasses implement ``chat``,
+    ``chat_stream``, ``probe_health`` and ``stats``."""
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0):
+        self.name = name
+        self.breaker = CircuitBreaker(failure_threshold, cooldown_s)
+        self.draining = False
+        self.healthy = True  # last health-probe verdict
+        self.inflight = 0  # gateway-side in-flight count (least-busy fallback)
+        self._inflight_lock = threading.Lock()
+
+    # ------------------------------------------------------------- requests
+    def chat(self, messages: List[dict], **kwargs) -> str:
+        raise NotImplementedError
+
+    def chat_stream(self, messages: List[dict], **kwargs) -> Iterator[str]:
+        raise NotImplementedError
+
+    # --------------------------------------------------------------- health
+    def probe_health(self) -> bool:
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        """{"slots_busy": int, "slots_total": int, "adapters": set|None}.
+        adapters=None means unknown — the router treats it as capable of
+        anything (load-on-demand fallback)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ lifecycle
+    def available(self) -> bool:
+        return self.healthy and not self.draining and self.breaker.allow()
+
+    def drain(self):
+        self.draining = True
+
+    def undrain(self):
+        self.draining = False
+
+    def acquire(self):
+        with self._inflight_lock:
+            self.inflight += 1
+
+    def release(self):
+        with self._inflight_lock:
+            self.inflight = max(0, self.inflight - 1)
+
+    def busy_fraction(self) -> float:
+        """Load signal for least-busy routing: engine slot occupancy when the
+        replica exposes it, gateway-side in-flight count otherwise."""
+        st = self.stats()
+        total = st.get("slots_total") or 0
+        if total > 0:
+            return st.get("slots_busy", 0) / total
+        return float(self.inflight)
+
+    def close(self):
+        pass
+
+
+class InProcessReplica(Replica):
+    """Wraps an engine object living in this process — the tier-1 test path
+    and single-host `dtx serve --gateway` without subprocess replicas.
+    The engine contract is duck-typed: ``chat(messages, **kw) -> str`` and
+    optionally ``chat_stream``, ``slots``/``_slot_req``, ``adapter_ids``."""
+
+    def __init__(self, name: str, engine, **kw):
+        super().__init__(name, **kw)
+        self.engine = engine
+
+    def chat(self, messages, **kwargs) -> str:
+        kwargs.pop("trace_id", None)
+        try:
+            return self.engine.chat(messages, **kwargs)
+        except Exception as e:  # noqa: BLE001 — engine fault = replica fault
+            raise ReplicaError(f"{self.name}: {e}") from e
+
+    def chat_stream(self, messages, **kwargs):
+        kwargs.pop("trace_id", None)
+        stream_fn = getattr(self.engine, "chat_stream", None)
+        try:
+            if stream_fn is None:
+                yield self.engine.chat(messages, **kwargs)
+                return
+            for delta in stream_fn(messages, **kwargs):
+                yield delta
+        except ReplicaError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ReplicaError(f"{self.name}: {e}") from e
+
+    def probe_health(self) -> bool:
+        probe = getattr(self.engine, "healthy", None)
+        if callable(probe):
+            try:
+                self.healthy = bool(probe())
+            except Exception:  # noqa: BLE001
+                self.healthy = False
+        else:
+            self.healthy = self.engine is not None
+        return self.healthy
+
+    def stats(self) -> dict:
+        slot_req = getattr(self.engine, "_slot_req", None)
+        busy = (sum(1 for r in slot_req if r is not None)
+                if slot_req is not None else 0)
+        adapter_ids = getattr(self.engine, "adapter_ids", None)
+        return {
+            "slots_busy": busy,
+            "slots_total": getattr(self.engine, "slots", 0),
+            "adapters": set(adapter_ids) if adapter_ids is not None else None,
+        }
+
+    def close(self):
+        closer = getattr(self.engine, "close", None)
+        if callable(closer):
+            closer()
+
+
+class HTTPReplica(Replica):
+    """A remote serving/server.py process. Requests carry the gateway's
+    trace id via the X-DTX-Trace-Id header so a request can be followed
+    operator → gateway → replica log."""
+
+    def __init__(self, name: str, base_url: str, timeout: float = 300.0,
+                 stats_ttl_s: float = 1.0, **kw):
+        super().__init__(name, **kw)
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        # stats() is on the ROUTING hot path (least-busy + adapter filter
+        # both consult it per request); cache the scrape for a TTL so a slow
+        # replica can't add its /metrics round-trip to every routed request
+        self.stats_ttl_s = stats_ttl_s
+        self._stats_cache: Optional[dict] = None
+        self._stats_at = 0.0
+
+    # ------------------------------------------------------------------ http
+    def _post(self, path: str, payload: dict, trace_id: str = ""):
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            headers["X-DTX-Trace-Id"] = trace_id
+        req = urllib.request.Request(
+            self.base_url + path, data=json.dumps(payload).encode(),
+            headers=headers, method="POST")
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _payload(self, messages, kwargs) -> dict:
+        payload = {
+            "messages": messages,
+            "max_tokens": kwargs.get("max_new_tokens", 128),
+            "temperature": kwargs.get("temperature", 0.0),
+            "top_p": kwargs.get("top_p", 1.0),
+        }
+        if kwargs.get("adapter"):
+            payload["model"] = kwargs["adapter"]
+        return payload
+
+    def chat(self, messages, **kwargs) -> str:
+        trace_id = kwargs.pop("trace_id", "")
+        try:
+            with self._post("/chat/completions",
+                            self._payload(messages, kwargs), trace_id) as r:
+                body = json.load(r)
+            return body["choices"][0]["message"]["content"]
+        except urllib.error.HTTPError as e:
+            # 4xx is the CLIENT's error (bad adapter name, bad body): the
+            # replica is fine, don't trip the breaker or fail over
+            if 400 <= e.code < 500:
+                try:
+                    detail = json.load(e).get("error", e.reason)
+                except Exception:  # noqa: BLE001
+                    detail = e.reason
+                raise ValueError(str(detail)) from e
+            raise ReplicaError(f"{self.name}: HTTP {e.code}") from e
+        except (OSError, ValueError, KeyError) as e:
+            raise ReplicaError(f"{self.name}: {e}") from e
+
+    def chat_stream(self, messages, **kwargs):
+        trace_id = kwargs.pop("trace_id", "")
+        payload = self._payload(messages, kwargs)
+        payload["stream"] = True
+        try:
+            resp = self._post("/chat/completions", payload, trace_id)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                raise ValueError(f"HTTP {e.code}") from e
+            raise ReplicaError(f"{self.name}: HTTP {e.code}") from e
+        except OSError as e:
+            raise ReplicaError(f"{self.name}: {e}") from e
+        try:
+            with resp:
+                for raw in resp:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        return
+                    evt = json.loads(data)
+                    if "error" in evt:
+                        raise ReplicaError(
+                            f"{self.name}: {evt['error'].get('message')}")
+                    delta = evt["choices"][0]["delta"].get("content")
+                    if delta:
+                        yield delta
+        except ReplicaError:
+            raise
+        except Exception as e:  # noqa: BLE001 — stream cut = replica fault
+            raise ReplicaError(f"{self.name}: stream died: {e}") from e
+
+    def probe_health(self) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    self.base_url + "/healthz", timeout=2) as r:
+                self.healthy = json.load(r).get("status") == "HEALTHY"
+        except Exception:  # noqa: BLE001
+            self.healthy = False
+        return self.healthy
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        if (self._stats_cache is not None
+                and now - self._stats_at < self.stats_ttl_s):
+            return self._stats_cache
+        out = {"slots_busy": 0, "slots_total": 0, "adapters": None}
+        try:
+            with urllib.request.urlopen(
+                    self.base_url + "/metrics", timeout=2) as r:
+                for line in r.read().decode().splitlines():
+                    if line.startswith("dtx_serving_slots_busy "):
+                        out["slots_busy"] = int(float(line.split()[-1]))
+                    elif line.startswith("dtx_serving_slots_total "):
+                        out["slots_total"] = int(float(line.split()[-1]))
+        except Exception:  # noqa: BLE001 — stats are advisory
+            pass
+        self._stats_cache = out
+        self._stats_at = now
+        return out
+
+
+class ReplicaPool:
+    """Thread-safe replica set + periodic health checking.
+
+    ``health_interval_s=0`` disables the background thread (tests drive
+    ``check_health()`` explicitly)."""
+
+    def __init__(self, replicas: Optional[List[Replica]] = None,
+                 health_interval_s: float = 0.0):
+        self._replicas: Dict[str, Replica] = {}
+        self._lock = threading.Lock()
+        for r in replicas or []:
+            self._replicas[r.name] = r
+        self._shutdown = threading.Event()
+        self._thread = None
+        if health_interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._health_loop, args=(health_interval_s,),
+                daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ membership
+    def add(self, replica: Replica):
+        with self._lock:
+            self._replicas[replica.name] = replica
+
+    def remove(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.pop(name, None)
+
+    def get(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def available(self) -> List[Replica]:
+        return [r for r in self.replicas() if r.available()]
+
+    def drain(self, name: str) -> bool:
+        r = self.get(name)
+        if r is None:
+            return False
+        r.drain()
+        return True
+
+    # --------------------------------------------------------------- health
+    def check_health(self):
+        for r in self.replicas():
+            r.probe_health()
+
+    def _health_loop(self, interval: float):
+        while not self._shutdown.wait(interval):
+            self.check_health()
+
+    # -------------------------------------------------------------- reports
+    def circuit_states(self) -> Dict[str, str]:
+        return {r.name: r.breaker.state for r in self.replicas()}
+
+    def close(self):
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for r in self.replicas():
+            r.close()

@@ -1,0 +1,118 @@
+"""Routing policies: which replica gets the next request.
+
+Selection pipeline (every policy):
+
+  1. candidates = healthy ∩ not-draining ∩ circuit-allows
+  2. adapter awareness: replicas that report the requested LoRA adapter
+     loaded win; if none report it (or stats are unknown), fall back to all
+     candidates — the engine loads on demand / 400s an unknown name.
+  3. session affinity: a request carrying a session key sticks to the
+     replica that served the session before (its prefix cache holds the
+     conversation's KV rows, so re-prefill becomes a suffix extension) —
+     as long as that replica is still a candidate.
+  4. policy pick: ``least_busy`` (lowest slot occupancy, gateway in-flight
+     count as tiebreak/fallback) or ``round_robin``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+from datatunerx_tpu.gateway.replica_pool import (
+    NoReplicaAvailable,
+    Replica,
+    ReplicaPool,
+)
+
+POLICIES = ("least_busy", "round_robin")
+
+
+def session_key(messages: List[dict], explicit: Optional[str] = None) -> str:
+    """Affinity key for a conversation. An explicit session id (body
+    ``session_id`` / ``user`` field, X-DTX-Session-Id header) wins; else the
+    first message anchors the conversation — every later turn of the same
+    chat shares it, so turns land where the prefix cache is warm."""
+    if explicit:
+        return str(explicit)
+    if not messages:
+        return ""
+    first = messages[0]
+    seed = f"{first.get('role', '')}:{first.get('content', '')}"
+    return hashlib.sha1(seed.encode("utf-8", "replace")).hexdigest()
+
+
+class Router:
+    def __init__(self, pool: ReplicaPool, policy: str = "least_busy",
+                 affinity_capacity: int = 4096):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; one of {POLICIES}")
+        self.pool = pool
+        self.policy = policy
+        self._rr = 0
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._affinity_capacity = affinity_capacity
+        self._lock = threading.Lock()
+
+    def route(self, messages: Optional[List[dict]] = None,
+              adapter: str = "", session_id: Optional[str] = None,
+              exclude: Optional[set] = None) -> Replica:
+        """Pick a replica. ``exclude`` names replicas already tried for this
+        request (failover must not retry the replica that just died)."""
+        exclude = exclude or set()
+        candidates = [r for r in self.pool.available()
+                      if r.name not in exclude]
+        if not candidates:
+            raise NoReplicaAvailable(
+                f"no available replica (total={len(self.pool.replicas())}, "
+                f"excluded={sorted(exclude)})")
+
+        if adapter:
+            with_adapter = []
+            for r in candidates:
+                adapters = r.stats().get("adapters")
+                if adapters is None or adapter in adapters:
+                    with_adapter.append(r)
+            candidates = with_adapter or candidates
+
+        key = session_key(messages or [], session_id)
+        if key:
+            with self._lock:
+                pinned = self._affinity.get(key)
+            if pinned:
+                for r in candidates:
+                    if r.name == pinned:
+                        self._touch(key, r.name)
+                        return r
+
+        chosen = self._pick(candidates)
+        if key:
+            self._touch(key, chosen.name)
+        return chosen
+
+    def _pick(self, candidates: List[Replica]) -> Replica:
+        if self.policy == "round_robin":
+            with self._lock:
+                # stable order so the rotation actually rotates
+                ordered = sorted(candidates, key=lambda r: r.name)
+                chosen = ordered[self._rr % len(ordered)]
+                self._rr += 1
+            return chosen
+        return min(candidates, key=lambda r: (r.busy_fraction(), r.inflight,
+                                              r.name))
+
+    def _touch(self, key: str, name: str):
+        with self._lock:
+            self._affinity[key] = name
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self._affinity_capacity:
+                self._affinity.popitem(last=False)
+
+    def forget_replica(self, name: str):
+        """Drop affinity pins to a removed/dead replica so stale sessions
+        rebalance instead of pinning to a ghost."""
+        with self._lock:
+            for k in [k for k, v in self._affinity.items() if v == name]:
+                del self._affinity[k]

@@ -1,0 +1,672 @@
+"""Gateway HTTP front-end: one endpoint fronting N serving replicas.
+
+Same wire surface the single server exposes (POST /chat/completions and
+/v1/chat/completions with SSE streaming, GET /healthz, /v1/models,
+/metrics, POST /perplexity) plus gateway-only endpoints:
+
+  GET  /autoscale            queue/p95 summary + desired-replica hint
+                             (operator/capacity.py consumes this)
+  POST /admin/scale          {"replicas": N} — resize the managed replica
+                             set (graceful drain on downscale)
+  POST /admin/drain          {"replica": name} — drain one replica for a
+                             rolling restart
+
+Request handling: admission control first (429 + Retry-After on overload),
+then routed to a replica (least-busy / round-robin / session affinity /
+adapter awareness), with failover — a replica dying yields a retry on
+another replica, including MID-STREAM: the replacement's output has the
+already-emitted prefix skipped, so the client's SSE stream continues
+seamlessly. Every request carries an X-DTX-Trace-Id, generated here when
+absent and propagated to the replica, so one id follows a request
+operator → gateway → engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from datatunerx_tpu.gateway.admission import AdmissionController, Overloaded
+from datatunerx_tpu.gateway.autoscale import autoscale_hint
+from datatunerx_tpu.gateway.metrics import Registry
+from datatunerx_tpu.gateway.replica_pool import (
+    HTTPReplica,
+    NoReplicaAvailable,
+    Replica,
+    ReplicaError,
+    ReplicaPool,
+)
+from datatunerx_tpu.gateway.router import Router
+from datatunerx_tpu.serving.local_backend import _free_port
+
+
+class Gateway:
+    """Transport-independent core: tests drive this directly; the HTTP
+    handler below is a thin shell around it."""
+
+    def __init__(self, pool: ReplicaPool, policy: str = "least_busy",
+                 admission: Optional[AdmissionController] = None,
+                 max_attempts: int = 3, model_name: str = ""):
+        self.pool = pool
+        self.router = Router(pool, policy=policy)
+        self.admission = admission or AdmissionController()
+        self.max_attempts = max_attempts
+        self.model_name = model_name
+        self.registry = Registry()
+        self._requests = self.registry.counter(
+            "dtx_gateway_requests_total", "Requests by terminal HTTP code.")
+        self._failovers = self.registry.counter(
+            "dtx_gateway_failovers_total",
+            "Requests retried on another replica after a replica fault.")
+        self._latency = self.registry.histogram(
+            "dtx_gateway_request_latency_seconds",
+            "End-to-end request latency through the gateway.")
+        self.replica_set = None  # ManagedReplicaSet when the gateway spawns
+        # serializes snapshot-gauge restating (concurrent scrapes would race
+        # clear/set and drop per-replica series) and the shed-delta tracking
+        self._scrape_lock = threading.Lock()
+        self._shed_at_last_hint = 0
+
+    # -------------------------------------------------------------- routing
+    def _kwargs_from(self, req: dict) -> dict:
+        return dict(
+            max_new_tokens=int(req.get("max_tokens", 128)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_p=float(req.get("top_p", 1.0)),
+        )
+
+    def _adapter_from(self, req: dict) -> str:
+        adapter = req.get("model") or ""
+        if adapter and adapter == self.model_name:
+            return ""
+        return adapter
+
+    def _route(self, messages, adapter, session_id, tried) -> Replica:
+        return self.router.route(messages=messages, adapter=adapter,
+                                 session_id=session_id, exclude=tried)
+
+    def _replica_failed(self, replica: Replica):
+        replica.breaker.record_failure()
+        self.router.forget_replica(replica.name)
+
+    # ----------------------------------------------------------- non-stream
+    def chat(self, req: dict, trace_id: str = "",
+             session_id: Optional[str] = None) -> str:
+        """Complete a non-streamed chat request with failover. Raises
+        Overloaded / NoReplicaAvailable / ValueError(client error)."""
+        messages = req.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("messages must be a non-empty list")
+        adapter = self._adapter_from(req)
+        kwargs = self._kwargs_from(req)
+        if adapter:
+            kwargs["adapter"] = adapter
+        t0 = time.monotonic()
+        with self.admission.try_admit(messages):
+            tried: set = set()
+            last: Optional[Exception] = None
+            for attempt in range(self.max_attempts):
+                replica = self._route(messages, adapter, session_id, tried)
+                tried.add(replica.name)
+                replica.acquire()
+                try:
+                    text = replica.chat(messages, trace_id=trace_id, **kwargs)
+                    replica.breaker.record_success()
+                    self._latency.observe(time.monotonic() - t0)
+                    return text
+                except ReplicaError as e:
+                    self._replica_failed(replica)
+                    self._failovers.inc()
+                    last = e
+                finally:
+                    replica.release()
+            raise NoReplicaAvailable(
+                f"all {len(tried)} attempted replicas failed: {last}")
+
+    # --------------------------------------------------------------- stream
+    def chat_stream(self, req: dict, trace_id: str = "",
+                    session_id: Optional[str] = None):
+        """Yield text deltas with MID-STREAM failover: when a replica dies
+        after emitting part of the answer, the request restarts on another
+        replica and the already-emitted character prefix is skipped — the
+        client's stream continues where it stopped. (Deterministic decode
+        gives byte-identical resumption; sampled requests resume the same
+        way but may diverge, which beats a dead stream.)"""
+        messages = req.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("messages must be a non-empty list")
+        adapter = self._adapter_from(req)
+        kwargs = self._kwargs_from(req)
+        if adapter:
+            kwargs["adapter"] = adapter
+        t0 = time.monotonic()
+        with self.admission.try_admit(messages):
+            emitted = ""
+            tried: set = set()
+            for attempt in range(self.max_attempts):
+                replica = self._route(messages, adapter, session_id, tried)
+                tried.add(replica.name)
+                replica.acquire()
+                skip = len(emitted)
+                try:
+                    for delta in replica.chat_stream(
+                            messages, trace_id=trace_id, **kwargs):
+                        if skip > 0:
+                            if len(delta) <= skip:
+                                skip -= len(delta)
+                                continue
+                            delta = delta[skip:]
+                            skip = 0
+                        emitted += delta
+                        yield delta
+                    replica.breaker.record_success()
+                    self._latency.observe(time.monotonic() - t0)
+                    return
+                except ReplicaError:
+                    self._replica_failed(replica)
+                    self._failovers.inc()
+                finally:
+                    replica.release()
+            raise NoReplicaAvailable(
+                f"stream failed over {len(tried)} replicas")
+
+    # ----------------------------------------------------------- perplexity
+    def perplexity(self, req: dict, trace_id: str = "") -> dict:
+        import urllib.error
+
+        replica = self._route(None, req.get("model") or "", None, set())
+        if not isinstance(replica, HTTPReplica):
+            raise NotImplementedError(
+                "perplexity proxying requires HTTP replicas")
+        replica.acquire()
+        try:
+            with replica._post("/perplexity", req, trace_id) as r:
+                out = json.load(r)
+            replica.breaker.record_success()
+            return out
+        except urllib.error.HTTPError as e:
+            # 4xx is the CLIENT's error (same rule as chat): the replica is
+            # fine — don't trip its breaker over someone's malformed body
+            if 400 <= e.code < 500:
+                try:
+                    detail = json.load(e).get("error", e.reason)
+                except Exception:  # noqa: BLE001
+                    detail = e.reason
+                raise ValueError(str(detail)) from e
+            self._replica_failed(replica)
+            raise ReplicaError(f"{replica.name}: HTTP {e.code}") from e
+        except (OSError, ValueError) as e:
+            self._replica_failed(replica)
+            raise ReplicaError(f"{replica.name}: {e}") from e
+        finally:
+            replica.release()
+
+    # -------------------------------------------------------------- reports
+    def healthy(self) -> bool:
+        return len(self.pool.available()) > 0
+
+    def autoscale(self) -> dict:
+        shed_total = self.admission.shed_count
+        with self._scrape_lock:
+            shed_recent = shed_total - self._shed_at_last_hint
+            self._shed_at_last_hint = shed_total
+        return autoscale_hint(
+            replicas=len(self.pool.replicas()),
+            available_replicas=len(self.pool.available()),
+            queue_depth=self.admission.depth,
+            queued_tokens=self.admission.queued_tokens,
+            shed_count=shed_total,
+            shed_recent=shed_recent,
+            p95_latency_s=self._latency.percentile(0.95),
+        )
+
+    def record_request(self, code: int):
+        self._requests.inc({"code": str(code)})
+
+    def metrics_text(self) -> str:
+        with self._scrape_lock:
+            return self._metrics_text_locked()
+
+    def _metrics_text_locked(self) -> str:
+        # re-state snapshot gauges at scrape time
+        g = self.registry.gauge
+        g("dtx_gateway_up", "1 when at least one replica is available.").set(
+            1 if self.healthy() else 0)
+        g("dtx_gateway_queue_depth",
+          "Admitted requests currently queued or in flight.").set(
+            self.admission.depth)
+        g("dtx_gateway_queued_tokens",
+          "Estimated prefill tokens admitted and not yet released.").set(
+            self.admission.queued_tokens)
+        shed = self.registry.counter(
+            "dtx_gateway_shed_total",
+            "Requests rejected with 429 by admission control.")
+        shed.set(self.admission.shed_count)
+        circuit = g("dtx_gateway_replica_circuit_state",
+                    "One-hot per-replica breaker state "
+                    "(closed/half_open/open).")
+        up = g("dtx_gateway_replica_up",
+               "Per-replica health-probe verdict (0 = draining too).")
+        busy = g("dtx_gateway_replica_inflight",
+                 "Gateway-side in-flight requests per replica.")
+        circuit.clear()
+        up.clear()
+        busy.clear()
+        for r in self.pool.replicas():
+            state = r.breaker.state
+            for s in ("closed", "half_open", "open"):
+                circuit.set(1 if s == state else 0,
+                            {"replica": r.name, "state": s})
+            up.set(1 if r.available() else 0, {"replica": r.name})
+            busy.set(r.inflight, {"replica": r.name})
+        return self.registry.expose()
+
+    def scale(self, n: int) -> int:
+        if self.replica_set is None:
+            raise NotImplementedError("gateway does not manage its replicas")
+        return self.replica_set.scale(n)
+
+    def close(self):
+        if self.replica_set is not None:
+            self.replica_set.close()
+        self.pool.close()
+
+
+# ------------------------------------------------------------------- subprocs
+class ManagedReplicaSet:
+    """Supervises serving.server subprocess replicas on localhost — the
+    process-per-replica deployment LocalServingBackend/`dtx serve
+    --replicas N` uses. A supervisor thread reconciles toward ``target``:
+    dead processes (crashed/killed replicas) are reaped and REPLACED, so the
+    fleet self-heals like Ray Serve restarting a dead deployment replica.
+    Downscale is graceful: the replica drains (no new requests) and its
+    process is reaped once in-flight work finishes."""
+
+    def __init__(self, pool: ReplicaPool, server_args: List[str],
+                 workdir: str = "", drain_timeout_s: float = 30.0,
+                 supervise_interval_s: float = 2.0):
+        self.pool = pool
+        self.server_args = list(server_args)
+        self.workdir = workdir or os.getcwd()
+        self.drain_timeout_s = drain_timeout_s
+        self.target = 0
+        self._procs: dict = {}
+        self._next_idx = 0
+        self._lock = threading.Lock()
+        os.makedirs(self.workdir, exist_ok=True)
+        self._shutdown = threading.Event()
+        self._supervisor = None
+        if supervise_interval_s > 0:
+            self._supervisor = threading.Thread(
+                target=self._supervise, args=(supervise_interval_s,),
+                daemon=True)
+            self._supervisor.start()
+
+    def spawn(self) -> HTTPReplica:
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        name = f"replica-{idx}"
+        port = _free_port()
+        log = open(os.path.join(self.workdir, f"{name}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "datatunerx_tpu.serving.server",
+             *self.server_args, "--port", str(port)],
+            stdout=log, stderr=subprocess.STDOUT, cwd=self.workdir,
+        )
+        with self._lock:
+            self._procs[name] = proc
+        replica = HTTPReplica(name, f"http://127.0.0.1:{port}")
+        replica.healthy = False  # until the health probe sees model loaded
+        self.pool.add(replica)
+        return replica
+
+    def scale(self, n: int) -> int:
+        self.target = max(0, int(n))
+        self._reconcile()
+        return self.target
+
+    def _supervise(self, interval: float):
+        while not self._shutdown.wait(interval):
+            self._reconcile()
+
+    def _reconcile(self):
+        """Converge the live managed fleet on ``target``: reap dead
+        processes first (a killed replica must not count toward the target,
+        or the fleet would stay degraded forever), then spawn/drain."""
+        with self._lock:
+            dead = [name for name, proc in self._procs.items()
+                    if proc.poll() is not None]
+            for name in dead:
+                self._procs.pop(name, None)
+        for name in dead:
+            self.pool.remove(name)
+        with self._lock:
+            managed = set(self._procs)
+        live = sorted((r for r in self.pool.replicas()
+                       if r.name in managed and not r.draining),
+                      key=lambda r: r.name)
+        for _ in range(self.target - len(live)):
+            self.spawn()
+        for replica in live[self.target:][::-1]:  # drain newest-first
+            replica.drain()
+            threading.Thread(target=self._reap, args=(replica,),
+                             daemon=True).start()
+
+    def _reap(self, replica: HTTPReplica):
+        deadline = time.monotonic() + self.drain_timeout_s
+        while replica.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        self.pool.remove(replica.name)
+        with self._lock:
+            proc = self._procs.pop(replica.name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def close(self):
+        self._shutdown.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+# ----------------------------------------------------------------------- http
+def make_handler(gw: Gateway):
+    class Handler(BaseHTTPRequestHandler):
+        gateway = gw
+
+        # ------------------------------------------------------------ plumbing
+        def _trace_id(self) -> str:
+            return (self.headers.get("X-DTX-Trace-Id")
+                    or f"dtx-{uuid.uuid4().hex[:16]}")
+
+        def _json(self, code: int, payload: dict, trace_id: str = "",
+                  extra_headers: Optional[dict] = None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if trace_id:
+                self.send_header("X-DTX-Trace-Id", trace_id)
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+            self.gateway.record_request(code)
+
+        # -------------------------------------------------------------- GET
+        def do_GET(self):
+            if self.path == "/healthz":
+                if self.gateway.healthy():
+                    self._json(200, {
+                        "status": "HEALTHY",
+                        "replicas": len(self.gateway.pool.replicas()),
+                        "available": len(self.gateway.pool.available()),
+                    })
+                else:
+                    self._json(503, {"status": "LOADING"})
+            elif self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [
+                    {"id": self.gateway.model_name, "object": "model"}]})
+            elif self.path == "/autoscale":
+                self._json(200, self.gateway.autoscale())
+            elif self.path == "/metrics":
+                body = self.gateway.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not found"})
+
+        # ------------------------------------------------------------- POST
+        def do_POST(self):
+            trace_id = self._trace_id()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"invalid JSON body: {e}"},
+                           trace_id)
+                return
+            if self.path in ("/chat/completions", "/v1/chat/completions"):
+                self._chat(req, trace_id)
+            elif self.path == "/perplexity":
+                self._perplexity(req, trace_id)
+            elif self.path == "/admin/scale":
+                self._scale(req, trace_id)
+            elif self.path == "/admin/drain":
+                self._drain(req, trace_id)
+            else:
+                self._json(404, {"error": "not found"}, trace_id)
+
+        def _session_id(self, req: dict) -> Optional[str]:
+            return (self.headers.get("X-DTX-Session-Id")
+                    or req.get("session_id") or req.get("user"))
+
+        def _chat(self, req: dict, trace_id: str):
+            session_id = self._session_id(req)
+            try:
+                if req.get("stream"):
+                    self._chat_sse(req, trace_id, session_id)
+                    return
+                text = self.gateway.chat(req, trace_id=trace_id,
+                                         session_id=session_id)
+                self._json(200, {
+                    "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+                    "object": "chat.completion",
+                    "created": int(time.time()),
+                    "model": self.gateway.model_name,
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": "stop",
+                    }],
+                }, trace_id)
+            except Overloaded as e:
+                self._json(429, {"error": f"overloaded: {e.reason}"},
+                           trace_id,
+                           {"Retry-After": e.retry_after_s})
+            except ValueError as e:
+                self._json(400, {"error": str(e)}, trace_id)
+            except NoReplicaAvailable as e:
+                self._json(503, {"error": str(e)}, trace_id)
+            except Exception as e:  # noqa: BLE001 — gateway must answer
+                self._json(500, {"error": str(e)}, trace_id)
+
+        def _chat_sse(self, req: dict, trace_id: str,
+                      session_id: Optional[str]):
+            rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            try:
+                deltas = self.gateway.chat_stream(req, trace_id=trace_id,
+                                                  session_id=session_id)
+                first = next(deltas, None)
+            except Overloaded as e:
+                self._json(429, {"error": f"overloaded: {e.reason}"},
+                           trace_id, {"Retry-After": e.retry_after_s})
+                return
+            except ValueError as e:
+                self._json(400, {"error": str(e)}, trace_id)
+                return
+            except (NoReplicaAvailable, ReplicaError) as e:
+                self._json(503, {"error": str(e)}, trace_id)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("X-DTX-Trace-Id", trace_id)
+            self.end_headers()
+
+            def event(payload: dict):
+                self.wfile.write(b"data: " + json.dumps(payload).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+
+            def chunk(delta, finish=None):
+                event({
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": int(time.time()),
+                    "model": self.gateway.model_name,
+                    "choices": [{"index": 0,
+                                 "delta": ({"content": delta}
+                                           if delta is not None else {}),
+                                 "finish_reason": finish}],
+                })
+
+            code = 200
+            try:
+                try:
+                    if first is not None:
+                        chunk(first)
+                    for delta in deltas:
+                        chunk(delta)
+                    chunk(None, finish="stop")
+                except Exception as e:  # noqa: BLE001 — headers already sent
+                    event({"error": {"message": str(e)}})
+                    code = 500
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                code = 499
+            self.gateway.record_request(code)
+
+        def _perplexity(self, req: dict, trace_id: str):
+            try:
+                self._json(200, self.gateway.perplexity(req, trace_id),
+                           trace_id)
+            except NotImplementedError as e:
+                self._json(501, {"error": str(e)}, trace_id)
+            except ValueError as e:  # replica judged the request malformed
+                self._json(400, {"error": str(e)}, trace_id)
+            except NoReplicaAvailable as e:
+                self._json(503, {"error": str(e)}, trace_id)
+            except Exception as e:  # noqa: BLE001 — replica fault
+                self._json(502, {"error": str(e)}, trace_id)
+
+        def _scale(self, req: dict, trace_id: str):
+            try:
+                n = int(req.get("replicas"))
+            except (TypeError, ValueError):
+                self._json(400, {"error": "replicas must be an integer"},
+                           trace_id)
+                return
+            try:
+                self._json(200, {"replicas": self.gateway.scale(n)}, trace_id)
+            except NotImplementedError as e:
+                self._json(501, {"error": str(e)}, trace_id)
+
+        def _drain(self, req: dict, trace_id: str):
+            name = req.get("replica") or ""
+            if self.gateway.pool.drain(name):
+                self.gateway.router.forget_replica(name)
+                self._json(200, {"draining": name}, trace_id)
+            else:
+                self._json(404, {"error": f"no replica {name!r}"}, trace_id)
+
+        def log_message(self, *a):
+            pass
+
+    return Handler
+
+
+def serve(gw: Gateway, port: int = 0,
+          host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer((host, port), make_handler(gw))
+    return srv
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="datatunerx-tpu-gateway")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--policy", default="least_busy",
+                   choices=["least_busy", "round_robin"])
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--token_budget", type=int, default=32768,
+                   help="estimated queued prefill tokens before shedding")
+    p.add_argument("--health_interval", type=float, default=2.0)
+    p.add_argument("--replica_url", action="append", default=[],
+                   help="front an EXISTING serving server (repeatable); "
+                        "mutually exclusive with --replicas spawning")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="spawn N serving.server subprocesses to front")
+    p.add_argument("--workdir", default="",
+                   help="replica log directory (spawn mode)")
+    # pass-through model flags for spawn mode (mirror serving.server)
+    p.add_argument("--model_path", default="")
+    p.add_argument("--checkpoint_path", default="")
+    p.add_argument("--template", default="llama2")
+    p.add_argument("--max_seq_len", type=int, default=1024)
+    p.add_argument("--quantization", default="")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--decode_chunk", type=int, default=8)
+    p.add_argument("--adapters", default="")
+    p.add_argument("--kv_quant", default="")
+    p.add_argument("--prefix_cache", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if not args.replica_url and args.replicas <= 0:
+        p.error("need --replica_url URL(s) or --replicas N with --model_path")
+    if args.replicas > 0 and not args.model_path:
+        p.error("--replicas spawning requires --model_path")
+
+    pool = ReplicaPool(health_interval_s=args.health_interval)
+    gw = Gateway(pool, policy=args.policy,
+                 admission=AdmissionController(max_queue=args.max_queue,
+                                               token_budget=args.token_budget),
+                 model_name=args.model_path)
+    for i, url in enumerate(args.replica_url):
+        pool.add(HTTPReplica(f"replica-{i}", url))
+    if args.replicas > 0:
+        server_args = ["--model_path", args.model_path,
+                       "--checkpoint_path", args.checkpoint_path,
+                       "--template", args.template,
+                       "--max_seq_len", str(args.max_seq_len),
+                       "--quantization", args.quantization,
+                       "--slots", str(args.slots),
+                       "--decode_chunk", str(args.decode_chunk),
+                       "--adapters", args.adapters,
+                       "--kv_quant", args.kv_quant,
+                       "--prefix_cache", str(args.prefix_cache)]
+        gw.replica_set = ManagedReplicaSet(
+            pool, server_args, workdir=args.workdir or "gateway-replicas")
+        gw.replica_set.scale(args.replicas)
+
+    srv = serve(gw, port=args.port)
+    print(f"[gateway] listening on :{args.port} "
+          f"({len(pool.replicas())} replicas, policy={args.policy})",
+          flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -441,7 +441,10 @@ class ManifestBackend:
             "kind": "Deployment",
             "metadata": {"name": name, "labels": labels},
             "spec": {
-                "replicas": 1,
+                # horizontal serving scale (gateway tier): the Service
+                # spreads requests; in-cluster gateway deployment with
+                # per-pod discovery is a ROADMAP open item
+                "replicas": int(spec.get("replicas") or 1),
                 "selector": {"matchLabels": {"app": name}},
                 "template": {
                     "metadata": {"labels": labels},

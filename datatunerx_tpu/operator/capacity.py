@@ -149,3 +149,26 @@ def check_admission(
         f"batch={batch} seq={seq} mesh={mesh_shape} "
         f"(breakdown GB: {fp.gb()}); shard further (meshShape), lower "
         f"batchSize/blockSize, or quantize (int4)", fp.gb())
+
+
+def serving_replicas_for(
+    hint: dict,
+    *,
+    min_replicas: int = 1,
+    max_replicas: int = 8,
+    free_slices: Optional[int] = None,
+) -> int:
+    """Turn the gateway's autoscale hint (gateway/autoscale.py, polled from
+    GET /autoscale) into the replica count the controller should apply.
+
+    The gateway only observes (queue depth, shed count, p95); capacity
+    policy lives HERE: the spec's min/max bounds and — when a TPU slice
+    pool exists — the free-slice inventory cap scale-up, so the controller
+    never asks for replicas the hardware can't place (the same inventory
+    `placement.SlicePool` gates training jobs with)."""
+    current = max(1, int(hint.get("replicas", 1)))
+    desired = int(hint.get("desiredReplicas", current))
+    desired = max(min_replicas, min(max_replicas, desired))
+    if free_slices is not None and desired > current:
+        desired = min(desired, current + max(0, int(free_slices)))
+    return desired

@@ -77,7 +77,22 @@ SPECS = {
         "finetune": obj({"name": STR, "finetuneSpec": FINETUNE_SPEC},
                         required=["finetuneSpec"]),
         "scoringPluginConfig": obj({"name": STR, "parameters": STR}),
-        "serveConfig": obj({"nodeSelector": ANY, "tolerations": arr(ANY)}),
+        "serveConfig": obj({
+            "nodeSelector": ANY, "tolerations": arr(ANY),
+            # TPU additions (generate.py generate_serving_spec)
+            "quantization": {"type": "string",
+                             "enum": ["", "int8", "int4", "nf4"]},
+            "slots": INT,
+            # gateway tier (gateway/server.py): N replicas behind one
+            # endpoint with routing/admission/failover; min/max bound the
+            # autoscale hint the controller applies
+            "replicas": INT,
+            "gateway": BOOL,
+            "policy": {"type": "string",
+                       "enum": ["least_busy", "round_robin"]},
+            "minReplicas": INT,
+            "maxReplicas": INT,
+        }),
     }, required=["finetune"]),
     "FinetuneExperiment": obj({
         "finetuneJobs": arr(obj({"name": STR, "spec": ANY})),

@@ -45,8 +45,11 @@ SERVE_POLL_S = float(os.environ.get("DTX_SERVE_POLL_S", "5.0"))
 class FinetuneJobController:
     kind = FinetuneJob
 
-    def __init__(self, serving_backend):
+    def __init__(self, serving_backend, slice_pool=None):
         self.serving = serving_backend
+        # optional SlicePool: caps gateway scale-up at free slice inventory
+        # (capacity.serving_replicas_for), same pool FinetuneController uses
+        self.slice_pool = slice_pool
 
     # re-enter when owned Finetune / Scoring change (reference Watches wiring,
     # finetunejob_controller.go:162-206). Owner references already cover this
@@ -206,6 +209,8 @@ class FinetuneJobController:
         result["dashboard"] = endpoint.replace(":8000", ":8080")
         inference_url = endpoint.rstrip("/") + "/chat/completions"  # reference :433
 
+        changed = self._reconcile_autoscale(job) or changed
+
         if store.try_get(Scoring, name, job.metadata.namespace) is None:
             if job.spec.get("scoringPluginConfig") and job.spec["scoringPluginConfig"].get("name"):
                 scoring = generate_plugin_scoring(job, inference_url)
@@ -219,6 +224,50 @@ class FinetuneJobController:
         if changed:
             store.update(job)
         return None  # scoring watch / requeue drives the rest
+
+    def _reconcile_autoscale(self, job: FinetuneJob) -> bool:
+        """Poll the gateway's autoscale hint and apply the capacity-clamped
+        replica count (gateway/autoscale.py → capacity.serving_replicas_for).
+        No-op for single-replica/no-gateway deployments and backends that
+        don't expose scale_hint/scale. Returns True when job.status changed."""
+        serve_cfg = job.spec.get("serveConfig", {}) or {}
+        gatewayed = (bool(serve_cfg.get("gateway"))
+                     or int(serve_cfg.get("replicas") or 1) > 1)
+        hint_fn = getattr(self.serving, "scale_hint", None)
+        scale_fn = getattr(self.serving, "scale", None)
+        if not gatewayed or hint_fn is None or scale_fn is None:
+            return False
+        hint = hint_fn(job.metadata.name)
+        if hint is None:
+            return False
+
+        from datatunerx_tpu.operator.capacity import serving_replicas_for
+
+        desired = serving_replicas_for(
+            hint,
+            min_replicas=int(serve_cfg.get("minReplicas") or 1),
+            max_replicas=int(serve_cfg.get("maxReplicas")
+                             or serve_cfg.get("replicas") or 1),
+            free_slices=(self.slice_pool.free_count()
+                         if self.slice_pool is not None else None),
+        )
+        result = job.status.setdefault("result", {})
+        summary = {
+            "replicas": hint["replicas"],
+            "desiredReplicas": desired,
+            "queueDepth": hint["queueDepth"],
+            "shedCount": hint["shedCount"],
+            "p95LatencySeconds": hint["p95LatencySeconds"],
+            "reason": hint["reason"],
+        }
+        changed = result.get("serving") != summary
+        result["serving"] = summary
+        if desired != hint["replicas"]:
+            try:
+                scale_fn(job.metadata.name, desired)
+            except Exception:  # noqa: BLE001 — next poll retries; don't
+                pass           # fail the reconcile over a scale hiccup
+        return changed
 
     # -------------------------------------------------------------- scoring
     def _reconcile_by_scoring_status(self, store: ObjectStore, job: FinetuneJob) -> Optional[Result]:

@@ -228,6 +228,15 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         # continuous-batching slot count (serving/server.py --slots; 1 =
         # single-request engine); TPU addition to ServeConfig
         "slots": serve_cfg.get("slots"),
+        # multi-replica serving behind the inference gateway
+        # (gateway/server.py, replaces the reference's Ray Serve tier):
+        # replicas > 1 or gateway=true puts the gateway in front
+        "replicas": int(serve_cfg.get("replicas") or 1),
+        "gateway": bool(serve_cfg.get("gateway")),
+        "policy": serve_cfg.get("policy", "least_busy"),
+        "min_replicas": int(serve_cfg.get("minReplicas") or 1),
+        "max_replicas": int(serve_cfg.get("maxReplicas")
+                            or serve_cfg.get("replicas") or 1),
     }
 
 

@@ -83,6 +83,7 @@ class KubeServingBackend(ManifestBackend):
             "tolerations": spec.get("tolerations", []),
             "quantization": spec.get("quantization", ""),
             "slots": spec.get("slots"),
+            "replicas": spec.get("replicas"),
         })
         for group, version, plural, body in (
             ("apps", "v1", "deployments", deployment),

@@ -12,7 +12,6 @@ server and are accepted as no-ops for drop-in compatibility.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import threading
 import time
@@ -52,7 +51,8 @@ def build_manager(
     mgr.register(FinetuneController(training_backend, storage_path=storage_path,
                                     health_probe=health_probe,
                                     slice_pool=slice_pool))
-    mgr.register(FinetuneJobController(serving_backend))
+    mgr.register(FinetuneJobController(serving_backend,
+                                       slice_pool=slice_pool))
     mgr.register(FinetuneExperimentController())
     if with_scoring:
         from datatunerx_tpu.scoring.controller import ScoringController

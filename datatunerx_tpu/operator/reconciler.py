@@ -19,7 +19,7 @@ import dataclasses
 import heapq
 import threading
 import time
-from typing import Callable, List, Optional, Protocol, Tuple
+from typing import List, Optional, Protocol, Tuple
 
 from datatunerx_tpu.operator.errors import handle_err
 from datatunerx_tpu.operator.store import Conflict, NotFound, ObjectStore

@@ -117,6 +117,24 @@ def validate_finetunejob(obj: CustomResource):
         _require(bool(str(plugin["name"]).strip()),
                  "scoringPluginConfig.name must be non-empty when set")
     _validate_probes(obj.spec.get("scoringProbes"))
+    _validate_serve_config(obj.spec.get("serveConfig") or {})
+
+
+def _validate_serve_config(cfg: dict):
+    _require(isinstance(cfg, dict), "serveConfig must be an object")
+    for key in ("replicas", "minReplicas", "maxReplicas", "slots"):
+        if cfg.get(key) is not None:
+            v = _num(cfg[key], f"serveConfig.{key}")
+            _require(v >= 1 and float(v).is_integer(),
+                     f"serveConfig.{key} must be a positive integer")
+    lo = int(float(cfg.get("minReplicas", 1) or 1))
+    hi = cfg.get("maxReplicas")
+    if hi is not None:
+        _require(int(float(hi)) >= lo,
+                 "serveConfig.maxReplicas must be >= minReplicas")
+    if cfg.get("policy") is not None:
+        _require(str(cfg["policy"]) in ("least_busy", "round_robin"),
+                 "serveConfig.policy must be least_busy or round_robin")
 
 
 def validate_finetuneexperiment(obj: CustomResource):
@@ -135,7 +153,17 @@ def validate_finetuneexperiment(obj: CustomResource):
 def default_finetunejob(obj: CustomResource):
     spec = obj.spec.setdefault("finetune", {}).setdefault("finetuneSpec", {})
     spec.setdefault("node", 1)
-    obj.spec.setdefault("serveConfig", {})
+    serve = obj.spec.setdefault("serveConfig", {})
+    # gateway-tier defaults: single replica unless asked; asking for
+    # replicas > 1 implies the gateway fronts them
+    serve.setdefault("replicas", 1)
+    if int(float(serve.get("replicas") or 1)) > 1:
+        serve.setdefault("gateway", True)
+    if serve.get("gateway"):
+        serve.setdefault("policy", "least_busy")
+        serve.setdefault("minReplicas", 1)
+        serve.setdefault("maxReplicas",
+                         max(int(float(serve.get("replicas") or 1)), 1))
 
 
 def default_hyperparameter(obj: CustomResource):

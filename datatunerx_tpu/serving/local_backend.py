@@ -43,14 +43,32 @@ class LocalServingBackend:
             appdir = os.path.join(self.workdir, f"serve-{name}")
             os.makedirs(appdir, exist_ok=True)
             log = open(os.path.join(appdir, "log.txt"), "w")
-            argv = [
-                sys.executable, "-m", "datatunerx_tpu.serving.server",
-                "--model_path", spec["model_path"],
-                "--checkpoint_path", spec.get("checkpoint_path") or "",
-                "--template", spec.get("template", self.template),
-                "--port", str(port),
-                "--quantization", spec.get("quantization") or "",
-            ]
+            replicas = int(spec.get("replicas") or 1)
+            if replicas > 1 or spec.get("gateway"):
+                # multi-replica serving: the gateway fronts N replica
+                # subprocesses (routing/admission/failover, gateway/server.py)
+                # behind the SAME /healthz + /chat/completions contract, so
+                # status() and the scoring POST work unchanged
+                argv = [
+                    sys.executable, "-m", "datatunerx_tpu.gateway.server",
+                    "--model_path", spec["model_path"],
+                    "--checkpoint_path", spec.get("checkpoint_path") or "",
+                    "--template", spec.get("template", self.template),
+                    "--port", str(port),
+                    "--quantization", spec.get("quantization") or "",
+                    "--replicas", str(replicas),
+                    "--policy", spec.get("policy") or "least_busy",
+                    "--workdir", appdir,
+                ]
+            else:
+                argv = [
+                    sys.executable, "-m", "datatunerx_tpu.serving.server",
+                    "--model_path", spec["model_path"],
+                    "--checkpoint_path", spec.get("checkpoint_path") or "",
+                    "--template", spec.get("template", self.template),
+                    "--port", str(port),
+                    "--quantization", spec.get("quantization") or "",
+                ]
             if spec.get("slots"):
                 argv += ["--slots", str(spec["slots"])]
             from datatunerx_tpu.operator.backends import _pkg_root
@@ -82,6 +100,35 @@ class LocalServingBackend:
     def endpoint(self, name: str) -> Optional[str]:
         port = self._ports.get(name)
         return f"http://127.0.0.1:{port}" if port else None
+
+    # ----------------------------------------------- gateway autoscaling
+    def scale_hint(self, name: str) -> Optional[dict]:
+        """The gateway's /autoscale summary, or None for single-server
+        deployments / unreachable gateways (controller skips scaling)."""
+        from datatunerx_tpu.gateway.autoscale import parse_hint
+
+        url = self.endpoint(name)
+        if not url:
+            return None
+        try:
+            with urllib.request.urlopen(f"{url}/autoscale", timeout=2) as r:
+                return parse_hint(json.load(r))
+        except Exception:  # noqa: BLE001 — no hint is a safe no-op
+            return None
+
+    def scale(self, name: str, replicas: int) -> bool:
+        url = self.endpoint(name)
+        if not url:
+            return False
+        req = urllib.request.Request(
+            f"{url}/admin/scale",
+            data=json.dumps({"replicas": int(replicas)}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001
+            return False
 
     def delete(self, name: str) -> None:
         with self._lock:

@@ -37,6 +37,11 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # echo the gateway's trace id so one id follows a request
+        # operator → gateway → replica (gateway/server.py generates it)
+        trace = self.headers.get("X-DTX-Trace-Id")
+        if trace:
+            self.send_header("X-DTX-Trace-Id", trace)
         self.end_headers()
         self.wfile.write(body)
 
@@ -70,17 +75,20 @@ class Handler(BaseHTTPRequestHandler):
             for kind, n in sorted(stats.items()):
                 lines.append(
                     f'dtx_serving_prefill_total{{kind="{kind}"}} {n}')
-            # hit = exact reuse, partial = suffix extension, miss = full
+            # hit = exact reuse, partial = suffix extension, miss = full;
+            # .get so a partially-populated stats dict (engine mid-init or a
+            # duck-typed test engine) can't 500 the scrape
             lines.append("# TYPE dtx_serving_prefix_cache_hits_total counter")
             lines.append(
-                f"dtx_serving_prefix_cache_hits_total {stats['reuse']}")
+                f"dtx_serving_prefix_cache_hits_total {stats.get('reuse', 0)}")
             lines.append(
                 "# TYPE dtx_serving_prefix_cache_partial_hits_total counter")
             lines.append(
-                f"dtx_serving_prefix_cache_partial_hits_total {stats['extend']}")
+                "dtx_serving_prefix_cache_partial_hits_total "
+                f"{stats.get('extend', 0)}")
             lines.append("# TYPE dtx_serving_prefix_cache_misses_total counter")
             lines.append(
-                f"dtx_serving_prefix_cache_misses_total {stats['full']}")
+                f"dtx_serving_prefix_cache_misses_total {stats.get('full', 0)}")
         prefix = getattr(eng, "_prefix", None)
         if prefix is not None:
             lines.append("# TYPE dtx_serving_prefix_cache_entries gauge")
@@ -200,6 +208,9 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
+        trace = self.headers.get("X-DTX-Trace-Id")
+        if trace:
+            self.send_header("X-DTX-Trace-Id", trace)
         self.end_headers()
 
         def event(payload: dict):

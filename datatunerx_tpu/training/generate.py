@@ -14,7 +14,6 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from datatunerx_tpu.models.config import ModelConfig

@@ -25,7 +25,6 @@ import numpy as np
 
 from datatunerx_tpu.data import BatchIterator, CsvDataset, get_template
 from datatunerx_tpu.data.preprocess import preprocess_preference_records
-from datatunerx_tpu.models.config import ModelConfig
 from datatunerx_tpu.parallel.distributed import maybe_initialize_distributed
 from datatunerx_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from datatunerx_tpu.training import TrainConfig, Trainer

@@ -67,7 +67,17 @@ for _k, _v in (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option. The XLA_FLAGS route still
+    # works post-import because the CPU backend initializes lazily on first
+    # device use — and the env var inherits into spawned trainer/serving
+    # subprocesses, matching the config-option path.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 # the env vars above bind spawned subprocesses (fresh interpreters read them
 # at import); for THIS process jax was already imported by sitecustomize, so
 # the config must be set explicitly — from the env values, so a user's own

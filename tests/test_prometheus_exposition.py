@@ -1,0 +1,194 @@
+"""Prometheus text-exposition validation for the serving and gateway
+/metrics endpoints — parser-based, not substring matching.
+
+A scrape that LOOKS right to a substring assert can still be rejected by a
+real Prometheus server: samples before their # TYPE line, duplicate series,
+unescaped label values. This parser enforces the exposition-format rules
+the scraper cares about and both endpoints must satisfy.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from datatunerx_tpu.gateway.metrics import (
+    Histogram,
+    Registry,
+    escape_label_value,
+)
+from datatunerx_tpu.gateway.replica_pool import InProcessReplica, ReplicaPool
+from datatunerx_tpu.gateway.server import Gateway, serve
+from datatunerx_tpu.serving import server as serving_server
+
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)(?: (?P<ts>[0-9]+))?$"
+)
+LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"'
+)
+
+
+def parse_exposition(text: str):
+    """→ (samples {series_key: float}, types {metric: type}). Asserts the
+    format invariants along the way."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    types = {}
+    samples = {}
+    seen_type_after_sample = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4, f"line {lineno}: malformed TYPE: {line!r}"
+            _, _, name, mtype = parts
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), f"line {lineno}: bad type {mtype}"
+            assert name not in types, \
+                f"line {lineno}: duplicate TYPE for {name}"
+            assert name not in seen_type_after_sample, \
+                f"line {lineno}: TYPE for {name} after its samples"
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: unparseable sample: {line!r}"
+        name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        tracked = base if base in types else name
+        seen_type_after_sample.add(tracked)
+        assert tracked in types, \
+            f"line {lineno}: sample {name} precedes its TYPE line"
+        labels = {}
+        raw = m.group("labels")
+        if raw is not None:
+            consumed = LABEL_RE.findall(raw)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in consumed)
+            assert rebuilt == raw, \
+                f"line {lineno}: malformed/unescaped labels: {raw!r}"
+            labels = dict(consumed)
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in samples, f"line {lineno}: duplicate series {key}"
+        value = m.group("value")
+        samples[key] = float("inf") if value == "+Inf" else float(value)
+    return samples, types
+
+
+# ------------------------------------------------------------ unit pieces
+def test_escape_label_value():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_registry_exposes_valid_format_with_nasty_labels():
+    reg = Registry()
+    reg.counter("t_requests_total", "help text").inc(
+        {"path": 'with"quote', "other": "back\\slash\nnewline"})
+    reg.gauge("t_depth").set(3)
+    h = reg.histogram("t_latency_seconds", buckets=(0.1, 1.0, float("inf")))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99)
+    samples, types = parse_exposition(reg.expose())
+    assert types["t_latency_seconds"] == "histogram"
+    assert samples[("t_latency_seconds_bucket", (("le", "0.1"),))] == 1
+    assert samples[("t_latency_seconds_bucket", (("le", "1.0"),))] == 2
+    assert samples[("t_latency_seconds_bucket", (("le", "+Inf"),))] == 3
+    assert samples[("t_latency_seconds_count", ())] == 3
+
+
+def test_histogram_percentile():
+    h = Histogram("x", buckets=(0.1, 0.5, 1.0, float("inf")))
+    for v in (0.05,) * 90 + (0.4,) * 9 + (2.0,):
+        h.observe(v)
+    assert h.percentile(0.5) == 0.1
+    assert h.percentile(0.95) == 0.5
+    assert h.percentile(1.0) == 1.0  # +Inf clamps to largest finite edge
+
+
+# --------------------------------------------------------- live endpoints
+class _StatsEngine:
+    """Duck-typed engine exposing the attributes serving._metrics reads."""
+
+    def __init__(self, partial_stats=False):
+        self.slots = 4
+        self._slot_req = [object(), None, None, None]
+        # partial dict: the regression the .get() hardening covers
+        self.prefill_stats = ({"full": 2} if partial_stats
+                              else {"full": 2, "reuse": 1, "extend": 0})
+
+    def chat(self, messages, **kw):
+        return "ok"
+
+
+@pytest.fixture()
+def serving_url():
+    old_engine = serving_server.STATE.engine
+    old_model = serving_server.STATE.model_path
+    serving_server.STATE.engine = _StatsEngine()
+    serving_server.STATE.model_path = "preset:test"
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), serving_server.Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    serving_server.STATE.engine = old_engine
+    serving_server.STATE.model_path = old_model
+
+
+def _scrape(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def test_serving_metrics_exposition_valid(serving_url):
+    samples, types = parse_exposition(_scrape(serving_url))
+    assert types["dtx_serving_up"] == "gauge"
+    assert samples[("dtx_serving_up", ())] == 1
+    assert samples[("dtx_serving_slots_busy", ())] == 1
+    assert samples[("dtx_serving_slots_total", ())] == 4
+    assert samples[("dtx_serving_prefill_total", (("kind", "full"),))] == 2
+
+
+def test_serving_metrics_survive_partial_stats_dict(serving_url):
+    """A stats dict missing reuse/extend keys must scrape as zeros, not 500
+    (the pre-hardening code indexed stats['reuse'] directly)."""
+    serving_server.STATE.engine = _StatsEngine(partial_stats=True)
+    samples, _ = parse_exposition(_scrape(serving_url))
+    assert samples[("dtx_serving_prefix_cache_hits_total", ())] == 0
+    assert samples[("dtx_serving_prefix_cache_partial_hits_total", ())] == 0
+    assert samples[("dtx_serving_prefix_cache_misses_total", ())] == 2
+
+
+def test_gateway_metrics_exposition_valid():
+    pool = ReplicaPool([InProcessReplica("r0", _StatsEngine()),
+                        InProcessReplica("r1", _StatsEngine())])
+    gw = Gateway(pool, model_name="preset:test")
+    srv = serve(gw, port=0, host="127.0.0.1")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        body = json.dumps({
+            "messages": [{"role": "user", "content": "hi"}]}).encode()
+        req = urllib.request.Request(
+            url + "/chat/completions", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+        samples, types = parse_exposition(_scrape(url))
+    finally:
+        srv.shutdown()
+        gw.close()
+    assert types["dtx_gateway_request_latency_seconds"] == "histogram"
+    assert types["dtx_gateway_replica_circuit_state"] == "gauge"
+    assert samples[("dtx_gateway_requests_total", (("code", "200"),))] == 1
+    assert samples[("dtx_gateway_queue_depth", ())] == 0
+    for r in ("r0", "r1"):
+        assert samples[(
+            "dtx_gateway_replica_circuit_state",
+            (("replica", r), ("state", "closed")))] == 1
