@@ -99,23 +99,67 @@ def child_tinyllama():
     state, m = tr.train_step(state, batch)
     float(m["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = tr.train_step(state, batch)
-    float(m["loss"])  # device-to-host fetch = true pipeline drain
-    dt = time.perf_counter() - t0
+    # DTX_BENCH_PIPELINE=1: feed the steps through the pipelined input path
+    # (data/prefetch.py — host batch build in a background thread + batch N+1
+    # placed while step N runs), the same machinery tuning/train.py uses. The
+    # default path keeps the static-batch measurement for round-over-round
+    # continuity; the pipelined line carries the pipeline wait stats so input
+    # stalls are visible next to the throughput number.
+    pipelined = bool(os.environ.get("DTX_BENCH_PIPELINE"))
+    pipe_stats = None
+    if pipelined:
+        import numpy as np
+
+        from datatunerx_tpu.data.prefetch import PipelineStats, prefetch_batches
+        from datatunerx_tpu.parallel.sharding import place_batch
+        from datatunerx_tpu.training.loss import IGNORE_INDEX as _II
+
+        host_rng = np.random.default_rng(3)
+
+        def host_batches():
+            for _ in range(steps):
+                t = host_rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+                lab = np.where(np.arange(T)[None, :] < T // 8, _II, t)
+                yield {"input_ids": t, "labels": lab.astype(np.int32)}
+
+        pipe_stats = PipelineStats()
+        batches, host_pf = prefetch_batches(
+            host_batches,
+            place_fn=lambda b: place_batch(b, tr.mesh),
+            depth=int(os.environ.get("DTX_BENCH_PREFETCH_DEPTH", "2")),
+            stats=pipe_stats,
+        )
+        t0 = time.perf_counter()
+        try:
+            for b in batches:
+                state, m = tr.train_step(state, b)
+        finally:
+            host_pf.close()
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = tr.train_step(state, batch)
+        float(m["loss"])  # device-to-host fetch = true pipeline drain
+        dt = time.perf_counter() - t0
 
     toks_per_sec = B * T * steps / dt
     vs = toks_per_sec / ROUND1_TINYLLAMA_TOKS if on_tpu else None
     tag = (f",{attention}" if attention != "xla" else "") + (
         f",remat={remat}" if remat != "dots" else "")
     tag += f",B{B}" if B != 8 else ""
-    print(json.dumps({
+    tag += ",pipelined" if pipelined else ""
+    line = {
         "metric": f"lora_sft_tokens_per_sec_per_chip[{model},B{B}xT{T}{tag}]",
         "value": round(toks_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3) if vs is not None else None,
-    }))
+    }
+    if pipe_stats is not None:
+        line["pipeline"] = {k: round(v, 3)
+                            for k, v in pipe_stats.snapshot().items()}
+    print(json.dumps(line))
 
 
 # ------------------------------------------------------------- orchestrator

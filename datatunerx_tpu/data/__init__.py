@@ -5,8 +5,22 @@ from datatunerx_tpu.data.preprocess import (
     preprocess_records,
 )
 from datatunerx_tpu.data.loader import CsvDataset, BatchIterator
+from datatunerx_tpu.data.prefetch import (
+    DevicePrefetcher,
+    HostPrefetcher,
+    MetricsBuffer,
+    PipelineStats,
+    PlacedBatch,
+    prefetch_batches,
+)
 
 __all__ = [
+    "DevicePrefetcher",
+    "HostPrefetcher",
+    "MetricsBuffer",
+    "PipelineStats",
+    "PlacedBatch",
+    "prefetch_batches",
     "Template",
     "get_template",
     "list_templates",

@@ -1,0 +1,325 @@
+"""Pipelined training input path: host prefetch + double-buffered device placement.
+
+The synchronous loop serializes three phases per step — host batch build
+(tokenize/pack in ``data/loader.py``), host→device transfer (``device_put``),
+and the step computation — so the accelerator idles on input between steps.
+Production JAX trainers (MaxText's multihost dataloading; the tf.data pipeline
+design of Murray et al., 2021) overlap all three. This module provides the same
+overlap in three small pieces:
+
+  HostPrefetcher    — runs any batch iterator in a background thread behind a
+                      bounded queue (backpressure, exception propagation, clean
+                      shutdown), so step N's host build happens during step N-1's
+                      compute.
+  DevicePrefetcher  — places batch N+1 onto the mesh while step N executes,
+                      keeping ``depth`` batches in flight. Placement goes
+                      through the SAME ``place_batch`` the Trainer uses inline
+                      (parallel/sharding.py), so single- and multi-host paths
+                      stay identical. Placed batches are marked ``PlacedBatch``
+                      so ``Trainer.train_step``/``eval_step`` skip re-placing.
+  MetricsBuffer     — holds in-flight device metrics and resolves only
+                      completed ones (one logging interval behind), so a
+                      logging boundary never drains the dispatch pipeline with
+                      per-metric ``float(v)`` blocking calls.
+
+Pipeline health (queue depth, host-build ms, device-put ms, step-wait ms) is
+aggregated by ``PipelineStats`` and surfaces both in MetricsLogger records and
+— via ``jax.profiler.TraceAnnotation`` around the host build and the device
+put — in XProf traces, so the overlap (or its absence) is visible.
+
+Determinism: the pipeline only changes WHEN work happens, never what the
+batches contain or the order they arrive — the pipelined loop is loss-identical
+to the synchronous loop on a fixed seed (tests/test_prefetch.py asserts the
+exact loss sequence).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+_ITEM, _ERROR, _DONE = 0, 1, 2
+
+
+class PlacedBatch(dict):
+    """A batch dict already placed on devices — ``Trainer._put_batch`` passes
+    it through untouched instead of re-placing (which on multi-host would
+    misread device arrays as process-local slices)."""
+
+
+class PipelineStats:
+    """Thread-safe accumulators for pipeline health, drained at logging
+    boundaries. All times in milliseconds; ``snapshot()`` returns the means
+    since the previous snapshot (so each logged record covers its interval).
+
+    Accumulators are bounded (last ``maxlen`` samples per key): non-main
+    processes in a multi-host run record every batch but never snapshot —
+    unbounded lists would leak for the process lifetime. A logging interval
+    longer than ``maxlen`` steps means the mean covers the interval's tail,
+    which is the operative signal anyway."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._maxlen = maxlen
+        self._acc: Dict[str, collections.deque] = {}
+
+    def record(self, key: str, value: float):
+        with self._lock:
+            dq = self._acc.get(key)
+            if dq is None:
+                dq = self._acc[key] = collections.deque(maxlen=self._maxlen)
+            dq.append(float(value))
+
+    def snapshot(self, reset: bool = True) -> Dict[str, float]:
+        """Mean per key since the last snapshot, prefixed ``pipe_``."""
+        with self._lock:
+            out = {
+                f"pipe_{k}": sum(v) / len(v) for k, v in self._acc.items() if v
+            }
+            if reset:
+                self._acc.clear()
+        return out
+
+
+class HostPrefetcher:
+    """Runs a batch-producing iterator in a daemon thread behind a bounded
+    queue.
+
+    - ``source``: an iterable, or a zero-arg callable returning an iterator
+      (use a callable when construction itself is expensive — e.g. re-opening
+      a shuffle-buffered stream — so it also runs off the step loop's thread).
+    - ``depth``: max batches buffered; the worker blocks (backpressure) once
+      the queue is full, bounding host memory at ``depth`` batches.
+    - A worker exception is re-raised in the consumer thread at the point the
+      failed batch would have been consumed.
+    - ``close()`` stops the worker promptly even when it is blocked on a full
+      queue, and joins it; also invoked by ``__exit__`` and iterator exhaustion.
+    """
+
+    def __init__(
+        self,
+        source: Iterable | Callable[[], Iterator],
+        depth: int = 2,
+        stats: Optional[PipelineStats] = None,
+        name: str = "dtx-host-prefetch",
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._source = source
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._stats = stats
+        self._finished = False
+        self._thread = threading.Thread(target=self._worker, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def _put(self, item) -> bool:
+        """Queue-put that stays responsive to close(); False = shutting down."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            import jax
+
+            src = self._source() if callable(self._source) else self._source
+            it = iter(src)
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                with jax.profiler.TraceAnnotation("dtx_host_prefetch_build"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                build_ms = (time.perf_counter() - t0) * 1e3
+                if self._stats is not None:
+                    self._stats.record("host_build_ms", build_ms)
+                    self._stats.record("queue_depth", self._q.qsize())
+                if not self._put((_ITEM, item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — propagated to consumer
+            self._put((_ERROR, e))
+            return
+        self._put((_DONE, None))
+
+    # ----------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                kind, payload = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                continue
+        if self._stats is not None:
+            self._stats.record("step_wait_ms", (time.perf_counter() - t0) * 1e3)
+        if kind == _ERROR:
+            self._finished = True
+            self._thread.join(timeout=5)
+            raise payload
+        if kind == _DONE:
+            self._finished = True
+            self._thread.join(timeout=5)
+            raise StopIteration
+        return payload
+
+    def close(self):
+        """Stop the worker and drop buffered batches. Idempotent. A worker
+        stuck inside ``next(source)`` (e.g. a blocking read) can't observe the
+        stop event; the short join timeout leaves it to die with the process
+        (daemon) rather than hanging shutdown."""
+        self._stop.set()
+        # drain so a worker blocked on put() can observe the stop event
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=1.0)
+        self._finished = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class DevicePrefetcher:
+    """Keeps up to ``depth`` device-placed batches in flight ahead of the
+    consumer.
+
+    ``place_fn`` is the host→device placement (typically
+    ``lambda b: place_batch(b, mesh, accum=...)`` — parallel/sharding.py).
+    ``device_put`` dispatches asynchronously on TPU, so placing batch N+1
+    here overlaps its transfer with step N's compute; the step loop then
+    receives ``PlacedBatch`` objects the Trainer consumes without a second
+    placement. depth=2 is double buffering; 3 tolerates jittery host builds.
+    """
+
+    def __init__(
+        self,
+        host_batches: Iterable,
+        place_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+        depth: int = 2,
+        stats: Optional[PipelineStats] = None,
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._it = iter(host_batches)
+        self._place = place_fn
+        self._buf: collections.deque = collections.deque()
+        self._depth = depth
+        self._stats = stats
+        self._exhausted = False
+
+    def _fill(self):
+        import jax
+
+        while not self._exhausted and len(self._buf) < self._depth:
+            try:
+                hb = next(self._it)
+            except StopIteration:
+                self._exhausted = True
+                return
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation("dtx_device_prefetch_put"):
+                placed = PlacedBatch(self._place(hb))
+            if self._stats is not None:
+                self._stats.record(
+                    "device_put_ms", (time.perf_counter() - t0) * 1e3)
+            self._buf.append(placed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        return self._buf.popleft()
+
+
+def prefetch_batches(
+    source: Iterable | Callable[[], Iterator],
+    place_fn: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None,
+    depth: int = 2,
+    stats: Optional[PipelineStats] = None,
+) -> Tuple[Iterator, HostPrefetcher]:
+    """Compose the full pipeline over one epoch's batches.
+
+    Returns ``(iterator, host_prefetcher)`` — iterate the first; close the
+    second when leaving the epoch early (break/exception) so the worker
+    thread never outlives the loop.
+    """
+    host = HostPrefetcher(source, depth=depth, stats=stats)
+    if place_fn is None:
+        return host, host
+    return DevicePrefetcher(host, place_fn, depth=depth, stats=stats), host
+
+
+class MetricsBuffer:
+    """Holds in-flight step metrics; resolves only completed ones.
+
+    ``push`` stores the device arrays (no sync). ``pop_ready`` resolves every
+    entry except the newest ``lag`` — by the next logging boundary those older
+    steps' results have long been computed, so ``float(v)`` returns without
+    draining dispatch — plus any newer entry whose arrays all report ready.
+    ``drain`` resolves everything (end of training).
+    """
+
+    def __init__(self, lag: int = 1):
+        self.lag = max(0, lag)
+        self._pending: collections.deque = collections.deque()
+
+    def __len__(self):
+        return len(self._pending)
+
+    def push(self, step: int, metrics: Dict[str, Any],
+             extra: Optional[Dict[str, float]] = None):
+        self._pending.append((step, metrics, extra or {}))
+
+    @staticmethod
+    def _ready(metrics: Dict[str, Any]) -> bool:
+        for v in metrics.values():
+            is_ready = getattr(v, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                return False
+        return True
+
+    @staticmethod
+    def _resolve(step, metrics, extra) -> Tuple[int, Dict[str, float]]:
+        host = {k: float(v) for k, v in metrics.items()}
+        host.update(extra)
+        return step, host
+
+    def pop_ready(self) -> List[Tuple[int, Dict[str, float]]]:
+        out = []
+        while len(self._pending) > self.lag:
+            out.append(self._resolve(*self._pending.popleft()))
+        while self._pending and self._ready(self._pending[0][1]):
+            out.append(self._resolve(*self._pending.popleft()))
+        return out
+
+    def drain(self) -> List[Tuple[int, Dict[str, float]]]:
+        out = [self._resolve(*entry) for entry in self._pending]
+        self._pending.clear()
+        return out

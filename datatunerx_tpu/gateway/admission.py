@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 
 class Overloaded(Exception):
@@ -27,14 +27,30 @@ class Overloaded(Exception):
         self.retry_after_s = retry_after_s
 
 
-def estimate_prompt_tokens(messages: List[dict]) -> int:
-    """Cheap prefill-cost estimate without a tokenizer: ~4 chars/token
-    (BPE English average) + a few tokens of template overhead per message.
-    Only relative magnitude matters — the budget is calibrated in the same
-    units."""
+def estimate_prompt_tokens(
+    messages: List[dict],
+    chars_per_token: float = 4.0,
+    count_tokens: Optional[Callable[[str], int]] = None,
+) -> int:
+    """Prefill-cost estimate for admission.
+
+    With ``count_tokens`` (a real tokenizer's text→token-count function,
+    wired when the gateway has the model's tokenizer) the estimate is exact
+    up to template overhead. Without one, ~``chars_per_token`` chars/token
+    (default 4, the BPE English average — configurable because CJK text runs
+    ~1.5 chars/token and code ~3, which under/over-admits by 2x+) + a few
+    tokens of template overhead per message. Only relative magnitude matters
+    — the budget is calibrated in the same units."""
     total = 0
     for m in messages or []:
-        total += len(str(m.get("content", ""))) // 4 + 4
+        content = str(m.get("content", ""))
+        if count_tokens is not None:
+            try:
+                total += int(count_tokens(content)) + 4
+                continue
+            except Exception:  # noqa: BLE001 — estimator must never shed 500s
+                pass
+        total += int(len(content) / max(chars_per_token, 0.1)) + 4
     return max(1, total)
 
 
@@ -61,11 +77,15 @@ class Ticket:
 
 class AdmissionController:
     def __init__(self, max_queue: int = 64, token_budget: int = 32768,
-                 min_retry_after_s: int = 1, max_retry_after_s: int = 30):
+                 min_retry_after_s: int = 1, max_retry_after_s: int = 30,
+                 chars_per_token: float = 4.0,
+                 count_tokens: Optional[Callable[[str], int]] = None):
         self.max_queue = max_queue
         self.token_budget = token_budget
         self.min_retry_after_s = min_retry_after_s
         self.max_retry_after_s = max_retry_after_s
+        self.chars_per_token = chars_per_token
+        self.count_tokens = count_tokens
         self._depth = 0
         self._tokens = 0
         self._shed = 0
@@ -75,9 +95,14 @@ class AdmissionController:
         self._last_release = time.monotonic()
 
     # ------------------------------------------------------------ admission
+    def estimate(self, messages: List[dict]) -> int:
+        return estimate_prompt_tokens(messages,
+                                      chars_per_token=self.chars_per_token,
+                                      count_tokens=self.count_tokens)
+
     def try_admit(self, messages: List[dict],
                   tokens: Optional[int] = None) -> Ticket:
-        n = tokens if tokens is not None else estimate_prompt_tokens(messages)
+        n = tokens if tokens is not None else self.estimate(messages)
         with self._lock:
             if self._depth + 1 > self.max_queue:
                 self._shed += 1

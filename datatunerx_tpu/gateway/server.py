@@ -607,6 +607,12 @@ def main(argv=None):
     p.add_argument("--max_queue", type=int, default=64)
     p.add_argument("--token_budget", type=int, default=32768,
                    help="estimated queued prefill tokens before shedding")
+    p.add_argument("--chars_per_token", type=float, default=4.0,
+                   help="admission prefill estimate when no tokenizer is "
+                        "available (~4 for English BPE; lower for CJK)")
+    p.add_argument("--tokenizer_path", default="",
+                   help="model dir or preset:NAME for token-accurate "
+                        "admission estimates (defaults to --model_path)")
     p.add_argument("--health_interval", type=float, default=2.0)
     p.add_argument("--replica_url", action="append", default=[],
                    help="front an EXISTING serving server (repeatable); "
@@ -633,10 +639,26 @@ def main(argv=None):
     if args.replicas > 0 and not args.model_path:
         p.error("--replicas spawning requires --model_path")
 
+    # token-accurate admission (ROADMAP): count prefill tokens with the real
+    # tokenizer when one is loadable; otherwise the chars/token heuristic
+    count_tokens = None
+    tok_src = args.tokenizer_path or args.model_path
+    if tok_src:
+        from datatunerx_tpu.utils.model_loader import load_tokenizer
+
+        tok = load_tokenizer(tok_src)
+        if tok is not None:
+            count_tokens = lambda text: len(tok.encode(text))  # noqa: E731
+            print(f"[gateway] admission using tokenizer from {tok_src}",
+                  flush=True)
+
     pool = ReplicaPool(health_interval_s=args.health_interval)
     gw = Gateway(pool, policy=args.policy,
-                 admission=AdmissionController(max_queue=args.max_queue,
-                                               token_budget=args.token_budget),
+                 admission=AdmissionController(
+                     max_queue=args.max_queue,
+                     token_budget=args.token_budget,
+                     chars_per_token=args.chars_per_token,
+                     count_tokens=count_tokens),
                  model_name=args.model_path)
     for i, url in enumerate(args.replica_url):
         pool.add(HTTPReplica(f"replica-{i}", url))

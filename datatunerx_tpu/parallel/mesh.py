@@ -82,9 +82,14 @@ def make_mesh(
         raise ValueError(f"expected 4-axis shape {MESH_AXES}, got {shape}")
     # Auto axis types = classic GSPMD: the compiler propagates shardings from
     # NamedSharding annotations (jax>=0.9 defaults to Explicit mode otherwise).
-    auto = (jax.sharding.AxisType.Auto,) * 4
+    # jax < 0.5 has no AxisType — every axis is implicitly Auto there, so the
+    # kwarg is simply omitted and the same programs compile unchanged.
+    if hasattr(jax.sharding, "AxisType"):
+        axis_kw = {"axis_types": (jax.sharding.AxisType.Auto,) * 4}
+    else:
+        axis_kw = {}
     if dcn_dp <= 1:
-        return jax.make_mesh(shape, MESH_AXES, devices=devices, axis_types=auto)
+        return jax.make_mesh(shape, MESH_AXES, devices=devices, **axis_kw)
 
     if shape[0] % dcn_dp != 0:
         raise ValueError(
@@ -105,4 +110,4 @@ def make_mesh(
         # flat device list already IS slice-major order, so a plain reshape
         # emulates slices — the same program shape compiles and runs
         arr = np.array(devices).reshape(shape)
-    return Mesh(arr, MESH_AXES, axis_types=auto)
+    return Mesh(arr, MESH_AXES, **axis_kw)

@@ -16,7 +16,7 @@ base params, LoRA adapters, gradients, and optimizer-state mirrors.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -107,3 +107,41 @@ def batch_shardings(batch, mesh: Mesh, accum: bool = False) -> Any:
     return jax.tree_util.tree_map(
         lambda x: NamedSharding(mesh, batch_pspec(x.ndim, accum=accum)), batch
     )
+
+
+def compat_shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across jax versions: the top-level API (with
+    ``check_vma``) exists from jax 0.6; older jax ships it as
+    ``jax.experimental.shard_map.shard_map`` with the ``check_rep`` spelling
+    of the same knob."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+
+
+def place_batch(batch: dict, mesh: Optional[Mesh], accum: bool = False) -> dict:
+    """Place one host-local batch dict onto the mesh.
+
+    Single source of truth for batch placement — the Trainer's inline path and
+    the DevicePrefetcher (data/prefetch.py) both call this, so pipelined and
+    synchronous feeding are byte-identical. Batches handed in are HOST-LOCAL
+    slices: single-process (host slice == global batch) uses a plain
+    device_put; multi-host assembles the global array from per-process slices —
+    device_put there would misread the local slice as the global array (half
+    the data silently dropped)."""
+    flat = {k: v for k, v in batch.items() if v is not None}
+    if mesh is None:
+        return flat
+    sh = batch_shardings(flat, mesh, accum=accum)
+    if jax.process_count() > 1:
+        import numpy as np
+
+        return {
+            k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))
+            for k, v in flat.items()
+        }
+    return {k: jax.device_put(v, sh[k]) for k, v in flat.items()}

@@ -18,13 +18,13 @@ result is identical to one big batch regardless of padding imbalance.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import struct
 
 from datatunerx_tpu.models.config import ModelConfig
@@ -34,7 +34,8 @@ from datatunerx_tpu.models.lora import (
     init_lora_params,
     lora_scaling,
 )
-from datatunerx_tpu.parallel.sharding import batch_shardings, shard_tree
+from datatunerx_tpu.data.prefetch import PlacedBatch
+from datatunerx_tpu.parallel.sharding import place_batch, shard_tree
 from datatunerx_tpu.training.loss import IGNORE_INDEX, causal_lm_loss
 from datatunerx_tpu.training.optimizer import make_optimizer, make_schedule
 
@@ -165,8 +166,27 @@ class Trainer:
                 {"train": self.optimizer, "frozen": optax.set_to_zero()}, labels
             )
         self.scaling = lora_scaling(train_cfg.lora_alpha, train_cfg.lora_rank)
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
-        self._eval_step = jax.jit(self._eval_step_impl)
+        # Process-wide step-program memo: two Trainers built from equal
+        # (model_cfg, train_cfg, mesh) produce identical programs, so they
+        # share one jitted callable — and with it jax's in-memory executable
+        # cache. Spinning up N trainers in one process (scoring controller
+        # sweeps, the test suite's dozens of e2e runs) compiles each distinct
+        # step program once instead of once per Trainer. This matters doubly
+        # on jax 0.4.x, where the persistent compilation cache is unusable
+        # (XLA:CPU executable serialization corrupts the heap — see
+        # tests/conftest.py).
+        key = _step_memo_key(model_cfg, train_cfg, mesh, type(self))
+        cached = None if key is None else _STEP_MEMO.get(key)
+        if cached is None:
+            self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
+            self._eval_step = jax.jit(self._eval_step_impl)
+            if key is not None:
+                _STEP_MEMO[key] = (self._train_step, self._eval_step)
+                while len(_STEP_MEMO) > _STEP_MEMO_MAX:
+                    _STEP_MEMO.popitem(last=False)
+        else:
+            _STEP_MEMO.move_to_end(key)
+            self._train_step, self._eval_step = cached
 
     # ---------------------------------------------------------------- state
     def init_state(self, params, rng: jax.Array) -> TrainState:
@@ -418,6 +438,8 @@ class Trainer:
 
     # ------------------------------------------------------------- public API
     def train_step(self, state: TrainState, batch):
+        """Accepts host batches (placed inline) or ``PlacedBatch`` objects a
+        DevicePrefetcher already put on the mesh (data/prefetch.py)."""
         batch = self._put_batch(batch, accum=self.cfg.grad_accum > 1)
         return self._train_step(state, batch)
 
@@ -426,22 +448,11 @@ class Trainer:
         return self._eval_step(state, batch)
 
     def _put_batch(self, batch, accum: bool = False):
-        """Batches handed to the Trainer are HOST-LOCAL slices. Single-process
-        (host slice == global batch): plain device_put. Multi-host: assemble
-        the global array from per-process slices — device_put would misread
-        the local slice as the global array (half the data silently dropped)."""
-        if self.mesh is not None:
-            flat = {k: v for k, v in batch.items() if v is not None}
-            sh = batch_shardings(flat, self.mesh, accum=accum)
-            if jax.process_count() > 1:
-                return {
-                    k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))
-                    for k, v in flat.items()
-                }
-            return {
-                k: jax.device_put(v, sh[k]) for k, v in flat.items()
-            }
-        return {k: v for k, v in batch.items() if v is not None}
+        if isinstance(batch, PlacedBatch):
+            # already on the mesh via the pipelined path — placing again would
+            # misread device arrays as process-local slices on multi-host
+            return dict(batch)
+        return place_batch(batch, self.mesh, accum=accum)
 
     def evaluate(self, state: TrainState, batches) -> dict:
         """Aggregate eval: mean loss + perplexity = exp(loss) (reference
@@ -455,6 +466,33 @@ class Trainer:
         import math
 
         return {"eval_loss": loss, "perplexity": math.exp(min(loss, 80.0)), "eval_tokens": tot_n}
+
+
+# Bounded LRU: each entry pins a Trainer closure + its compiled executables,
+# so an unbounded dict would leak across a long-lived controller sweeping
+# many distinct configs (each trial would add, never release). 16 covers any
+# realistic set of concurrently-live configs; evicted entries free their
+# executables once the owning Trainers are gone.
+_STEP_MEMO: collections.OrderedDict = collections.OrderedDict()
+_STEP_MEMO_MAX = 16
+
+
+def _step_memo_key(model_cfg, train_cfg, mesh, cls):
+    """Hashable identity of the compiled step program, or None when identity
+    can't be established (unhashable/exotic field values → compile fresh).
+    dataclass reprs cover every field deterministically; the mesh enters by
+    axis layout + device ids (devices are process singletons in jax); the
+    concrete Trainer class guards subclasses that override step impls."""
+    try:
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = (
+                tuple(mesh.shape.items()),
+                tuple(d.id for d in mesh.devices.flat),
+            )
+        return (cls.__qualname__, repr(model_cfg), repr(train_cfg), mesh_key)
+    except Exception:  # noqa: BLE001 — memoization is best-effort
+        return None
 
 
 def optax_global_norm(tree) -> jnp.ndarray:

@@ -99,6 +99,9 @@ class TrainArgs:
     generate_examples: int = 32
     generate_eval_steps: int = 0  # 0 = end-of-run only; N = also every N steps
     # TPU additions
+    prefetch_depth: int = 2  # batches in flight in the pipelined input path
+    # (host prefetch + double-buffered device placement, data/prefetch.py);
+    # 0 = synchronous feeding (the pre-pipeline loop). PPO always synchronous.
     profile_steps: int = 0  # capture a jax.profiler trace for N steps
     mesh: Optional[str] = None  # e.g. "dp=4,fsdp=2,tp=1,sp=1"
     attention: str = "xla"  # xla | flash | ring
@@ -128,6 +131,9 @@ class TrainArgs:
                 raise ValueError(
                     "--streaming and --pack_sequences are exclusive (packing "
                     "needs the whole dataset to fill blocks densely)")
+        if self.prefetch_depth < 0:
+            raise ValueError("--prefetch_depth must be >= 0 (0 disables the "
+                             "pipelined input path)")
         if self.finetuning_type not in ("lora", "freeze", "full", "none"):
             raise ValueError(f"invalid --finetuning_type {self.finetuning_type}")
         if self.quantization not in (None, "int4", "int8"):

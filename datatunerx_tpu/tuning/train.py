@@ -24,9 +24,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from datatunerx_tpu.data import BatchIterator, CsvDataset, get_template
+from datatunerx_tpu.data.prefetch import (
+    HostPrefetcher,
+    MetricsBuffer,
+    PipelineStats,
+    prefetch_batches,
+)
 from datatunerx_tpu.data.preprocess import preprocess_preference_records
 from datatunerx_tpu.parallel.distributed import maybe_initialize_distributed
 from datatunerx_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from datatunerx_tpu.parallel.sharding import place_batch
 from datatunerx_tpu.training import TrainConfig, Trainer
 from datatunerx_tpu.training.checkpoint import (
     CheckpointManager,
@@ -307,6 +314,37 @@ def run(args: TrainArgs) -> dict:
     profiling = {"active": False, "done": args.profile_steps <= 0}
 
     step_fn = trainer.step if args.stage == "ppo" else trainer.train_step
+    # pipelined input path (data/prefetch.py): host batch build in a
+    # background thread, batch N+1 placed on the mesh while step N executes.
+    # PPO keeps its synchronous path — its step interleaves rollout
+    # generation with optimization and places prompt batches itself.
+    # Streaming + in-training generative eval also stays synchronous: the
+    # stream tokenizes inside the prefetch worker while the eval encodes on
+    # the main thread, and HF fast tokenizers are not thread-safe
+    # ("Already borrowed" RuntimeError would kill the run mid-epoch).
+    # Non-streaming pipelines never tokenize in the worker (examples are
+    # pre-encoded; the worker only pads/packs), so they keep the overlap.
+    gen_eval_in_training = (args.predict_with_generate
+                            and args.generate_eval_steps > 0)
+    pipelined = (args.prefetch_depth > 0 and args.stage != "ppo"
+                 and not (args.streaming and gen_eval_in_training))
+    if (args.prefetch_depth > 0 and args.streaming and gen_eval_in_training
+            and is_main):
+        print("[pipeline] disabled: --streaming with in-training generative "
+              "eval shares one tokenizer across threads", flush=True)
+    pipe_stats = PipelineStats() if pipelined else None
+    accum_batches = grad_accum > 1
+    # non-blocking logging: step outputs buffer on device and resolve one
+    # logging interval behind (or as soon as they report ready), so a logging
+    # boundary never drains the dispatch pipeline
+    mbuf = MetricsBuffer(lag=1)
+
+    def _log_resolved(resolved):
+        nonlocal final_metrics
+        for s_done, host in resolved:
+            logger.log_train(s_done, host)
+            final_metrics = host
+
     step = 0  # counts up through start_step (skipping those batches) on resume
     final_metrics: dict = {}
     if args.streaming:
@@ -316,57 +354,91 @@ def run(args: TrainArgs) -> dict:
     else:
         epochs = range(int(math.ceil(total_steps / steps_per_epoch)))
     done = False
-    for epoch in epochs:
+    try:
+      for epoch in epochs:
         if done:
             break
         saw_batch = False
-        for batch in it.epoch(epoch):
-            saw_batch = True
-            if step >= total_steps:
-                done = True
+        src = it.epoch(epoch)
+        # resumed: fast-forward the data stream on HOST batches, before the
+        # pipeline spins up, so skipped batches are never placed on device
+        # (and never past total_steps — an already-complete run must exit in
+        # O(1), not re-tokenize every skipped batch)
+        exhausted = False
+        while step < start_step and step < total_steps:
+            try:
+                next(src)
+            except StopIteration:
+                exhausted = True
                 break
-            if step < start_step:  # resumed: fast-forward the data stream
-                step += 1
-                continue
-            if not profiling["done"] and not profiling["active"] and step >= start_step + 1:
-                jax.profiler.start_trace(trace_dir)
-                profiling["active"] = True
-                profiling["until"] = step + args.profile_steps
-            state, metrics = step_fn(state, batch)
+            saw_batch = True
             step += 1
-            if profiling["active"] and step >= profiling["until"]:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                profiling.update(active=False, done=True)
-                if is_main:
-                    print(f"[profile] trace captured to {trace_dir}", flush=True)
-            if is_main and (step % args.logging_steps == 0 or step == total_steps):
-                host = {k: float(v) for k, v in metrics.items()}
-                host["epoch"] = round(step / steps_per_epoch, 3)
-                logger.log_train(step, host)
-                final_metrics = host
-            if args.save_steps > 0:
-                if ckpt.maybe_save(state, step) and args.stage == "ppo" \
-                        and is_main:
-                    from datatunerx_tpu.training.ppo import (
-                        save_controller_state,
-                    )
+        if step >= total_steps:
+            done = True
+            break
+        host_pf: HostPrefetcher | None = None
+        if exhausted:
+            batches = iter(())
+        elif pipelined:
+            batches, host_pf = prefetch_batches(
+                src,
+                place_fn=lambda b: place_batch(b, mesh, accum=accum_batches),
+                depth=args.prefetch_depth,
+                stats=pipe_stats,
+            )
+        else:
+            batches = src
+        try:
+            for batch in batches:
+                saw_batch = True
+                if step >= total_steps:
+                    done = True
+                    break
+                if not profiling["done"] and not profiling["active"] and step >= start_step + 1:
+                    jax.profiler.start_trace(trace_dir)
+                    profiling["active"] = True
+                    profiling["until"] = step + args.profile_steps
+                state, metrics = step_fn(state, batch)
+                step += 1
+                if profiling["active"] and step >= profiling["until"]:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling.update(active=False, done=True)
+                    if is_main:
+                        print(f"[profile] trace captured to {trace_dir}", flush=True)
+                if is_main and (step % args.logging_steps == 0 or step == total_steps):
+                    extra = {"epoch": round(step / steps_per_epoch, 3)}
+                    if pipe_stats is not None:
+                        extra.update(pipe_stats.snapshot())
+                    mbuf.push(step, metrics, extra)
+                    _log_resolved(mbuf.pop_ready())
+                if args.save_steps > 0:
+                    if ckpt.maybe_save(state, step) and args.stage == "ppo" \
+                            and is_main:
+                        from datatunerx_tpu.training.ppo import (
+                            save_controller_state,
+                        )
 
-                    save_controller_state(ckpt_dir, step, trainer.kl_coef)
-            if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
-                _run_eval(trainer, state, eval_examples, args, pad_id, logger,
-                          step, is_main, dist)
-            if (args.predict_with_generate and eval_records
-                    and args.generate_eval_steps > 0
-                    and step % args.generate_eval_steps == 0
-                    and step < total_steps  # final step gets the full pass below
-                    and dist["num_processes"] == 1 and is_main):
-                # in-training generative eval: a small sample at step
-                # intervals so rouge/bleu CURVES exist, not just a final
-                # point (reference only evaluates at the end)
-                _generative_eval_step(trainer, state, cfg, tokenizer, template,
-                                      eval_records, args, logger, step,
-                                      tcfg.finetuning_type)
+                        save_controller_state(ckpt_dir, step, trainer.kl_coef)
+                if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
+                    _run_eval(trainer, state, eval_examples, args, pad_id, logger,
+                              step, is_main, dist)
+                if (args.predict_with_generate and eval_records
+                        and args.generate_eval_steps > 0
+                        and step % args.generate_eval_steps == 0
+                        and step < total_steps  # final step gets the full pass below
+                        and dist["num_processes"] == 1 and is_main):
+                    # in-training generative eval: a small sample at step
+                    # intervals so rouge/bleu CURVES exist, not just a final
+                    # point (reference only evaluates at the end)
+                    _generative_eval_step(trainer, state, cfg, tokenizer, template,
+                                          eval_records, args, logger, step,
+                                          tcfg.finetuning_type)
+        finally:
+            if host_pf is not None:
+                # stops the worker thread even when the loop exits early
+                # (done, max_steps, an exception) mid-epoch
+                host_pf.close()
         if (eval_examples and args.eval_steps == 0 and not done
                 and step < total_steps):
             # eval_steps=0 → once per epoch (final epoch's eval happens below)
@@ -377,6 +449,10 @@ def run(args: TrainArgs) -> dict:
                 raise RuntimeError("Empty dataset!")
             break
 
+    finally:
+        # also on a crash/interrupt mid-run: resolve buffered records rather
+        # than dropping up to a logging interval of already-computed metrics
+        _log_resolved(mbuf.drain())
     if profiling["active"]:  # window extended past the last step
         jax.profiler.stop_trace()
         profiling.update(active=False, done=True)

@@ -79,6 +79,24 @@ def load_model_and_tokenizer(
     return cfg, params, tok
 
 
+def load_tokenizer(path_or_preset: str):
+    """Tokenizer WITHOUT the weights — for components that only need token
+    counts (e.g. the gateway's admission estimator). Never initializes params;
+    returns None when no tokenizer can be found (callers fall back to a
+    chars/token heuristic)."""
+    if path_or_preset.startswith("preset:"):
+        return SimpleTokenizer()
+    if not os.path.isdir(path_or_preset):
+        return None
+    tok = _load_hf_tokenizer(path_or_preset)
+    if tok is None and os.path.exists(
+            os.path.join(path_or_preset, "model.npz")):
+        # in-repo export format ships without a tokenizer dir: the byte-level
+        # SimpleTokenizer is what serving pairs with it
+        return SimpleTokenizer()
+    return tok
+
+
 def _load_hf_tokenizer(path: str):
     try:
         from transformers import AutoTokenizer

@@ -40,6 +40,26 @@ def _host_fingerprint() -> str:
         return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
 
 
+def _jax_version() -> tuple:
+    from importlib.metadata import version
+
+    try:
+        return tuple(int(x) for x in version("jax").split(".")[:2])
+    except Exception:  # noqa: BLE001 — unknown version: assume modern
+        return (99, 0)
+
+
+# jax 0.4.x XLA:CPU cache use INSIDE the suite's own process corrupts the
+# heap (observed deterministically on 0.4.37: in-process cache hits on the
+# e2e train-step program die with "corrupted double-linked list"/SIGSEGV —
+# reproduced with a two-run() script, warm or warming cache, orbax in the
+# mix). SPAWNED subprocesses are unaffected — every prior round ran the
+# subprocess-heavy tests with the inherited cache env and a warming dir.
+# So: the env vars are always exported (trainer/serving subprocesses inherit
+# them and share compiles across spawns), but the PYTEST process itself only
+# enables the cache on jax >= 0.5; on 0.4.x it is explicitly forced off
+# in-process below. (In-process compile reuse comes from the Trainer
+# step-program memo instead — training/train_lib.py.)
 _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_compilation_cache",
     _host_fingerprint())
@@ -79,15 +99,19 @@ except AttributeError:
         + " --xla_force_host_platform_device_count=8"
     ).strip()
 # the env vars above bind spawned subprocesses (fresh interpreters read them
-# at import); for THIS process jax was already imported by sitecustomize, so
-# the config must be set explicitly — from the env values, so a user's own
-# JAX_COMPILATION_CACHE_DIR override keeps process and subprocesses aligned
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                  float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes",
-                  int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
+# at import); for THIS process the config is set explicitly — enabled from
+# the env values on jax >= 0.5, forced OFF on 0.4.x (see the heap-corruption
+# note above; the env may have been read at import, so the off state must be
+# asserted, not assumed).
+if _jax_version() >= (0, 5):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
+else:
+    jax.config.update("jax_compilation_cache_dir", None)
 
 import pytest  # noqa: E402
 

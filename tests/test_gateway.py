@@ -225,6 +225,43 @@ def test_estimate_tokens_scales_with_content():
     assert big > small * 10
 
 
+def test_estimate_tokens_chars_per_token_configurable():
+    msgs = [{"role": "user", "content": "x" * 400}]
+    default = estimate_prompt_tokens(msgs)  # 400/4 + 4
+    dense = estimate_prompt_tokens(msgs, chars_per_token=2.0)  # 400/2 + 4
+    assert default == 104
+    assert dense == 204
+
+
+def test_estimate_tokens_prefers_real_tokenizer():
+    msgs = [{"role": "user", "content": "hello world"}]
+    exact = estimate_prompt_tokens(msgs, count_tokens=lambda t: 7)
+    assert exact == 7 + 4
+    # a tokenizer that blows up must not shed the request: heuristic fallback
+    def broken(text):
+        raise RuntimeError("tokenizer died")
+
+    fallback = estimate_prompt_tokens(msgs, count_tokens=broken)
+    assert fallback == estimate_prompt_tokens(msgs)
+
+
+def test_admission_controller_uses_configured_estimator():
+    counted = []
+
+    def count(text):
+        counted.append(text)
+        return 30
+
+    adm = AdmissionController(max_queue=10, token_budget=40,
+                              count_tokens=count)
+    t1 = adm.try_admit([{"role": "user", "content": "abc"}])
+    assert t1.tokens == 34  # 30 counted + template overhead
+    with pytest.raises(Overloaded):  # 34 + 34 > 40
+        adm.try_admit([{"role": "user", "content": "def"}])
+    assert counted == ["abc", "def"]
+    t1.release()
+
+
 # --------------------------------------------------------------- autoscale
 def test_autoscale_hint_scales_up_on_backlog_and_down_when_idle():
     up = autoscale_hint(replicas=2, available_replicas=2, queue_depth=20,

@@ -228,3 +228,27 @@ def test_full_param_step_preserves_param_dtype():
     before = jax.tree_util.tree_map(lambda x: x.dtype, state.params)
     after = jax.tree_util.tree_map(lambda x: x.dtype, state2.params)
     assert before == after, "param dtypes drifted after one step"
+
+
+def test_step_program_memo_shares_compiled_steps():
+    """Equal (model_cfg, train_cfg, mesh) trainers share one jitted step —
+    N trainers in a process compile each distinct program once (and on
+    jax 0.4.x, where the persistent compilation cache is unusable, this is
+    the only cross-trainer compile reuse there is)."""
+    a = _make_trainer()
+    b = _make_trainer()
+    assert a._train_step is b._train_step
+    assert a._eval_step is b._eval_step
+    c = _make_trainer(lora_rank=8)  # different program: no sharing
+    assert c._train_step is not a._train_step
+    # and the shared program still trains: results equal across instances
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    import jax.numpy as _jnp
+    sa = a.init_state(jax.tree_util.tree_map(_jnp.copy, params),
+                      jax.random.PRNGKey(3))
+    sb = b.init_state(jax.tree_util.tree_map(_jnp.copy, params),
+                      jax.random.PRNGKey(3))
+    batch = _batch(np.random.default_rng(1))
+    _, ma = a.train_step(sa, batch)
+    _, mb = b.train_step(sb, batch)
+    assert float(ma["loss"]) == float(mb["loss"])

@@ -43,6 +43,9 @@ def client(apiserver):
 
 
 def _cm(client, tmp_path, sub, **kw):
+    # TLS cert generation needs the optional `cryptography` dep (dev extra);
+    # skip — not error — where it's absent
+    pytest.importorskip("cryptography")
     return SecretBackedCertManager(
         client, namespace=NS, secret_name=SECRET,
         cert_dir=str(tmp_path / sub),
@@ -255,6 +258,7 @@ def test_install_renders_ha_deployment(tmp_path):
 def test_install_ha_bundle_applies_and_managers_share_ca(client, tmp_path):
     """Apply the HA bundle to the fake apiserver, then boot two
     Secret-backed cert managers the way two replicas would: one CA."""
+    pytest.importorskip("cryptography")
     from datatunerx_tpu.operator.install import install
 
     lines = install(client, namespace="dtx-ha", replicas=2)

@@ -36,6 +36,9 @@ def apiserver():
 
 @pytest.fixture(scope="module")
 def webhook(tmp_path_factory):
+    # TLS cert generation needs the optional `cryptography` dep (dev extra);
+    # skip — not error — where it's absent
+    pytest.importorskip("cryptography")
     certs = CertManager(str(tmp_path_factory.mktemp("wh-certs")),
                         dns_names=["localhost", "127.0.0.1"])
     srv = AdmissionWebhookServer(certs, host="127.0.0.1", port=0).start()
@@ -62,6 +65,7 @@ def _hp(name, params):
 # ------------------------------------------------------------ cert manager
 
 def test_cert_manager_generates_and_reports_rotation(tmp_path):
+    pytest.importorskip("cryptography")
     cm = CertManager(str(tmp_path / "certs"))
     assert cm.needs_rotation()  # nothing on disk yet
     assert cm.ensure() is True
@@ -193,6 +197,7 @@ def test_invalid_dataset_rejected_via_webhook(apiserver, webhook):
 def test_cert_rotation_repatches_cabundle(apiserver, tmp_path):
     """Rotation regenerates the CA, reloads TLS in place, and the re-patched
     caBundle keeps admission working — the cert-rotator loop end-to-end."""
+    pytest.importorskip("cryptography")
     certs = CertManager(str(tmp_path / "rot"), validity_days=365,
                         dns_names=["localhost", "127.0.0.1"])
     srv = AdmissionWebhookServer(certs, host="127.0.0.1", port=0).start()
@@ -240,6 +245,7 @@ def test_serving_cert_sans_cover_service_dns(tmp_path):
     <service>.<ns>.svc and the apiserver verifies the serving cert against
     that DNS name — the cert must carry the Service SANs, not just
     localhost."""
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.x509.oid import ExtensionOID
 
@@ -286,6 +292,7 @@ def test_cert_rotates_on_san_drift(tmp_path):
     """A persisted cert dir from an older deploy (localhost-only SANs) must
     regenerate when the configured dns_names grow — months of remaining
     validity notwithstanding — or service-style TLS keeps failing."""
+    pytest.importorskip("cryptography")
     d = str(tmp_path / "certs")
     old = CertManager(d, dns_names=["localhost", "127.0.0.1"])
     assert old.ensure() is True
