@@ -4,10 +4,13 @@
 IMG_OPERATOR ?= datatunerx-tpu/operator:latest
 IMG_TRAINER  ?= datatunerx-tpu/trainer:latest
 
-.PHONY: test test-fast native bench graft-check aot-certify docker-build deploy undeploy fmt
+.PHONY: test test-fast native bench graft-check aot-certify docker-build deploy undeploy fmt lint
 
 test:            ## full test suite (8-device virtual CPU mesh)
 	python -m pytest tests/ -q
+
+lint:            ## dtxlint: JAX-aware static analysis (the tier-1 CI gate)
+	python -m datatunerx_tpu.analysis datatunerx_tpu/
 
 test-fast:       ## skip the slow live-pipeline e2e
 	python -m pytest tests/ -q -m "not slow"

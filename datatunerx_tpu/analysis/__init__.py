@@ -1,0 +1,28 @@
+"""dtxlint — JAX-aware static analysis for the datatunerx-tpu codebase.
+
+Pattern-based AST linting tuned to this repo's real bug history (see
+CHANGELOG 0.6/0.7): host-sync calls in hot training/decode paths, jit
+retrace storms, tracer-unsafe control flow, PRNG key reuse, mesh-axis
+drift, lock discipline around gateway/prefetch threads, subprocess and
+thread leaks, and device work at module import.
+
+Entry points:
+
+  python -m datatunerx_tpu.analysis [paths...]
+  dtx lint [paths...]
+  dtxlint [paths...]
+
+Rules are self-contained visitor classes registered in
+``datatunerx_tpu.analysis.rules``; per-rule docs live on each class.
+Suppress a finding inline with ``# dtxlint: disable=DTX00N`` (comma
+list, or ``all``), and carry pre-existing debt in a baseline file
+(``--write-baseline``) so CI only blocks NEW findings.
+"""
+
+from datatunerx_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ModuleContext,
+    Rule,
+    lint_paths,
+    lint_source,
+)

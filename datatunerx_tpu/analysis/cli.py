@@ -1,0 +1,123 @@
+"""dtxlint command line: ``dtxlint``, ``dtx lint``, ``python -m
+datatunerx_tpu.analysis``.
+
+Exit codes: 0 = clean (or everything suppressed/baselined), 1 = new
+findings, 2 = usage error. ``--format json`` emits one machine-readable
+object for CI annotation tooling; ``--write-baseline`` records the
+current findings as accepted debt instead of failing on them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from datatunerx_tpu.analysis import baseline as baseline_mod
+from datatunerx_tpu.analysis.config import LintConfig, load_config
+from datatunerx_tpu.analysis.core import LintResult, lint_paths
+from datatunerx_tpu.analysis.rules import RULE_CLASSES, all_rules, rules_by_id
+
+_SEVERITY_RANK = {"warning": 0, "error": 1}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtxlint",
+        description="JAX-aware static analysis for datatunerx-tpu "
+                    "(host-sync, retrace, sharding, lock-discipline rules)")
+    p.add_argument("paths", nargs="*", default=["datatunerx_tpu"],
+                   help="files/directories to lint (default: datatunerx_tpu)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--select", default="",
+                   help="comma list of rule ids to run (default: all)")
+    p.add_argument("--baseline", default="",
+                   help="baseline file (default: [tool.dtxlint] baseline)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current findings as accepted debt and exit 0")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file (report everything)")
+    p.add_argument("--no-config", action="store_true",
+                   help="skip pyproject [tool.dtxlint] discovery")
+    p.add_argument("--fail-on", choices=["warning", "error"],
+                   default="warning",
+                   help="minimum severity that fails the run "
+                        "(default: warning — everything gates)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _list_rules() -> int:
+    for cls in RULE_CLASSES:
+        doc = (cls.__module__ and sys.modules[cls.__module__].__doc__) or ""
+        first = next((ln.strip() for ln in doc.splitlines() if cls.id in ln),
+                     "")
+        print(f"{cls.id}  {cls.name:28s} [{cls.severity}] {first}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+
+    if args.no_config:
+        config = LintConfig()
+    else:
+        config = load_config(start=args.paths[0] if args.paths else ".")
+    if args.select:
+        wanted = [r.strip() for r in args.select.split(",") if r.strip()]
+        known = {cls.id for cls in RULE_CLASSES}
+        unknown = sorted(set(wanted) - known)
+        if unknown:
+            # a typo must not turn the gate green by selecting zero rules
+            print(f"dtxlint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        rules = rules_by_id(wanted)
+    else:
+        rules = all_rules()
+
+    result: LintResult = lint_paths(args.paths, config=config, rules=rules)
+
+    baseline_path = args.baseline or config.resolve(config.baseline)
+    if args.write_baseline:
+        baseline_mod.save_baseline(baseline_path, result.findings)
+        print(f"dtxlint: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    carried = (baseline_mod.load_baseline(baseline_path)
+               if not args.no_baseline else baseline_mod.load_baseline(""))
+    new, baselined = baseline_mod.partition(result.findings, carried)
+    gate = [f for f in new
+            if _SEVERITY_RANK.get(f.severity, 1)
+            >= _SEVERITY_RANK[args.fail_on]]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(baselined),
+            "suppressed": result.suppressed,
+            "files": result.files,
+            "failed": bool(gate),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        summary = (f"dtxlint: {len(new)} finding(s) in {result.files} "
+                   f"file(s)")
+        extras = []
+        if result.suppressed:
+            extras.append(f"{result.suppressed} suppressed inline")
+        if baselined:
+            extras.append(f"{len(baselined)} baselined")
+        if extras:
+            summary += " (" + ", ".join(extras) + ")"
+        print(summary)
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

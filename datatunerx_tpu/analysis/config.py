@@ -1,0 +1,192 @@
+"""dtxlint configuration: defaults + the ``[tool.dtxlint]`` pyproject table.
+
+The container's Python 3.10 has neither ``tomllib`` (3.11+) nor ``tomli``,
+so when both imports fail a tiny TOML-subset reader handles the one table
+we own: ``key = <python-ish literal>`` pairs (strings, ints, booleans, and
+possibly-multiline lists of strings) under the ``[tool.dtxlint]`` header.
+That subset is what this repo's pyproject actually contains; full TOML
+files still parse correctly wherever a real parser is importable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Optional, Sequence, Tuple
+
+_SECTION = "tool.dtxlint"
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Knobs the rules and runner consult. Field names match the pyproject
+    keys with dashes normalized to underscores."""
+
+    # baseline file path (relative to the config file's directory)
+    baseline: str = "dtxlint-baseline.json"
+    # directory/file basename fragments to skip while collecting sources
+    exclude: Tuple[str, ...] = ("__pycache__", ".git", "build", "dist")
+    # bare-name fnmatch patterns marking hot-path roots for DTX001
+    hot_functions: Tuple[str, ...] = (
+        "train_step", "eval_step", "decode_step", "generate_step",
+    )
+    # declared mesh axis names for DTX005; empty + mesh_module set → the
+    # axes are extracted from *_AXES tuple assignments in that module
+    mesh_axes: Tuple[str, ...] = ()
+    mesh_module: str = ""
+    # rule ids disabled globally (inline suppressions handle point FPs)
+    disable: Tuple[str, ...] = ()
+    # directory the config file was found in ("" = cwd); baseline and
+    # mesh_module resolve against it
+    root: str = ""
+
+    def resolve(self, path: str) -> str:
+        if not path or os.path.isabs(path) or not self.root:
+            return path
+        return os.path.join(self.root, path)
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Extract ``[tool.dtxlint]`` key/value pairs without a TOML parser.
+
+    Values are read with ast.literal_eval after mapping TOML's bare
+    true/false; anything fancier (dates, inline tables, dotted keys) is
+    skipped rather than mis-read.
+    """
+    lines = text.splitlines()
+    out: dict = {}
+    in_section = False
+    buf_key: Optional[str] = None
+    buf_val: list = []
+
+    def flush():
+        nonlocal buf_key, buf_val
+        if buf_key is None:
+            return
+        raw = "\n".join(buf_val).strip()
+        raw = re.sub(r"\btrue\b", "True", raw)
+        raw = re.sub(r"\bfalse\b", "False", raw)
+        try:
+            out[buf_key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            pass
+        buf_key, buf_val = None, []
+
+    for line in lines:
+        stripped = line.strip()
+        header = re.match(r"^\[(.+?)\]\s*$", stripped)
+        if header and buf_key is None:
+            in_section = header.group(1).strip() == _SECTION
+            continue
+        if not in_section:
+            continue
+        if buf_key is not None:
+            buf_val.append(line.split("#", 1)[0] if '"' not in line else line)
+            joined = "\n".join(buf_val)
+            if joined.count("[") == joined.count("]"):
+                flush()
+            continue
+        m = re.match(r"^([A-Za-z0-9_-]+)\s*=\s*(.*)$", stripped)
+        if not m:
+            continue
+        key, val = m.group(1), m.group(2)
+        if val.count("[") != val.count("]"):
+            buf_key, buf_val = key, [val]
+            continue
+        buf_key, buf_val = key, [val]
+        flush()
+    flush()
+    return out
+
+
+def _read_table(path: str) -> dict:
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        import tomllib  # Python ≥ 3.11
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            tomllib = None
+    if tomllib is not None:
+        table = tomllib.loads(raw.decode("utf-8"))
+        for part in _SECTION.split("."):
+            table = table.get(part, {})
+        return table if isinstance(table, dict) else {}
+    return _parse_toml_subset(raw.decode("utf-8"))
+
+
+def find_pyproject(start: str) -> Optional[str]:
+    """Walk up from ``start`` (file or directory) to the nearest
+    pyproject.toml."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        cand = os.path.join(cur, "pyproject.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def load_config(start: str = ".",
+                pyproject: Optional[str] = None) -> LintConfig:
+    """Build a LintConfig from the nearest pyproject's ``[tool.dtxlint]``
+    table (missing file or table → defaults)."""
+    path = pyproject or find_pyproject(start)
+    cfg = LintConfig()
+    if path is None or not os.path.isfile(path):
+        return cfg
+    table = _read_table(path)
+    fields = {f.name: f for f in dataclasses.fields(LintConfig)}
+    kwargs: dict = {"root": os.path.dirname(os.path.abspath(path))}
+    for key, value in table.items():
+        name = key.replace("-", "_")
+        if name not in fields or name == "root":
+            continue
+        if isinstance(value, list):
+            value = tuple(str(v) for v in value)
+        kwargs[name] = value
+    return dataclasses.replace(cfg, **kwargs)
+
+
+def mesh_axes_for(config: LintConfig) -> Tuple[str, ...]:
+    """Declared mesh axis names: the configured list, else every string in
+    ``*_AXES`` tuple/list assignments of the configured mesh module."""
+    if config.mesh_axes:
+        return tuple(config.mesh_axes)
+    path = config.resolve(config.mesh_module)
+    if not path or not os.path.isfile(path):
+        return ()
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except SyntaxError:
+        return ()
+    axes: list = []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(n.endswith("_AXES") or n == "AXES" for n in names):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    axes.append(elt.value)
+    return tuple(dict.fromkeys(axes))
+
+
+def rule_enabled(config: LintConfig, rule_id: str) -> bool:
+    return rule_id not in set(config.disable)
+
+
+__all__: Sequence[str] = (
+    "LintConfig", "find_pyproject", "load_config", "mesh_axes_for",
+    "rule_enabled",
+)

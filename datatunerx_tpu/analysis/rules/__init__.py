@@ -1,0 +1,33 @@
+"""dtxlint rule registry. Each rule is a self-contained visitor class;
+adding one = write the class, import it here, add a fixture pair to
+tests/test_dtxlint.py (see README "Static analysis")."""
+
+from typing import List, Sequence
+
+from datatunerx_tpu.analysis.core import Rule
+from datatunerx_tpu.analysis.rules.concurrency import LockDiscipline, ResourceLeak
+from datatunerx_tpu.analysis.rules.host_sync import HostSyncInHotPath
+from datatunerx_tpu.analysis.rules.prng import PRNGKeyReuse
+from datatunerx_tpu.analysis.rules.retrace import JitInLoop, ModuleImportDeviceWork
+from datatunerx_tpu.analysis.rules.sharding import MeshAxisDrift
+from datatunerx_tpu.analysis.rules.tracer import TracerControlFlow
+
+RULE_CLASSES = (
+    HostSyncInHotPath,    # DTX001
+    JitInLoop,            # DTX002
+    TracerControlFlow,    # DTX003
+    PRNGKeyReuse,         # DTX004
+    MeshAxisDrift,        # DTX005
+    LockDiscipline,       # DTX006
+    ResourceLeak,         # DTX007
+    ModuleImportDeviceWork,  # DTX008
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in RULE_CLASSES]
+
+
+def rules_by_id(ids: Sequence[str]) -> List[Rule]:
+    wanted = set(ids)
+    return [cls() for cls in RULE_CLASSES if cls.id in wanted]

@@ -1,0 +1,71 @@
+"""DTX001: host-synchronizing calls inside hot-path functions.
+
+The bug class PR 3 removed by hand: a ``float(loss)`` / ``.item()`` /
+``np.asarray(x)`` / ``jax.device_get`` / ``.block_until_ready()`` inside
+the step loop blocks the host on the device stream every step, draining
+the dispatch pipeline — silent, and worth double-digit % of step time.
+
+"Hot path" = any function whose bare name matches a configured
+``hot-functions`` pattern, plus everything reachable from one through the
+intra-module call graph (call, reference, and nesting edges).
+
+Not flagged: ``float()``/``int()`` of plain constants (unit conversion,
+argument parsing) — only conversions of computed values can sync.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from datatunerx_tpu.analysis.callgraph import walk_function
+from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
+
+# dotted names that force a device→host transfer / stream sync
+_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+# method names with the same effect regardless of receiver
+_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+
+
+class HostSyncInHotPath(Rule):
+    id = "DTX001"
+    name = "host-sync-in-hot-path"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        hot = ctx.graph.reachable(tuple(ctx.config.hot_functions))
+        for qualname in sorted(hot):
+            info = ctx.graph.functions[qualname]
+            for node in walk_function(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._sync_label(ctx, node)
+                if label:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{label} in hot path "
+                        f"({qualname} is reachable from a hot function); "
+                        "this blocks the host on the device stream every "
+                        "step — move it behind a logging boundary or use "
+                        "MetricsBuffer"))
+        return out
+
+    def _sync_label(self, ctx: ModuleContext, node: ast.Call) -> str:
+        func = node.func
+        # float(x)/int(x) of a computed value
+        if isinstance(func, ast.Name) and func.id in ("float", "int"):
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                return f"{func.id}() on a device value"
+            return ""
+        resolved = ctx.resolve(func)
+        if resolved in _SYNC_CALLS:
+            return f"{_SYNC_CALLS[resolved]}()"
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+            return f".{func.attr}()"
+        return ""

@@ -1,0 +1,157 @@
+"""DTX002: jit-in-loop / unstable static args. DTX008: device work at import.
+
+DTX002 — ``jax.jit`` evaluated inside a ``for``/``while`` body builds a
+fresh wrapper (empty compile cache) per iteration: a retrace/recompile
+storm that looks like "TPU slow" rather than an error. Also flagged:
+``static_argnums``/``static_argnames`` given a set/dict/comprehension —
+non-hashable or iteration-order-unstable values that either fail at trace
+time or silently change the cache key between runs.
+
+DTX008 — ``jnp.*`` / ``jax.random.*`` / ``jax.devices()`` / ``jax.
+device_put`` executed at module top level (module body, class body, or a
+function's DEFAULT ARGUMENT) runs device work at import: it initializes
+the backend before the program can pick platforms/meshes (breaks
+JAX_PLATFORMS selection and multi-process init) and allocates on
+whichever device import happened to land on. Hoist into a function or
+compute lazily. ``jax.jit(fn)`` at module level is fine — building a
+wrapper is host-only and idiomatic; dtype/constant attributes
+(``jnp.float32``, ``jnp.pi``) are data, not work.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+_UNSTABLE_STATIC = (ast.Dict, ast.Set, ast.DictComp, ast.SetComp,
+                    ast.ListComp, ast.GeneratorExp)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _LoopVisitor(ast.NodeVisitor):
+    """Tracks loop depth; function scopes reset it (their bodies run when
+    called, not where defined), but decorators and default args evaluate
+    in the enclosing scope and keep the current depth."""
+
+    def __init__(self, rule: "JitInLoop", ctx: ModuleContext):
+        self.rule = rule
+        self.ctx = ctx
+        self.out: List[Finding] = []
+        self.depth = 0
+
+    def _visit_loop(self, node):
+        for header in ("iter", "test"):  # evaluated once, outside the body
+            expr = getattr(node, header, None)
+            if expr is not None:
+                self.visit(expr)
+        self.depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self.depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _visit_loop
+
+    def _visit_scope(self, node):
+        for dec in getattr(node, "decorator_list", []):
+            self.visit(dec)
+        if isinstance(node, _FUNC_NODES):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if default is not None:
+                    self.visit(default)
+        saved, self.depth = self.depth, 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth = saved
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _visit_scope
+
+    def visit_Call(self, node: ast.Call):
+        resolved = self.ctx.resolve(node.func)
+        if resolved in _JIT_NAMES:
+            if self.depth > 0:
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f"{resolved}() evaluated inside a loop builds a fresh "
+                    "wrapper (and an empty compile cache) every iteration "
+                    "— hoist the jit out of the loop"))
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") \
+                        and isinstance(kw.value, _UNSTABLE_STATIC):
+                    self.out.append(self.rule.finding(
+                        self.ctx, kw.value,
+                        f"{kw.arg} given a "
+                        f"{type(kw.value).__name__.lower()} — use an int "
+                        "or tuple literal; non-hashable/unordered values "
+                        "break or destabilize the jit cache key"))
+        self.generic_visit(node)
+
+
+class JitInLoop(Rule):
+    id = "DTX002"
+    name = "jit-in-loop"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        visitor = _LoopVisitor(self, ctx)
+        visitor.visit(ctx.tree)
+        return visitor.out
+
+
+_IMPORT_WORK_PREFIXES = ("jax.numpy.", "jax.random.", "jax.nn.")
+_IMPORT_WORK_EXACT = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.device_put", "jax.process_index",
+}
+
+
+class ModuleImportDeviceWork(Rule):
+    id = "DTX008"
+    name = "module-import-device-work"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        self._scan_body(ctx, ctx.tree.body, out, where="module import")
+        return out
+
+    def _scan_body(self, ctx, body, out, where: str):
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_body(ctx, stmt.body, out,
+                                where="class body (import time)")
+                continue
+            if isinstance(stmt, _FUNC_NODES):
+                # default args evaluate at import; bodies do not
+                for default in stmt.args.defaults + stmt.args.kw_defaults:
+                    if default is not None:
+                        self._scan_expr(ctx, default, out,
+                                        where="function default argument")
+                for dec in stmt.decorator_list:
+                    self._scan_expr(ctx, dec, out, where="decorator")
+                continue
+            self._scan_expr(ctx, stmt, out, where=where)
+
+    def _scan_expr(self, ctx, root, out, where: str):
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            # lambda/def bodies run when called, not at import
+            if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved is None:
+                continue
+            if resolved in _IMPORT_WORK_EXACT or any(
+                    resolved.startswith(p) for p in _IMPORT_WORK_PREFIXES):
+                out.append(self.finding(
+                    ctx, node,
+                    f"{resolved}() runs at {where}: device work during "
+                    "import initializes the backend early and allocates "
+                    "before mesh/platform setup — hoist it into a "
+                    "function or compute it lazily"))

@@ -1,0 +1,79 @@
+"""DTX005: PartitionSpec axis names not declared by the mesh module.
+
+Every ``PartitionSpec``/``with_sharding_constraint`` axis string must be
+an axis the mesh actually declares (``parallel/mesh.py::MESH_AXES`` —
+dp/fsdp/tp/sp here). A typo'd or stale axis name ("data", "mdl", "x")
+doesn't fail loudly: depending on context it raises deep inside GSPMD or
+silently falls back to replication, which costs HBM and bandwidth instead
+of a traceback.
+
+Declared axes come from ``[tool.dtxlint] mesh-axes`` when set, else are
+extracted from ``*_AXES`` assignments of the configured ``mesh-module``.
+When neither yields axis names the rule stays quiet (nothing to check
+against). The mesh module itself is exempt — it's the declaration site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Tuple
+
+from datatunerx_tpu.analysis.config import mesh_axes_for
+from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
+
+_SPEC_NAMES = (
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+)
+_CONSTRAINT_NAMES = (
+    "jax.lax.with_sharding_constraint",
+    "jax.experimental.pjit.with_sharding_constraint",
+)
+
+
+class MeshAxisDrift(Rule):
+    id = "DTX005"
+    name = "mesh-axis-drift"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        axes = set(mesh_axes_for(ctx.config))
+        if not axes:
+            return []
+        mesh_module = ctx.config.resolve(ctx.config.mesh_module)
+        if mesh_module and os.path.normpath(os.path.abspath(ctx.path)) \
+                == os.path.normpath(os.path.abspath(mesh_module)):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved in _SPEC_NAMES:
+                args = list(node.args)
+            elif resolved in _CONSTRAINT_NAMES and len(node.args) >= 2:
+                # direct string/tuple axis spec (P(...) args are caught by
+                # the PartitionSpec branch when that call appears inline)
+                args = [node.args[1]]
+            else:
+                continue
+            for name, strnode in self._axis_strings(args):
+                if name not in axes:
+                    out.append(self.finding(
+                        ctx, strnode,
+                        f"axis {name!r} is not a declared mesh axis "
+                        f"({', '.join(sorted(axes))}) — stale or typo'd "
+                        "PartitionSpec axes silently replicate (or crash "
+                        "in GSPMD lowering)"))
+        return out
+
+    def _axis_strings(self, args) -> Iterable[Tuple[str, ast.AST]]:
+        stack = list(args)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Tuple, ast.List)):
+                stack.extend(node.elts)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                yield node.value, node

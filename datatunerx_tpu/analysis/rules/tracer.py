@@ -1,0 +1,95 @@
+"""DTX003: Python control flow on traced values inside jitted functions.
+
+``if jnp.any(mask):`` inside a ``@jax.jit`` function calls ``bool()`` on a
+tracer — a TracerBoolConversionError at trace time in the best case, and
+in the worst (shape-dependent code that happens to trace) a silently
+baked-in branch that ignores runtime values. The fix is ``jax.lax.cond``
+/ ``jax.lax.while_loop`` or ``jnp.where``.
+
+Detection: a function is "jitted" when decorated with ``jax.jit`` (bare,
+called, or via ``functools.partial(jax.jit, ...)``), or when the module
+wraps it by name — ``g = jax.jit(f)``. Inside such functions, an
+``if``/``while`` whose TEST contains a ``jnp.*``/``jax.lax.*``/
+``jax.nn.*`` CALL is flagged. Attribute-only tests (``x.ndim``,
+``x.shape[0]``, ``x.dtype``) are static under tracing and stay allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from datatunerx_tpu.analysis.callgraph import walk_function
+from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+_TRACED_CALL_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.scipy.")
+
+
+def _is_jit_expr(ctx: ModuleContext, node: ast.AST) -> bool:
+    """True for ``jax.jit``, ``jax.jit(...)``, ``partial(jax.jit, ...)``."""
+    if ctx.resolve(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if ctx.resolve(node.func) in _JIT_NAMES:
+            return True
+        if ctx.resolve(node.func) == "functools.partial" and node.args \
+                and ctx.resolve(node.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+class TracerControlFlow(Rule):
+    id = "DTX003"
+    name = "tracer-control-flow"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for qualname in sorted(self._jitted(ctx)):
+            info = ctx.graph.functions[qualname]
+            for node in walk_function(info.node, include_nested=True):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                traced = self._traced_call_in(ctx, node.test)
+                if traced:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(self.finding(
+                        ctx, node,
+                        f"Python `{kind}` on the traced value "
+                        f"{traced}(...) inside jitted {qualname}: use "
+                        "jax.lax.cond/while_loop or jnp.where — a tracer "
+                        "has no stable truth value"))
+        return out
+
+    def _jitted(self, ctx: ModuleContext) -> Set[str]:
+        jitted: Set[str] = set()
+        for qualname, info in ctx.graph.functions.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                if _is_jit_expr(ctx, dec):
+                    jitted.add(qualname)
+        # g = jax.jit(f) / self._fn = jax.jit(self._impl)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.resolve(node.func) in _JIT_NAMES and node.args):
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                for cand in (target.id,):
+                    jitted.update(q for q, i in ctx.graph.functions.items()
+                                  if i.name == cand and i.cls is None)
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self":
+                jitted.update(q for q, i in ctx.graph.functions.items()
+                              if i.name == target.attr and i.cls is not None)
+        return {q for q in jitted if q in ctx.graph.functions}
+
+    def _traced_call_in(self, ctx: ModuleContext, test: ast.AST) -> str:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve(node.func)
+                if resolved and any(resolved.startswith(p)
+                                    for p in _TRACED_CALL_PREFIXES):
+                    return resolved
+        return ""

@@ -17,6 +17,10 @@ Talks to the operator's REST API (operator/apiserver.py):
   dtx serve --model_path P             serve directly (no operator); with
       [--replicas N] [--gateway]       N > 1 or --gateway the inference
                                        gateway fronts the replicas
+  dtx lint [paths...]                  JAX-aware static analysis (dtxlint):
+                                       host-sync, retrace, sharding, and
+                                       lock-discipline rules; exits 1 on
+                                       findings (the tier-1 CI gate)
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.
@@ -229,6 +233,34 @@ def cmd_serve(args):
     return serving_main(argv)
 
 
+def _lint_tail(argv):
+    """The argv tail after ``lint`` when lint is the subcommand — allowing
+    the one global option (``--server``) before it — else None. dtxlint's
+    flags must bypass argparse entirely: a REMAINDER positional drops
+    leading optionals like ``--format``, so `dtx lint` dispatches before
+    parsing and every `dtx [--server X] lint …` form behaves exactly like
+    the `dtxlint` console script."""
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok == "--server":
+            i += 2
+            continue
+        if tok.startswith("--server="):
+            i += 1
+            continue
+        return argv[i + 1:] if tok == "lint" else None
+    return None
+
+
+def cmd_lint(args):
+    # unreachable in practice — main() intercepts every lint invocation
+    # before argparse — kept so the help-listing subparser has an action
+    from datatunerx_tpu.analysis.cli import main as lint_main
+
+    return lint_main([])
+
+
 def cmd_install(args):
     """One-command install (reference dtx-ctl + Helm, INSTALL.md:26-48)."""
     from datatunerx_tpu.operator.install import install, render_install_manifests
@@ -268,6 +300,12 @@ def cmd_install(args):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    lint_tail = _lint_tail(argv)
+    if lint_tail is not None:
+        from datatunerx_tpu.analysis.cli import main as lint_main
+
+        return lint_main(lint_tail)
     p = argparse.ArgumentParser(prog="dtx")
     p.add_argument("--server", default=os.environ.get("DTX_SERVER",
                                                       "http://127.0.0.1:8080"))
@@ -327,6 +365,12 @@ def main(argv=None):
                     help="gateway replica log directory")
     vp.set_defaults(fn=cmd_serve)
 
+    xp = sub.add_parser(
+        "lint",
+        help="JAX-aware static analysis (dtxlint); args pass through",
+        add_help=False)
+    xp.set_defaults(fn=cmd_lint)
+
     ip = sub.add_parser(
         "install",
         help="install CRDs + RBAC + operator Deployment + config "
@@ -350,8 +394,8 @@ def main(argv=None):
     ip.set_defaults(fn=cmd_install)
 
     args = p.parse_args(argv)
-    args.fn(args)
-    return 0
+    rc = args.fn(args)
+    return int(rc) if isinstance(rc, int) else 0
 
 
 if __name__ == "__main__":

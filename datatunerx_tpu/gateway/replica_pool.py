@@ -30,6 +30,14 @@ class ReplicaError(Exception):
     mid-stream, 5xx). The gateway fails over; the breaker records it."""
 
 
+def _client_error_message(e: BaseException) -> str:
+    # KeyError.__str__ reprs its argument — unwrap so the 400 body reads
+    # "unknown adapter 'x'", not "\"unknown adapter 'x'\""
+    if isinstance(e, KeyError) and e.args:
+        return str(e.args[0])
+    return str(e)
+
+
 class NoReplicaAvailable(Exception):
     """No healthy, non-draining, circuit-closed replica to route to."""
 
@@ -150,6 +158,12 @@ class InProcessReplica(Replica):
         kwargs.pop("trace_id", None)
         try:
             return self.engine.chat(messages, **kwargs)
+        except (ValueError, KeyError) as e:
+            # the CLIENT's error (unknown adapter, over-length prompt, bad
+            # params): same rule as HTTPReplica's 4xx mapping — the replica
+            # is fine, don't trip its breaker or fail over; the gateway
+            # answers 400, not 503
+            raise ValueError(_client_error_message(e)) from e
         except Exception as e:  # noqa: BLE001 — engine fault = replica fault
             raise ReplicaError(f"{self.name}: {e}") from e
 
@@ -164,6 +178,8 @@ class InProcessReplica(Replica):
                 yield delta
         except ReplicaError:
             raise
+        except (ValueError, KeyError) as e:  # client error — no failover
+            raise ValueError(_client_error_message(e)) from e
         except Exception as e:  # noqa: BLE001
             raise ReplicaError(f"{self.name}: {e}") from e
 

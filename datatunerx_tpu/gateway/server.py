@@ -274,6 +274,18 @@ class Gateway:
             raise NotImplementedError("gateway does not manage its replicas")
         return self.replica_set.scale(n)
 
+    def drain(self, name: str) -> bool:
+        """Drain a replica for a rolling restart. Managed replicas get the
+        full treatment (reap the subprocess, spawn a replacement); bare
+        pool replicas just stop receiving new requests."""
+        if self.replica_set is not None and self.replica_set.drain(name):
+            self.router.forget_replica(name)
+            return True
+        if self.pool.drain(name):
+            self.router.forget_replica(name)
+            return True
+        return False
+
     def close(self):
         if self.replica_set is not None:
             self.replica_set.close()
@@ -287,8 +299,11 @@ class ManagedReplicaSet:
     --replicas N` uses. A supervisor thread reconciles toward ``target``:
     dead processes (crashed/killed replicas) are reaped and REPLACED, so the
     fleet self-heals like Ray Serve restarting a dead deployment replica.
-    Downscale is graceful: the replica drains (no new requests) and its
-    process is reaped once in-flight work finishes."""
+    Downscale AND /admin/drain are graceful: the replica drains (no new
+    requests) and its process is reaped once in-flight work finishes —
+    every drained managed replica gets a reaper, so a drain can never
+    leave a zombie subprocess + pool entry behind (the fleet previously
+    grew past target by one zombie per /admin/drain)."""
 
     def __init__(self, pool: ReplicaPool, server_args: List[str],
                  workdir: str = "", drain_timeout_s: float = 30.0,
@@ -299,8 +314,13 @@ class ManagedReplicaSet:
         self.drain_timeout_s = drain_timeout_s
         self.target = 0
         self._procs: dict = {}
+        self._reaping: set = set()
         self._next_idx = 0
         self._lock = threading.Lock()
+        # serializes whole reconcile passes: drain()/scale() callers (HTTP
+        # handler threads) race the supervisor tick, and two concurrent
+        # passes would both see live < target and double-spawn a replica
+        self._reconcile_lock = threading.Lock()
         os.makedirs(self.workdir, exist_ok=True)
         self._shutdown = threading.Event()
         self._supervisor = None
@@ -330,9 +350,25 @@ class ManagedReplicaSet:
         return replica
 
     def scale(self, n: int) -> int:
-        self.target = max(0, int(n))
+        n = max(0, int(n))
+        with self._lock:  # target is read by the supervisor thread
+            self.target = n
         self._reconcile()
-        return self.target
+        return n
+
+    def drain(self, name: str) -> bool:
+        """Drain one MANAGED replica for a rolling restart: stop routing to
+        it, reap its process once in-flight work finishes, and let the
+        supervisor spawn a replacement to hold ``target``."""
+        with self._lock:
+            managed = name in self._procs
+        replica = self.pool.get(name)
+        if not managed or replica is None:
+            return False
+        replica.drain()
+        self._start_reap(replica)
+        self._reconcile()  # spawn the replacement now, not next tick
+        return True
 
     def _supervise(self, interval: float):
         while not self._shutdown.wait(interval):
@@ -342,6 +378,10 @@ class ManagedReplicaSet:
         """Converge the live managed fleet on ``target``: reap dead
         processes first (a killed replica must not count toward the target,
         or the fleet would stay degraded forever), then spawn/drain."""
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
         with self._lock:
             dead = [name for name, proc in self._procs.items()
                     if proc.poll() is not None]
@@ -351,29 +391,51 @@ class ManagedReplicaSet:
             self.pool.remove(name)
         with self._lock:
             managed = set(self._procs)
-        live = sorted((r for r in self.pool.replicas()
-                       if r.name in managed and not r.draining),
-                      key=lambda r: r.name)
-        for _ in range(self.target - len(live)):
+            target = self.target
+        live = []
+        for r in self.pool.replicas():
+            if r.name not in managed:
+                continue
+            if r.draining:
+                # safety net: however a managed replica got its draining
+                # flag (/admin/drain via pool.drain, an operator poking the
+                # pool directly), it must end up reaped — draining without
+                # a reaper is how zombies used to accumulate
+                self._start_reap(r)
+            else:
+                live.append(r)
+        live.sort(key=lambda r: r.name)
+        for _ in range(target - len(live)):
             self.spawn()
-        for replica in live[self.target:][::-1]:  # drain newest-first
+        for replica in live[target:][::-1]:  # drain newest-first
             replica.drain()
-            threading.Thread(target=self._reap, args=(replica,),
-                             daemon=True).start()
+            self._start_reap(replica)
+
+    def _start_reap(self, replica: HTTPReplica):
+        with self._lock:
+            if replica.name in self._reaping or replica.name not in self._procs:
+                return
+            self._reaping.add(replica.name)
+        threading.Thread(target=self._reap, args=(replica,),
+                         daemon=True).start()
 
     def _reap(self, replica: HTTPReplica):
-        deadline = time.monotonic() + self.drain_timeout_s
-        while replica.inflight > 0 and time.monotonic() < deadline:
-            time.sleep(0.1)
-        self.pool.remove(replica.name)
-        with self._lock:
-            proc = self._procs.pop(replica.name, None)
-        if proc is not None and proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        try:
+            deadline = time.monotonic() + self.drain_timeout_s
+            while replica.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            self.pool.remove(replica.name)
+            with self._lock:
+                proc = self._procs.pop(replica.name, None)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        finally:
+            with self._lock:
+                self._reaping.discard(replica.name)
 
     def close(self):
         self._shutdown.set()
@@ -581,8 +643,7 @@ def make_handler(gw: Gateway):
 
         def _drain(self, req: dict, trace_id: str):
             name = req.get("replica") or ""
-            if self.gateway.pool.drain(name):
-                self.gateway.router.forget_replica(name)
+            if self.gateway.drain(name):
                 self._json(200, {"draining": name}, trace_id)
             else:
                 self._json(404, {"error": f"no replica {name!r}"}, trace_id)

@@ -242,7 +242,7 @@ class BatchedEngine:
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._waiting: "queue.Queue[Request]" = queue.Queue()
         self._wake = threading.Event()
-        self._shutdown = False
+        self._shutdown = threading.Event()
 
         self._prefill = jax.jit(self._prefill_impl,
                                 static_argnames=("prompt_len",))
@@ -496,7 +496,7 @@ class BatchedEngine:
         self._slot_req[slot] = req
 
     def _scheduler(self):
-        while not self._shutdown:
+        while not self._shutdown.is_set():
             admitted = False
             for slot in range(self.slots):
                 if self._slot_req[slot] is not None:
@@ -523,8 +523,10 @@ class BatchedEngine:
                     self._remaining, self._active, self._rng, self._temps,
                     self._top_ps, self._stops, self._adapter_idx, K=self.chunk,
                 )
-                emitted_np = np.asarray(emitted)          # [K, S]
-                active_np = np.asarray(self._active)      # [S]
+                # the decode loop's ONE designed sync point: K tokens per
+                # chunk cross to host here so req.push can stream them
+                emitted_np = np.asarray(emitted)  # [K, S]  # dtxlint: disable=DTX001
+                active_np = np.asarray(self._active)  # [S]  # dtxlint: disable=DTX001
             except Exception as e:  # noqa: BLE001 — device fault: fail all in-flight
                 for slot, req in enumerate(self._slot_req):
                     if req is not None:
@@ -534,7 +536,8 @@ class BatchedEngine:
 
             for k in range(emitted_np.shape[0]):
                 for slot in range(self.slots):
-                    t = int(emitted_np[k, slot])
+                    # emitted_np is host-side numpy already — no device sync
+                    t = int(emitted_np[k, slot])  # dtxlint: disable=DTX001
                     req = self._slot_req[slot]
                     if t >= 0 and req is not None:
                         req.push(t)
@@ -647,6 +650,6 @@ class BatchedEngine:
             raise RuntimeError(req.error)
 
     def close(self):
-        self._shutdown = True
+        self._shutdown.set()
         self._wake.set()
         self._thread.join(timeout=10)

@@ -138,7 +138,9 @@ def validate_nf4_transposed():
             jax.random.PRNGKey(20 + K), (K, N), jnp.float32) * 0.05
         q4 = quantize_nf4(w)
         g = jax.random.normal(jax.random.PRNGKey(21), (M, N), jnp.bfloat16)
-        got = jax.jit(
+        # two iterations with DIFFERENT shapes: each would recompile even
+        # through one wrapper, so the per-iteration jit costs nothing here
+        got = jax.jit(  # dtxlint: disable=DTX002
             lambda g, q4=q4, K=K, N=N: _pallas_matmul_nf4_t_impl(
                 g, q4, (K, N)))(g)
         wd = dequant_nf4(q4, (K, N))

@@ -19,7 +19,8 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _PROBE = r"""
-import json, os
+import json
+import os
 os.environ["DTX_PALLAS_INTERPRET"] = "0"
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax

@@ -2,7 +2,6 @@
 slot reuse/admission under load, measurable request overlap, streaming deltas,
 and unmerged multi-adapter LoRA correctness (VERDICT round-1 item 5)."""
 
-import threading
 import time
 
 import numpy as np

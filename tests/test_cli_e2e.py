@@ -10,7 +10,6 @@ import csv
 import json
 import os
 
-import numpy as np
 import pytest
 
 from datatunerx_tpu.tuning.parser import parse_train_args
@@ -234,7 +233,7 @@ def test_model_family_smoke(tmp_path, preset):
     CLI on scaled-down dims."""
     import dataclasses as _dc
 
-    from datatunerx_tpu.models.config import PRESETS, ModelConfig
+    from datatunerx_tpu.models.config import PRESETS
     from datatunerx_tpu.tuning.parser import parse_train_args
     from datatunerx_tpu.tuning.train import run
 

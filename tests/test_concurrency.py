@@ -5,10 +5,9 @@ single reconcile loop)."""
 
 import threading
 
-import pytest
 
 from datatunerx_tpu.operator.api import Hyperparameter, LLM, ObjectMeta
-from datatunerx_tpu.operator.store import Conflict, NotFound, ObjectStore
+from datatunerx_tpu.operator.store import Conflict, ObjectStore
 
 
 def test_concurrent_updates_all_land_or_conflict():

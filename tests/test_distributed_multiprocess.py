@@ -11,7 +11,8 @@ import sys
 import pytest
 
 _WORKER = r"""
-import os, sys
+import os
+import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
 from datatunerx_tpu.parallel.distributed import maybe_initialize_distributed

@@ -1,0 +1,460 @@
+"""dtxlint (datatunerx_tpu/analysis): one true-positive and one clean
+fixture per rule, plus framework behavior — inline suppressions, baseline
+load/partition, JSON output, config parsing, and the CI contract that the
+repo itself lints clean.
+
+The DTX006/DTX007 positive fixtures reproduce the PRE-FIX gateway
+drain-leak shape from ROADMAP ("/admin/drain never reaps"): a replica set
+that spawns subprocesses, drains on request, and never terminates what it
+drained — exactly what PR 4 fixed in gateway/server.py.
+"""
+
+import json
+import textwrap
+
+from datatunerx_tpu.analysis.baseline import (
+    load_baseline,
+    partition,
+    save_baseline,
+)
+from datatunerx_tpu.analysis.cli import main as dtxlint_main
+from datatunerx_tpu.analysis.config import LintConfig, load_config
+from datatunerx_tpu.analysis.core import lint_paths, lint_source
+
+CFG = LintConfig(mesh_axes=("dp", "fsdp", "tp", "sp"))
+
+
+def run(src, config=CFG):
+    res = lint_source(textwrap.dedent(src), path="fixture.py", config=config)
+    return res
+
+
+def rule_ids(src, config=CFG):
+    return [f.rule for f in run(src, config).findings]
+
+
+# ------------------------------------------------------------------ DTX001
+def test_dtx001_flags_host_sync_reachable_from_hot_function():
+    src = """
+    import jax
+    import numpy as np
+
+    def log_metrics(m):
+        return float(m["loss"])
+
+    def train_step(state, batch):
+        out = state.apply(batch)
+        log_metrics(out)
+        return np.asarray(out)
+    """
+    ids = rule_ids(src)
+    assert ids.count("DTX001") == 2  # float() via call graph + np.asarray
+
+
+def test_dtx001_clean_outside_hot_path_and_on_constants():
+    src = """
+    import numpy as np
+
+    def train_step(state, batch):
+        return state.apply(batch)
+
+    def summarize(metrics):
+        # same calls, but not reachable from a hot function
+        return float(metrics["loss"]), np.asarray(metrics["hist"])
+
+    def parse(v):
+        return float("1.5")
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX002
+def test_dtx002_flags_jit_in_loop_and_unstable_static_args():
+    src = """
+    import jax
+
+    def compile_all(fns):
+        out = []
+        for f in fns:
+            out.append(jax.jit(f))
+        return out
+
+    bad = jax.jit(lambda x: x, static_argnums={0, 1})
+    """
+    ids = rule_ids(src)
+    assert ids.count("DTX002") == 2
+
+
+def test_dtx002_clean_for_hoisted_jit_called_in_loop():
+    src = """
+    import jax
+
+    step = jax.jit(lambda x: x + 1)
+
+    def run(n):
+        for i in range(n):
+            step(i)
+        return jax.jit(lambda y: y, static_argnums=(0,))
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX003
+def test_dtx003_flags_python_branch_on_traced_value():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        if jnp.any(x > 0):
+            return x
+        return -x
+    """
+    assert rule_ids(src) == ["DTX003"]
+
+
+def test_dtx003_allows_static_shape_branches_and_wrapped_names():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def impl(x):
+        if x.ndim == 2:  # static under tracing
+            return jnp.sum(x, axis=-1)
+        return jnp.where(x > 0, x, -x)
+
+    f = jax.jit(impl)
+
+    def eager(x):
+        # not jitted: Python control flow on values is fine
+        if jnp.any(x > 0):
+            return x
+        return -x
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX004
+def test_dtx004_flags_double_consumption_and_loop_reuse():
+    src = """
+    import jax
+
+    def double(key):
+        a = jax.random.normal(key, (2,))
+        b = jax.random.uniform(key, (2,))
+        return a + b
+
+    def loop(key):
+        return [jax.random.normal(key, (2,)) for _ in range(3)] if False \\
+            else _loop(key)
+
+    def _loop(key):
+        out = []
+        for i in range(3):
+            out.append(jax.random.normal(key, (2,)))
+        return out
+    """
+    ids = rule_ids(src)
+    assert ids.count("DTX004") == 2
+
+
+def test_dtx004_clean_split_branches_loop_carry_and_fold_in():
+    src = """
+    import jax
+
+    def good(key, flag):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (2,))
+        if flag:
+            b = jax.random.uniform(k2, (2,))
+        else:
+            b = jax.random.normal(k2, (2,))
+        return a + b
+
+    def carry(key):
+        out = []
+        for i in range(3):
+            key, sub = jax.random.split(key)
+            out.append(jax.random.normal(sub, (2,)))
+        return out
+
+    def streams(key):
+        # fold_in with distinct data is the documented idiom, not reuse
+        return [jax.random.normal(jax.random.fold_in(key, i), (2,))
+                for i in range(3)]
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX005
+def test_dtx005_flags_undeclared_axis_name():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    def spec():
+        return P("data", None)
+    """
+    assert rule_ids(src) == ["DTX005"]
+
+
+def test_dtx005_clean_declared_axes_and_quiet_without_axes():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    def spec():
+        return P(("dp", "fsdp"), None, "tp")
+    """
+    assert rule_ids(src) == []
+    # no declared axes configured → nothing to check against
+    assert rule_ids('from jax.sharding import PartitionSpec as P\n'
+                    'x = P("whatever")\n', config=LintConfig()) == []
+
+
+# ------------------------------------------------------------------ DTX006
+# the pre-fix /admin/drain shape: a public method flips state the
+# supervisor thread reconciles on, with no lock
+DRAIN_LEAK_CLASS = """
+import subprocess
+import threading
+
+
+class ReplicaSet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.target = 0
+        self._procs = {}
+        self._t = threading.Thread(target=self._supervise, daemon=True)
+        self._t.start()
+
+    def _supervise(self):
+        while True:
+            if len(self._procs) < self.target:
+                self.spawn(str(len(self._procs)))
+
+    def spawn(self, name):
+        self._procs[name] = subprocess.Popen(["serve"])
+
+    def scale(self, n):
+        self.target = n
+
+    def drain(self, name):
+        self._procs[name].draining = True
+"""
+
+
+def test_dtx006_flags_pre_fix_drain_leak_shape_unlocked_public_write():
+    ids = rule_ids(DRAIN_LEAK_CLASS)
+    assert "DTX006" in ids  # scale() writes self.target, thread reads it
+
+
+def test_dtx006_clean_when_writes_hold_the_lock():
+    src = """
+    import threading
+
+    class ReplicaSet:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.target = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    n = self.target
+
+        def scale(self, n):
+            with self._lock:
+                self.target = n
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX007
+def test_dtx007_flags_pre_fix_drain_leak_shape_unreaped_subprocess():
+    ids = rule_ids(DRAIN_LEAK_CLASS)
+    # spawn() stores a Popen in self._procs and NO method of the class
+    # ever terminates/joins values from it — the zombie-per-drain leak
+    assert "DTX007" in ids
+
+
+def test_dtx007_clean_when_a_method_reaps_and_for_escaping_handles():
+    src = """
+    import subprocess
+    import threading
+
+    class ReplicaSet:
+        def __init__(self):
+            self._procs = {}
+
+        def spawn(self, name):
+            self._procs[name] = subprocess.Popen(["serve"])
+
+        def close(self):
+            procs = list(self._procs.values())
+            for proc in procs:
+                proc.terminate()
+
+    def run_once():
+        proc = subprocess.Popen(["true"])
+        proc.wait()
+
+    def fire_and_forget(fn):
+        threading.Thread(target=fn, daemon=True).start()
+
+    def handoff():
+        return subprocess.Popen(["true"])
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ DTX008
+def test_dtx008_flags_module_level_and_default_arg_device_work():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    TABLE = jnp.ones((8,))
+
+    def f(x, fill=jnp.zeros((4,))):
+        return x + fill
+
+    N_DEV = jax.device_count()
+    """
+    assert rule_ids(src) == ["DTX008"] * 3
+
+
+def test_dtx008_clean_for_lazy_work_jit_wrappers_and_dtypes():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    DTYPE = jnp.float32
+
+    def make_table():
+        return jnp.ones((8,))
+
+    f = jax.jit(make_table)
+    g = lambda: jnp.zeros((4,))
+    """
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------- framework behavior
+def test_inline_suppression_comment_silences_one_rule():
+    src = """
+    import jax.numpy as jnp
+
+    A = jnp.ones((2,))  # dtxlint: disable=DTX008 -- frozen table, deliberate
+    B = jnp.ones((2,))  # dtxlint: disable=DTX001
+    C = jnp.ones((2,))  # dtxlint: disable=all
+    """
+    res = run(src)
+    assert [f.rule for f in res.findings] == ["DTX008"]  # only B still fires
+    assert res.suppressed == 2
+
+
+def test_baseline_roundtrip_and_partition(tmp_path):
+    res = run("import jax.numpy as jnp\nA = jnp.ones((2,))\n")
+    assert len(res.findings) == 1
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), res.findings)
+    carried = load_baseline(str(path))
+    new, baselined = partition(res.findings, carried)
+    assert new == [] and len(baselined) == 1
+    # a second, identical finding needs a second baseline entry
+    two = res.findings * 2
+    new, baselined = partition(two, carried)
+    assert len(new) == 1 and len(baselined) == 1
+    assert load_baseline(str(tmp_path / "missing.json")) == {}
+
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nA = jnp.ones((2,))\n")
+    rc = dtxlint_main([str(bad), "--format", "json", "--no-config",
+                       "--no-baseline"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["failed"]
+    assert doc["findings"][0]["rule"] == "DTX008"
+    assert doc["findings"][0]["line"] == 2
+
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n")
+    assert dtxlint_main([str(good), "--no-config", "--no-baseline"]) == 0
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nA = jnp.ones((2,))\n")
+    base = tmp_path / "base.json"
+    assert dtxlint_main([str(bad), "--no-config", "--baseline",
+                         str(base), "--write-baseline"]) == 0
+    assert dtxlint_main([str(bad), "--no-config", "--baseline",
+                         str(base)]) == 0
+    capsys.readouterr()
+
+
+def test_select_runs_only_named_rules(tmp_path):
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "A = jnp.ones((2,))\n"
+           "def f(key):\n"
+           "    a = jax.random.normal(key, (2,))\n"
+           "    return a + jax.random.uniform(key, (2,))\n")
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    res = lint_paths([str(p)], config=LintConfig())
+    assert {f.rule for f in res.findings} == {"DTX004", "DTX008"}
+    from datatunerx_tpu.analysis.rules import rules_by_id
+
+    res = lint_paths([str(p)], config=LintConfig(),
+                     rules=rules_by_id(["DTX004"]))
+    assert {f.rule for f in res.findings} == {"DTX004"}
+
+
+def test_config_disable_and_toml_subset(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [project]
+        name = "x"
+
+        [tool.dtxlint]
+        baseline = "b.json"
+        disable = ["DTX008"]
+        hot-functions = [
+            "train_step",
+            "hot_*",
+        ]
+        mesh-axes = ["dp", "tp"]
+    """))
+    cfg = load_config(str(tmp_path))
+    assert cfg.baseline == "b.json"
+    assert cfg.disable == ("DTX008",)
+    assert cfg.hot_functions == ("train_step", "hot_*")
+    assert cfg.mesh_axes == ("dp", "tp")
+    res = lint_source("import jax.numpy as jnp\nA = jnp.ones((2,))\n",
+                      config=cfg)
+    assert res.findings == []  # DTX008 disabled by config
+
+
+def test_syntax_error_reports_dtx000_not_crash():
+    res = lint_source("def broken(:\n", path="x.py")
+    assert [f.rule for f in res.findings] == ["DTX000"]
+
+
+# --------------------------------------------------------------- CI contract
+def test_repo_lints_clean_at_head():
+    """The acceptance gate: the shipped tree has zero non-suppressed
+    findings against the shipped (empty-findings) baseline."""
+    cfg = load_config(".")
+    res = lint_paths(["datatunerx_tpu"], config=cfg)
+    baseline = load_baseline(cfg.resolve(cfg.baseline))
+    new, _ = partition(res.findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert baseline == {}, "policy: the baseline stays empty"
+
+
+def test_mesh_axes_extracted_from_mesh_module():
+    from datatunerx_tpu.analysis.config import mesh_axes_for
+
+    cfg = load_config(".")
+    assert set(mesh_axes_for(cfg)) == {"dp", "fsdp", "tp", "sp"}

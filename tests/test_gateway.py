@@ -8,10 +8,12 @@ real ThreadingHTTPServer on a loopback port.
 """
 
 import json
+import subprocess
 import threading
 import time
 import urllib.error
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
@@ -23,12 +25,14 @@ from datatunerx_tpu.gateway.admission import (
 from datatunerx_tpu.gateway.autoscale import autoscale_hint, parse_hint
 from datatunerx_tpu.gateway.replica_pool import (
     CircuitBreaker,
+    HTTPReplica,
     InProcessReplica,
     NoReplicaAvailable,
+    ReplicaError,
     ReplicaPool,
 )
-from datatunerx_tpu.gateway.router import Router, session_key
-from datatunerx_tpu.gateway.server import Gateway, serve
+from datatunerx_tpu.gateway.router import session_key
+from datatunerx_tpu.gateway.server import Gateway, ManagedReplicaSet, serve
 
 
 class FakeEngine:
@@ -633,3 +637,216 @@ def test_local_backend_deploys_gateway_with_real_replicas(tmp_path):
         assert hint and hint["replicas"] == 1
     finally:
         backend.delete("gwjob")
+
+
+# --------------------------------------------------- drain reaping (PR 4)
+class FakeProc:
+    """subprocess.Popen stand-in: alive until terminate()/kill()."""
+
+    def __init__(self):
+        self.returncode = None
+        self.terminated = False
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        self.terminated = True
+        self.returncode = -15
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        if self.returncode is None:
+            raise subprocess.TimeoutExpired("fake", timeout or 0)
+        return self.returncode
+
+
+class FakeManagedReplicaSet(ManagedReplicaSet):
+    """ManagedReplicaSet whose spawn() creates an in-process replica and a
+    FakeProc instead of a real serving.server subprocess — the reap logic
+    under test (drain → terminate → pool removal → replacement) is
+    identical."""
+
+    def spawn(self):
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        name = f"replica-{idx}"
+        with self._lock:
+            self._procs[name] = FakeProc()
+        replica = InProcessReplica(name, FakeEngine(name))
+        self.pool.add(replica)
+        return replica
+
+
+def _wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_admin_drain_reaps_process_and_restores_target(tmp_path):
+    """ROADMAP bug: /admin/drain used to only set the draining flag — the
+    subprocess and pool entry leaked, and reconcile grew the fleet past
+    target by one zombie per drain. Now: drained replica's process is
+    terminated, its pool entry removed, and the fleet returns to target."""
+    pool = ReplicaPool()
+    mrs = FakeManagedReplicaSet(pool, [], workdir=str(tmp_path / "w"),
+                                drain_timeout_s=2.0, supervise_interval_s=0)
+    gw = Gateway(pool)
+    gw.replica_set = mrs
+    try:
+        mrs.scale(2)
+        assert sorted(r.name for r in pool.replicas()) == [
+            "replica-0", "replica-1"]
+        proc0 = mrs._procs["replica-0"]
+
+        assert gw.drain("replica-0")
+        assert _wait_until(lambda: pool.get("replica-0") is None)
+        assert proc0.terminated, "drained replica's subprocess must be reaped"
+        assert "replica-0" not in mrs._procs, "no zombie pool entry"
+
+        # replacement spawned: fleet back at target, not target+zombie
+        mrs._reconcile()
+        assert _wait_until(lambda: len(pool.replicas()) == 2)
+        assert len(mrs._procs) == 2
+        assert all(p.poll() is None for p in mrs._procs.values())
+
+        # unknown replica still 404s through the gateway entry point
+        assert not gw.drain("replica-404")
+    finally:
+        mrs.close()
+        pool.close()
+
+
+def test_drain_waits_for_inflight_before_reaping(tmp_path):
+    pool = ReplicaPool()
+    mrs = FakeManagedReplicaSet(pool, [], workdir=str(tmp_path / "w"),
+                                drain_timeout_s=5.0, supervise_interval_s=0)
+    try:
+        mrs.scale(1)
+        replica = pool.get("replica-0")
+        replica.acquire()  # simulate an in-flight request
+        assert mrs.drain("replica-0")
+        time.sleep(0.3)
+        assert pool.get("replica-0") is not None, \
+            "reaper must wait for in-flight work"
+        replica.release()
+        assert _wait_until(lambda: pool.get("replica-0") is None)
+        assert "replica-0" not in mrs._procs
+    finally:
+        mrs.close()
+        pool.close()
+
+
+def test_pool_level_drain_is_reaped_by_supervisor(tmp_path):
+    """Safety net: a managed replica drained directly on the pool (old
+    /admin/drain path) is picked up by the next reconcile tick."""
+    pool = ReplicaPool()
+    mrs = FakeManagedReplicaSet(pool, [], workdir=str(tmp_path / "w"),
+                                drain_timeout_s=2.0, supervise_interval_s=0)
+    try:
+        mrs.scale(2)
+        pool.drain("replica-1")  # bypasses ManagedReplicaSet.drain
+        mrs._reconcile()  # what the supervisor thread runs periodically
+        assert _wait_until(lambda: pool.get("replica-1") is None)
+        assert "replica-1" not in mrs._procs
+        assert _wait_until(lambda: len(pool.replicas()) == 2)
+    finally:
+        mrs.close()
+        pool.close()
+
+
+# ------------------------------------- client errors vs replica faults (PR 4)
+class ClientErrorEngine(FakeEngine):
+    """Engine that rejects the REQUEST (unknown adapter / over-length
+    prompt) — the engine contract raises ValueError/KeyError for these,
+    never a replica-level fault."""
+
+    def __init__(self, name, exc):
+        super().__init__(name)
+        self.exc = exc
+
+    def chat(self, messages, **kw):
+        self.calls += 1
+        raise self.exc
+
+    def chat_stream(self, messages, **kw):
+        self.calls += 1
+        raise self.exc
+        yield  # pragma: no cover — make it a generator
+
+
+@pytest.mark.parametrize("exc", [ValueError("prompt too long"),
+                                 KeyError("unknown adapter 'x'")])
+def test_inprocess_client_error_does_not_trip_breaker_or_fail_over(exc):
+    bad = ClientErrorEngine("r0", exc)
+    healthy = FakeEngine("r1", reply="ok")
+    pool = ReplicaPool([InProcessReplica("r0", bad)])
+    gw = Gateway(pool)
+    with pytest.raises(ValueError):
+        gw.chat({"messages": MSGS})
+    assert pool.get("r0").breaker.state == "closed", \
+        "a client error must not count against the replica"
+
+    # with a healthy sibling available the request must STILL fail (the
+    # request itself is bad) instead of failing over and masking the 400
+    gw2 = make_gateway([ClientErrorEngine("r0", exc), healthy],
+                       policy="round_robin")
+    for _ in range(2):  # whichever replica round-robin picks first
+        with pytest.raises(ValueError):
+            gw2.chat({"messages": MSGS})
+    assert healthy.calls <= 2  # served directly, never via failover retries
+
+    with pytest.raises(ValueError):
+        list(Gateway(ReplicaPool([InProcessReplica(
+            "r2", ClientErrorEngine("r2", exc))])).chat_stream(
+                {"messages": MSGS}))
+
+
+def test_replica_types_agree_on_client_error_mapping():
+    """InProcessReplica and HTTPReplica side by side: the same client
+    mistake surfaces as ValueError (→ gateway 400) from both, not as
+    ReplicaError (→ breaker trip + 503)."""
+
+    class Reject400(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.dumps({"error": "unknown model/adapter 'x'"}).encode()
+            self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Reject400)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        replicas = [
+            HTTPReplica("http0", f"http://127.0.0.1:{srv.server_port}"),
+            InProcessReplica("proc0", ClientErrorEngine(
+                "proc0", KeyError("unknown model/adapter 'x'"))),
+        ]
+        for replica in replicas:
+            with pytest.raises(ValueError):
+                replica.chat(MSGS, max_new_tokens=4)
+            with pytest.raises(ValueError):
+                list(replica.chat_stream(MSGS, max_new_tokens=4))
+
+        # a genuine replica fault still raises ReplicaError from both
+        dead_http = HTTPReplica("dead", "http://127.0.0.1:9")  # closed port
+        with pytest.raises(ReplicaError):
+            dead_http.chat(MSGS)
+        dead_proc = InProcessReplica("deadp", FakeEngine("deadp"))
+        dead_proc.engine.dead = True
+        with pytest.raises(ReplicaError):
+            dead_proc.chat(MSGS)
+    finally:
+        srv.shutdown()

@@ -3,10 +3,6 @@ RBAC + operator Deployment + env config, parity with the reference's
 dtx-ctl/Helm install flow (reference INSTALL.md:26-48,115-144). The rendered
 bundle must apply cleanly against the fake apiserver, idempotently."""
 
-import io
-import json
-import sys
-from contextlib import redirect_stdout
 
 import pytest
 

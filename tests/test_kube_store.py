@@ -12,7 +12,6 @@ from datatunerx_tpu.operator.api import (
     Dataset,
     Finetune,
     FinetuneJob,
-    Hyperparameter,
     LLM,
     LLMCheckpoint,
     ObjectMeta,

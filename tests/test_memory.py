@@ -6,7 +6,6 @@ These tests ARE the BASELINE.md rows-4/5 capacity claims: if a stated
 configuration stops fitting its stated hardware, they fail loudly.
 """
 
-import jax.numpy as jnp
 import pytest
 
 from datatunerx_tpu.models import get_config

@@ -1,7 +1,6 @@
 """Native C++ packer vs the pure-Python reference implementations."""
 
 import numpy as np
-import pytest
 
 from datatunerx_tpu import native
 from datatunerx_tpu.data.preprocess import pack_to_block, pad_to_block

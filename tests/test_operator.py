@@ -4,8 +4,6 @@ the transitions in SURVEY.md §2.3/§3 — including the key one: Scoring.Score
 set ⇒ job Successful + serving torn down (reference
 finetunejob_controller.go:485-508)."""
 
-import json
-import os
 
 import pytest
 

@@ -11,7 +11,6 @@ import csv
 import json
 import os
 import time
-import urllib.request
 
 import pytest
 

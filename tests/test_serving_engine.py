@@ -1,10 +1,8 @@
 """Serving engine: jitted while-loop decode vs stepwise reference, sampling,
 chat templating."""
 
-import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from datatunerx_tpu.models.llama import forward, init_cache

@@ -8,8 +8,6 @@ webhooks + cert-rotator (reference controller_manager.go:83-135).
 
 import datetime
 import json
-import urllib.error
-import urllib.request
 
 import pytest
 
