@@ -162,6 +162,115 @@ def child_tinyllama():
     print(json.dumps(line))
 
 
+def child_serve():
+    """DTX_BENCH_SERVE=1: continuous-batching serve bench. A mixed long/short
+    chat workload runs through one BatchedEngine (paged KV cache + chunked
+    prefill by default; DTX_BENCH_SERVE_PAGED=0 compares the dense cache) and
+    the line carries the three serving north-stars: aggregate tokens/s, TTFT
+    (time to first streamed token, where chunked prefill + the prefill token
+    budget bite), and TPOT (inter-token time, where a long admission stalling
+    decode would show). CPU numbers are smoke-only, like the pipeline bench.
+    """
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        # env-var platform selection is intercepted by the tunnel's
+        # sitecustomize; config.update is the only reliable CPU escape
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, max_seq, short_new, long_new = "tinyllama-1.1b", 1024, 48, 32
+        n_short, n_long = 12, 4
+    else:  # CPU smoke: tiny model, tiny workload, same code path
+        model, max_seq, short_new, long_new = "debug", 256, 12, 8
+        n_short, n_long = 6, 2
+    slots = int(os.environ.get("DTX_BENCH_SERVE_SLOTS", "4"))
+    paged = os.environ.get("DTX_BENCH_SERVE_PAGED", "1") != "0"
+    block = int(os.environ.get("DTX_BENCH_BLOCK_SIZE", "16"))
+    budget = int(os.environ.get("DTX_BENCH_PREFILL_BUDGET", "256"))
+    eng = BatchedEngine(
+        f"preset:{model}", template="vanilla", max_seq_len=max_seq,
+        slots=slots, decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK",
+                                                     "8")),
+        kv_block_size=block if paged else 0,
+        prefill_token_budget=budget if paged else 0,
+    )
+    try:
+        tok = eng.tokenizer
+        short_ids = tok.encode("a quick question about the weather today")
+        long_ids = tok.encode("background context " * (max_seq // 4))
+        eng.generate(short_ids, max_new_tokens=2)  # compile prefill+decode
+        eng.generate(long_ids, max_new_tokens=2)
+
+        lock = threading.Lock()
+        per_req = []  # (t_submit, [token arrival times])
+
+        def consume(req, t0):
+            stamps = []
+            while True:
+                t = req.stream.get()
+                if t is None:
+                    break
+                stamps.append(time.perf_counter())
+            with lock:
+                per_req.append((t0, stamps, req.error))
+
+        threads = []
+        wall0 = time.perf_counter()
+        # interleave: every 3rd request is a long prompt, arriving while
+        # short decodes are in flight — the head-of-line-blocking shape
+        workload = []
+        li = si = 0
+        while li < n_long or si < n_short:
+            if si < n_short:
+                workload.append((short_ids, short_new)); si += 1
+            if si % 2 == 0 and li < n_long:
+                workload.append((long_ids, long_new)); li += 1
+        for ids, max_new in workload:
+            t0 = time.perf_counter()
+            req = eng.submit(ids, max_new_tokens=max_new)
+            th = threading.Thread(target=consume, args=(req, t0), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - wall0
+    finally:
+        eng.close()
+
+    errors = [e for _, _, e in per_req if e]
+    ttfts = sorted((s[0] - t0) for t0, s, e in per_req if s and not e)
+    tpots = [(s[-1] - s[0]) / (len(s) - 1)
+             for _, s, e in per_req if len(s) > 1 and not e]
+    total_tokens = sum(len(s) for _, s, _ in per_req)
+    mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
+    p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] if ttfts else 0.0
+    tag = (f"{model},slots{slots}," +
+           (f"paged,bs{block},budget{budget}" if paged else "dense"))
+    line = {
+        "metric": f"serve_tokens_per_sec[{tag}]",
+        "value": round(total_tokens / wall, 1) if wall > 0 else 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": None,  # no prior serve-bench round to compare against
+        "serve": {
+            "requests": len(per_req),
+            "errors": len(errors),
+            "tokens": total_tokens,
+            "ttft_ms_mean": round(mean(ttfts) * 1e3, 1),
+            "ttft_ms_p95": round(p95 * 1e3, 1),
+            "tpot_ms_mean": round(mean(tpots) * 1e3, 2),
+            "prefill_stats": dict(eng.prefill_stats),
+        },
+    }
+    if not on_tpu:
+        line["cpu_fallback"] = True
+    print(json.dumps(line), flush=True)
+
+
 # ------------------------------------------------------------- orchestrator
 
 def _preflight_device_ok():
@@ -334,7 +443,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if os.environ.get("DTX_BENCH_SERVE"):
+        child_serve()
+    elif "--child" in sys.argv:
         child_tinyllama()
     else:
         main()

@@ -1,11 +1,15 @@
-"""DTX005: PartitionSpec axis names not declared by the mesh module.
+"""DTX005: PartitionSpec / collective axis names not declared by the mesh.
 
-Every ``PartitionSpec``/``with_sharding_constraint`` axis string must be
-an axis the mesh actually declares (``parallel/mesh.py::MESH_AXES`` —
-dp/fsdp/tp/sp here). A typo'd or stale axis name ("data", "mdl", "x")
-doesn't fail loudly: depending on context it raises deep inside GSPMD or
-silently falls back to replication, which costs HBM and bandwidth instead
-of a traceback.
+Every ``PartitionSpec``/``with_sharding_constraint`` axis string — and every
+``lax.psum``/``pmean``/``all_gather``/… collective's literal ``axis_name`` —
+must be an axis the mesh actually declares (``parallel/mesh.py::MESH_AXES``
+— dp/fsdp/tp/sp here). A typo'd or stale axis name ("data", "mdl", "x")
+doesn't fail loudly: depending on context it raises deep inside GSPMD, at
+trace time far from the typo, or silently falls back to replication, which
+costs HBM and bandwidth instead of a traceback. Collectives drift the same
+way PartitionSpecs do — a psum over a renamed axis is the same bug one
+layer down. Variable axis names (e.g. ring attention's ``axis_name``
+parameter, vmap-introduced axes) are out of static reach and not checked.
 
 Declared axes come from ``[tool.dtxlint] mesh-axes`` when set, else are
 extracted from ``*_AXES`` assignments of the configured ``mesh-module``.
@@ -31,6 +35,20 @@ _CONSTRAINT_NAMES = (
     "jax.lax.with_sharding_constraint",
     "jax.experimental.pjit.with_sharding_constraint",
 )
+# collective → positional index of ``axis_name`` (keyword form also checked)
+_COLLECTIVE_AXIS_ARG = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
 
 
 class MeshAxisDrift(Rule):
@@ -53,10 +71,21 @@ class MeshAxisDrift(Rule):
             resolved = ctx.resolve(node.func)
             if resolved in _SPEC_NAMES:
                 args = list(node.args)
+                what = "PartitionSpec axes silently replicate (or crash " \
+                       "in GSPMD lowering)"
             elif resolved in _CONSTRAINT_NAMES and len(node.args) >= 2:
                 # direct string/tuple axis spec (P(...) args are caught by
                 # the PartitionSpec branch when that call appears inline)
                 args = [node.args[1]]
+                what = "PartitionSpec axes silently replicate (or crash " \
+                       "in GSPMD lowering)"
+            elif resolved in _COLLECTIVE_AXIS_ARG:
+                idx = _COLLECTIVE_AXIS_ARG[resolved]
+                args = [node.args[idx]] if len(node.args) > idx else []
+                args += [kw.value for kw in node.keywords
+                         if kw.arg == "axis_name"]
+                what = (f"{resolved.rsplit('.', 1)[-1]} over an unbound "
+                        "axis fails at trace time far from the typo")
             else:
                 continue
             for name, strnode in self._axis_strings(args):
@@ -65,8 +94,7 @@ class MeshAxisDrift(Rule):
                         ctx, strnode,
                         f"axis {name!r} is not a declared mesh axis "
                         f"({', '.join(sorted(axes))}) — stale or typo'd "
-                        "PartitionSpec axes silently replicate (or crash "
-                        "in GSPMD lowering)"))
+                        + what))
         return out
 
     def _axis_strings(self, args) -> Iterable[Tuple[str, ast.AST]]:

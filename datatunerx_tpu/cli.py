@@ -210,6 +210,9 @@ def cmd_serve(args):
             "--quantization", args.quantization,
             "--slots", str(args.slots),
             "--adapters", args.adapters,
+            "--kv_block_size", str(args.kv_block_size),
+            "--kv_blocks", str(args.kv_blocks),
+            "--prefill_token_budget", str(args.prefill_token_budget),
             "--replicas", str(max(args.replicas, 1)),
             "--policy", args.policy,
             "--max_queue", str(args.max_queue),
@@ -229,6 +232,9 @@ def cmd_serve(args):
         "--quantization", args.quantization,
         "--slots", str(args.slots),
         "--adapters", args.adapters,
+        "--kv_block_size", str(args.kv_block_size),
+        "--kv_blocks", str(args.kv_blocks),
+        "--prefill_token_budget", str(args.prefill_token_budget),
     ]
     return serving_main(argv)
 
@@ -352,6 +358,13 @@ def main(argv=None):
     vp.add_argument("--slots", type=int, default=4)
     vp.add_argument("--adapters", default="",
                     help="named LoRA adapters: name=ckpt[,name=ckpt…]")
+    vp.add_argument("--kv_block_size", type=int, default=0,
+                    help="paged KV cache block size in tokens (0 = dense)")
+    vp.add_argument("--kv_blocks", type=int, default=0,
+                    help="paged pool size in blocks (default: dense parity)")
+    vp.add_argument("--prefill_token_budget", type=int, default=0,
+                    help="prefill tokens per scheduler tick between decode "
+                         "chunks (0 = unbounded)")
     vp.add_argument("--replicas", type=int, default=1,
                     help="replica count; > 1 puts the gateway in front")
     vp.add_argument("--gateway", action="store_true",

@@ -9,9 +9,11 @@ of the same global batch, rather than Ray pushing dataset shards to actors.
 
 from __future__ import annotations
 
+import copy
 import csv
 import json
 import os
+import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -157,22 +159,55 @@ class StreamingBatchIterator:
         self.host_id = host_id
         self.num_hosts = num_hosts
         self.stage = stage
+        # per-thread tokenizer clones (see ensure_thread_safe_encoding)
+        self._tls = threading.local()
+        self._clone_encoders = False
 
     def steps_per_epoch(self) -> int:
         return -1  # unknown without a full pass; callers must use max_steps
 
+    def ensure_thread_safe_encoding(self) -> bool:
+        """Opt into per-thread tokenizer clones so this iterator can encode
+        inside a HostPrefetcher worker while another thread (in-training
+        generative eval) encodes with the original tokenizer.
+
+        HF fast tokenizers wrap one Rust object whose internal state two
+        threads must not borrow concurrently ("Already borrowed"
+        RuntimeError); a clone per encoding thread removes the sharing
+        entirely. Returns False — and leaves encoding untouched — when the
+        tokenizer cannot be cloned, in which case the caller must keep the
+        pipeline synchronous (tuning/train.py prints and falls back)."""
+        if self._clone_encoders:
+            return True
+        try:
+            copy.deepcopy(self.tokenizer)
+        except Exception:  # noqa: BLE001 — non-clonable → caller stays sync
+            return False
+        self._clone_encoders = True
+        return True
+
+    def _thread_tokenizer(self):
+        if not self._clone_encoders:
+            return self.tokenizer
+        tok = getattr(self._tls, "tokenizer", None)
+        if tok is None:
+            tok = copy.deepcopy(self.tokenizer)
+            self._tls.tokenizer = tok
+        return tok
+
     def _encoded(self) -> Iterator[Dict[str, List[int]]]:
         from datatunerx_tpu.data.preprocess import preprocess_pretrain_records
 
+        tokenizer = self._thread_tokenizer()  # one epoch runs on one thread
         for rec in self.dataset:
             if self.stage == "pt":
                 out = preprocess_pretrain_records(
-                    [rec], self.tokenizer,
+                    [rec], tokenizer,
                     cutoff_len=self.cutoff_len, columns=self.dataset.columns,
                 )
             else:
                 out = preprocess_records(
-                    [rec], self.template, self.tokenizer,
+                    [rec], self.template, tokenizer,
                     cutoff_len=self.cutoff_len, columns=self.dataset.columns,
                 )
             if out:

@@ -108,10 +108,21 @@ class Replica:
         raise NotImplementedError
 
     def stats(self) -> dict:
-        """{"slots_busy": int, "slots_total": int, "adapters": set|None}.
-        adapters=None means unknown — the router treats it as capable of
-        anything (load-on-demand fallback)."""
+        """{"slots_busy": int, "slots_total": int, "kv_blocks_free": int,
+        "kv_blocks_total": int, "adapters": set|None}.
+        kv_blocks_total 0 means the replica runs a dense cache (no block
+        signal); adapters=None means unknown — the router treats it as
+        capable of anything (load-on-demand fallback)."""
         raise NotImplementedError
+
+    def stats_snapshot(self) -> dict:
+        """Last-known stats WITHOUT doing any fetch work — for observability
+        paths (the gateway /metrics scrape handler) that must never block on
+        a slow replica. Local replicas answer live; remote replicas return
+        whatever the routing/stats path last cached (possibly stale on an
+        idle gateway — a stale gauge beats a scrape that hangs 2s per hung
+        replica)."""
+        return self.stats()
 
     # ------------------------------------------------------------ lifecycle
     def available(self) -> bool:
@@ -132,12 +143,22 @@ class Replica:
             self.inflight = max(0, self.inflight - 1)
 
     def busy_fraction(self) -> float:
-        """Load signal for least-busy routing: engine slot occupancy when the
-        replica exposes it, gateway-side in-flight count otherwise."""
+        """Load signal for least-busy routing. Paged replicas report KV
+        block occupancy — the gauge that actually bounds admission (a free
+        slot with no free blocks cannot take work) — combined with slot
+        occupancy (no free slot means no admission however many blocks
+        remain). Dense replicas fall back to slot occupancy, then to the
+        gateway-side in-flight count."""
         st = self.stats()
-        total = st.get("slots_total") or 0
-        if total > 0:
-            return st.get("slots_busy", 0) / total
+        slot_total = st.get("slots_total") or 0
+        slot_frac = (st.get("slots_busy", 0) / slot_total
+                     if slot_total > 0 else None)
+        block_total = st.get("kv_blocks_total") or 0
+        if block_total > 0:
+            block_frac = 1.0 - st.get("kv_blocks_free", 0) / block_total
+            return max(block_frac, slot_frac or 0.0)
+        if slot_frac is not None:
+            return slot_frac
         return float(self.inflight)
 
     def close(self):
@@ -202,6 +223,8 @@ class InProcessReplica(Replica):
         return {
             "slots_busy": busy,
             "slots_total": getattr(self.engine, "slots", 0),
+            "kv_blocks_free": getattr(self.engine, "free_kv_blocks", None) or 0,
+            "kv_blocks_total": getattr(self.engine, "total_kv_blocks", None) or 0,
             "adapters": set(adapter_ids) if adapter_ids is not None else None,
         }
 
@@ -316,7 +339,8 @@ class HTTPReplica(Replica):
         if (self._stats_cache is not None
                 and now - self._stats_at < self.stats_ttl_s):
             return self._stats_cache
-        out = {"slots_busy": 0, "slots_total": 0, "adapters": None}
+        out = {"slots_busy": 0, "slots_total": 0,
+               "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None}
         try:
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
@@ -325,11 +349,23 @@ class HTTPReplica(Replica):
                         out["slots_busy"] = int(float(line.split()[-1]))
                     elif line.startswith("dtx_serving_slots_total "):
                         out["slots_total"] = int(float(line.split()[-1]))
+                    elif line.startswith("dtx_serving_kv_blocks_free "):
+                        out["kv_blocks_free"] = int(float(line.split()[-1]))
+                    elif line.startswith("dtx_serving_kv_blocks_total "):
+                        out["kv_blocks_total"] = int(float(line.split()[-1]))
         except Exception:  # noqa: BLE001 — stats are advisory
             pass
         self._stats_cache = out
         self._stats_at = now
         return out
+
+    def stats_snapshot(self) -> dict:
+        """Never fetches: the last stats() result (routing keeps it warm
+        under any traffic), or all-zeros/unknown before the first fetch."""
+        if self._stats_cache is not None:
+            return self._stats_cache
+        return {"slots_busy": 0, "slots_total": 0,
+                "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None}
 
 
 class ReplicaPool:

@@ -257,9 +257,14 @@ class Gateway:
                "Per-replica health-probe verdict (0 = draining too).")
         busy = g("dtx_gateway_replica_inflight",
                  "Gateway-side in-flight requests per replica.")
+        blocks_free = g("dtx_gateway_replica_kv_blocks_free",
+                        "Free paged KV-cache blocks per replica — the "
+                        "admission headroom gauge (0 labels absent on "
+                        "dense-cache replicas).")
         circuit.clear()
         up.clear()
         busy.clear()
+        blocks_free.clear()
         for r in self.pool.replicas():
             state = r.breaker.state
             for s in ("closed", "half_open", "open"):
@@ -267,6 +272,15 @@ class Gateway:
                             {"replica": r.name, "state": s})
             up.set(1 if r.available() else 0, {"replica": r.name})
             busy.set(r.inflight, {"replica": r.name})
+            try:
+                # snapshot, not stats(): a scrape must never block on a hung
+                # replica's 2s-timeout fetch — routing keeps the cache warm
+                st = r.stats_snapshot()
+            except Exception:  # noqa: BLE001 — stats are advisory
+                st = {}
+            if st.get("kv_blocks_total"):
+                blocks_free.set(st.get("kv_blocks_free", 0),
+                                {"replica": r.name})
         return self.registry.expose()
 
     def scale(self, n: int) -> int:
@@ -693,6 +707,10 @@ def main(argv=None):
     p.add_argument("--adapters", default="")
     p.add_argument("--kv_quant", default="")
     p.add_argument("--prefix_cache", type=int, default=0)
+    p.add_argument("--kv_block_size", type=int, default=0)
+    p.add_argument("--kv_blocks", type=int, default=0)
+    p.add_argument("--prefill_chunk", type=int, default=256)
+    p.add_argument("--prefill_token_budget", type=int, default=0)
     args = p.parse_args(argv)
 
     if not args.replica_url and args.replicas <= 0:
@@ -733,7 +751,12 @@ def main(argv=None):
                        "--decode_chunk", str(args.decode_chunk),
                        "--adapters", args.adapters,
                        "--kv_quant", args.kv_quant,
-                       "--prefix_cache", str(args.prefix_cache)]
+                       "--prefix_cache", str(args.prefix_cache),
+                       "--kv_block_size", str(args.kv_block_size),
+                       "--kv_blocks", str(args.kv_blocks),
+                       "--prefill_chunk", str(args.prefill_chunk),
+                       "--prefill_token_budget",
+                       str(args.prefill_token_budget)]
         gw.replica_set = ManagedReplicaSet(
             pool, server_args, workdir=args.workdir or "gateway-replicas")
         gw.replica_set.scale(args.replicas)

@@ -31,7 +31,14 @@ import jax
 import jax.numpy as jnp
 
 from datatunerx_tpu.models.config import ModelConfig
-from datatunerx_tpu.ops.attention import attention, make_causal_bias
+from datatunerx_tpu.ops.attention import (
+    attention,
+    cache_positions_update,
+    kv_cache_update,
+    kv_cache_width,
+    make_causal_bias,
+)
+from datatunerx_tpu.ops.paged_attention import POS_SENTINEL
 from datatunerx_tpu.ops.rope import apply_rope, rope_cos_sin
 
 Params = Any  # nested dict pytree
@@ -123,11 +130,11 @@ def _proj(h, p, lora_p, lora_scale, drop_key=None, drop_rate=0.0,
     return out
 
 
-# Marks invalid/pad cache slots: the causal check kv_pos <= q_pos then masks
-# them with no separate validity plumbing. A plain int (NOT jnp.int32): a
-# module-level device array would initialize the XLA backend at import time,
-# breaking jax.distributed.initialize for multi-host trainer processes.
-POS_SENTINEL = 2**30
+# POS_SENTINEL (imported above) marks invalid/pad cache slots: the causal
+# check kv_pos <= q_pos masks them with no separate validity plumbing. The
+# paged block-pool cache (ops/paged_attention.py ``init_paged_cache``) is the
+# elastic alternative to this dense layout; both satisfy the same
+# ops/attention.py cache interface.
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
@@ -159,19 +166,6 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
         cache["k"] = jnp.zeros(shape, dtype)
         cache["v"] = jnp.zeros(shape, dtype)
     return cache
-
-
-def _kv_quantize(x: jnp.ndarray):
-    """[..., head_dim] → (int8 values, per-vector scale)."""
-    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
-    safe = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe[..., None]),
-                 -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def _kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype):
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def lm_logits(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -226,7 +220,7 @@ def forward(
         )
         x = x + (noise * mag).astype(x.dtype)
 
-    seq_len = T if cache is None else cache["k"].shape[2]
+    seq_len = T if cache is None else kv_cache_width(cache)
     cos, sin = rope_cos_sin(
         positions,
         cfg.head_dim,
@@ -243,23 +237,11 @@ def forward(
         kv_seg = segment_ids
         cache_pos = None
     else:
-        # record each new slot's rope position; pads (attention_mask 0) get the
-        # sentinel so the causal check masks them everywhere
-        pos_update = positions
-        if attention_mask is not None:
-            pos_update = jnp.where(attention_mask.astype(bool), positions,
-                                   POS_SENTINEL)
-        if cache["len"].ndim == 0:
-            cache_pos = jax.lax.dynamic_update_slice(
-                cache["pos"], pos_update, (0, cache["len"])
-            )
-        else:
-            # per-slot cursors: scatter each row at its own depth (OOB writes
-            # for exhausted slots are dropped by the default scatter mode)
-            rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-            idx = cache["len"][:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-            cache_pos = cache["pos"].at[rows, idx].set(pos_update)
-        kv_positions = cache_pos
+        # record each new slot's rope position; pads (attention_mask 0) get
+        # the sentinel so the causal check masks them everywhere. The paged
+        # cache returns the gathered per-slot linear view as kv_positions.
+        cache_pos, kv_positions = cache_positions_update(
+            cache, positions, attention_mask)
         kv_valid = None  # sentinel positions handle both unwritten and pads
         kv_seg = None
     # flash/ring kernels skip the [B, T, S] bias entirely (building it would
@@ -323,31 +305,10 @@ def forward(
         k = apply_rope(k, cos, sin)
 
         if ck is not None:
-            start = cache["len"]
-            if cks is not None:  # int8 cache: quantize new k/v on write
-                k_w, ks_w = _kv_quantize(k)
-                v_w, vs_w = _kv_quantize(v)
-            else:
-                k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
-            if start.ndim == 0:
-                ck = jax.lax.dynamic_update_slice(ck, k_w, (0, start, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, v_w, (0, start, 0, 0))
-                if cks is not None:
-                    cks = jax.lax.dynamic_update_slice(cks, ks_w, (0, start, 0))
-                    cvs = jax.lax.dynamic_update_slice(cvs, vs_w, (0, start, 0))
-            else:
-                rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-                idx = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-                ck = ck.at[rows, idx].set(k_w)
-                cv = cv.at[rows, idx].set(v_w)
-                if cks is not None:
-                    cks = cks.at[rows, idx].set(ks_w)
-                    cvs = cvs.at[rows, idx].set(vs_w)
-            if cks is not None:
-                k_att = _kv_dequantize(ck, cks, q.dtype)
-                v_att = _kv_dequantize(cv, cvs, q.dtype)
-            else:
-                k_att, v_att = ck.astype(q.dtype), cv.astype(q.dtype)
+            # dense (scalar/per-slot cursor) or paged (block-table) write +
+            # full-width read via the shared cache interface (ops/attention)
+            ck, cv, cks, cvs, k_att, v_att = kv_cache_update(
+                cache, ck, cv, cks, cvs, k, v)
         else:
             k_att, v_att = k, v
 
@@ -405,6 +366,8 @@ def forward(
         if quant_kv:
             new_cache["k_scale"] = new_ks
             new_cache["v_scale"] = new_vs
+        if "block_tables" in cache:
+            new_cache["block_tables"] = cache["block_tables"]
     if return_hidden:
         # final-norm hidden states, for value heads (reward modelling)
         return logits, new_cache, x

@@ -8,12 +8,26 @@ for long sequences, and ring attention over a mesh axis for sequence parallelism
 
 Shapes: q [B, T, H, d]; k, v [B, S, KV, d] with H = KV * G (GQA).
 Bias is additive, broadcastable to [B, 1|H, T, S]; softmax runs in f32.
+
+This module also owns the serving KV-cache interface the model writes and
+reads through (``cache_positions_update`` / ``kv_cache_update``): a cache
+dict with ``block_tables`` takes the paged block-pool path
+(ops/paged_attention.py); otherwise the dense contiguous layouts
+(scalar-cursor prefill rows, per-slot-cursor continuous batching). The int8
+``kv_quant`` representation is shared by both.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from datatunerx_tpu.ops.paged_attention import (
+    POS_SENTINEL,
+    paged_kv_update,
+    paged_record_positions,
+    paged_view_width,
+)
 
 
 def make_causal_bias(
@@ -60,6 +74,102 @@ def xla_attention(
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
     return out.reshape(B, T, H, d)
+
+
+# ------------------------------------------------------- KV cache interface
+
+def kv_quantize(x: jnp.ndarray):
+    """[..., head_dim] → (int8 values, per-vector scale)."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def kv_cache_width(cache: dict) -> int:
+    """Linear key width attention sees for one slot — the rope ``seq_len``
+    (dynamic-NTK inflation keys off it, ops/rope.py)."""
+    if "block_tables" in cache:
+        return paged_view_width(cache)
+    return cache["k"].shape[2]
+
+
+def cache_positions_update(cache: dict, positions: jnp.ndarray,
+                           attention_mask):
+    """Record the new tokens' rope positions at each slot's write cursor.
+
+    Returns ``(pos_state, kv_positions)``: the updated position state (dense
+    [B, S] table, or the paged [NB, bs] pool) and the per-slot linear
+    position view ``[B, W]`` the causal bias masks against. Pads
+    (attention_mask 0) get POS_SENTINEL so they are masked everywhere."""
+    pos_update = positions
+    if attention_mask is not None:
+        pos_update = jnp.where(attention_mask.astype(bool), positions,
+                               POS_SENTINEL)
+    if "block_tables" in cache:
+        return paged_record_positions(cache, pos_update)
+    B, T = positions.shape
+    if cache["len"].ndim == 0:
+        cache_pos = jax.lax.dynamic_update_slice(
+            cache["pos"], pos_update, (0, cache["len"]))
+    else:
+        # per-slot cursors: scatter each row at its own depth (OOB writes
+        # for exhausted slots are dropped by the default scatter mode)
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        idx = cache["len"][:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        cache_pos = cache["pos"].at[rows, idx].set(pos_update)
+    return cache_pos, cache_pos
+
+
+def kv_cache_update(cache: dict, ck, cv, cks, cvs, k, v):
+    """One layer's cache write + full-width read.
+
+    ``ck``/``cv`` (and int8 scale pools ``cks``/``cvs``) are the layer-peeled
+    cache leaves the scan threads; ``k``/``v`` the new tokens' projections
+    [B, T, KV, d]. Returns the updated leaves plus ``k_att``/``v_att`` — the
+    [B, W, KV, d] views attention reads, dequantized when quantized."""
+    if cks is not None:  # int8 cache: quantize new k/v on write
+        k_w, ks_w = kv_quantize(k)
+        v_w, vs_w = kv_quantize(v)
+    else:
+        k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
+        ks_w = vs_w = None
+    if "block_tables" in cache:
+        ck, cv, cks, cvs, k_all, v_all, ks_all, vs_all = paged_kv_update(
+            ck, cv, cks, cvs, cache["block_tables"], cache["len"],
+            k_w, v_w, ks_w, vs_w)
+        if cks is not None:
+            return ck, cv, cks, cvs, \
+                kv_dequantize(k_all, ks_all, k.dtype), \
+                kv_dequantize(v_all, vs_all, v.dtype)
+        return ck, cv, cks, cvs, k_all.astype(k.dtype), v_all.astype(v.dtype)
+    B, T = k.shape[0], k.shape[1]
+    start = cache["len"]
+    if start.ndim == 0:
+        ck = jax.lax.dynamic_update_slice(ck, k_w, (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_w, (0, start, 0, 0))
+        if cks is not None:
+            cks = jax.lax.dynamic_update_slice(cks, ks_w, (0, start, 0))
+            cvs = jax.lax.dynamic_update_slice(cvs, vs_w, (0, start, 0))
+    else:
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        idx = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        ck = ck.at[rows, idx].set(k_w)
+        cv = cv.at[rows, idx].set(v_w)
+        if cks is not None:
+            cks = cks.at[rows, idx].set(ks_w)
+            cvs = cvs.at[rows, idx].set(vs_w)
+    if cks is not None:
+        k_att = kv_dequantize(ck, cks, k.dtype)
+        v_att = kv_dequantize(cv, cvs, v.dtype)
+    else:
+        k_att, v_att = ck.astype(k.dtype), cv.astype(v.dtype)
+    return ck, cv, cks, cvs, k_att, v_att
 
 
 def attention(

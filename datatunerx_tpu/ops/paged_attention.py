@@ -1,0 +1,235 @@
+"""Paged KV cache: block pool + per-slot block tables (vLLM PagedAttention,
+Sarathi chunked prefill — PAPERS.md).
+
+The dense serving cache reserves ``slots × max_seq_len`` KV rows up front, so
+a 40-token chat strands the other 984 positions of its slot in HBM for its
+whole lifetime. Here the cache is a POOL of fixed-size blocks
+(``block_size`` tokens each, shaped ``[L, num_blocks, block_size, KV, d]``)
+plus a per-slot block table mapping linear cache positions to physical
+blocks. Admission reserves ``ceil((prompt + max_new) / block_size)`` blocks
+from a host-side free list instead of a full-width row, so short requests
+release most of the HBM a dense slot would strand and the same pool admits
+more concurrent work (or the same work in less HBM).
+
+Reads go through a GATHER over the block table: the slot's blocks are
+gathered back into a ``[B, blocks_per_slot × block_size]`` linear view and
+attention runs over it exactly as over a dense row — the gathered view is
+element-identical to the dense layout (token at linear index ``i`` lives in
+block ``i // block_size`` at offset ``i % block_size``), so paged and dense
+decode produce the same tokens. Unallocated table entries (-1) gather block
+0's values but their rope positions are forced to ``POS_SENTINEL``, which
+the causal bias masks exactly like a dense cache's unwritten tail. Writes
+scatter through the table; invalid targets (exhausted slot, -1 entry) map to
+index ``num_blocks`` — out of bounds, which JAX scatter drops.
+
+The int8 ``kv_quant`` path is preserved: scale pools are paged alongside the
+value pools with the same tables.
+
+This module is wired into the model through ``ops/attention.py``'s cache
+interface (``cache_positions_update`` / ``kv_cache_update``): a cache dict
+carrying ``block_tables`` takes the paged path, anything else the dense one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Marks invalid/pad cache slots: the causal check kv_pos <= q_pos then masks
+# them with no separate validity plumbing. A plain int (NOT jnp.int32): a
+# module-level device array would initialize the XLA backend at import time,
+# breaking jax.distributed.initialize for multi-host trainer processes.
+POS_SENTINEL = 2**30
+
+
+class BlockAllocator:
+    """Host-side free-list over the physical block pool.
+
+    The scheduler thread is the only allocator writer, but gauges
+    (``/metrics``, gateway stats) read ``free_count`` from HTTP threads —
+    hence the lock. Blocks are handed out lowest-id-first and returned to
+    the head of the free list, so tests can assert deterministic reuse."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+        self._lock = threading.Lock()
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Reserve ``n`` blocks; None (and no change) when the pool can't
+        cover the request — the caller keeps the request queued."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if n > len(self._free):
+                return None
+            out, self._free = self._free[:n], self._free[n:]
+            return out
+
+    def free(self, blocks: List[int]):
+        if not blocks:
+            return
+        with self._lock:
+            self._free = sorted(blocks) + self._free
+
+
+def init_paged_cache(cfg, slots: int, num_blocks: int, block_size: int,
+                     blocks_per_slot: int, dtype=jnp.bfloat16,
+                     quantize: Optional[str] = None) -> Dict:
+    """Block-pool KV cache. ``block_tables`` is ``[slots, blocks_per_slot]``
+    int32 (-1 = unallocated); ``len`` is the per-slot linear write cursor;
+    ``pos`` records each written token's rope position per (block, offset)."""
+    L = cfg.num_layers
+    shape = (L, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    cache: Dict = {
+        "len": jnp.zeros((slots,), jnp.int32),
+        "pos": jnp.full((num_blocks, block_size), POS_SENTINEL, jnp.int32),
+        "block_tables": jnp.full((slots, blocks_per_slot), -1, jnp.int32),
+    }
+    if quantize == "int8":
+        cache["k"] = jnp.zeros(shape, jnp.int8)
+        cache["v"] = jnp.zeros(shape, jnp.int8)
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    elif quantize:
+        raise ValueError(f"unsupported cache quantization {quantize!r}")
+    else:
+        cache["k"] = jnp.zeros(shape, dtype)
+        cache["v"] = jnp.zeros(shape, dtype)
+    return cache
+
+
+def paged_view_width(cache: Dict) -> int:
+    """Linear width of the gathered per-slot view (= dense-row equivalent)."""
+    return cache["block_tables"].shape[1] * cache["k"].shape[2]
+
+
+def _write_targets(tables: jnp.ndarray, lens: jnp.ndarray, T: int,
+                   block_size: int, num_blocks: int):
+    """Physical (block, offset) for the next ``T`` linear positions of each
+    slot. Invalid targets (slot exhausted, table entry -1) get physical index
+    ``num_blocks`` — out of bounds, so the scatter drops them."""
+    idx = lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    blk, off = idx // block_size, idx % block_size
+    nbps = tables.shape[1]
+    tbl = jnp.take_along_axis(tables, jnp.clip(blk, 0, nbps - 1), axis=1)
+    phys = jnp.where((blk < nbps) & (tbl >= 0), tbl, num_blocks)
+    return phys, off
+
+
+def _gather_tables(tables: jnp.ndarray) -> jnp.ndarray:
+    """Table with -1 entries clamped to block 0 (gather must stay in
+    bounds; the garbage it reads is masked via sentinel positions)."""
+    return jnp.where(tables >= 0, tables, 0)
+
+
+def paged_record_positions(cache: Dict, pos_update: jnp.ndarray):
+    """Scatter the new tokens' rope positions through the block tables and
+    return ``(new_pos_pool, kv_positions [B, W])`` — the gathered linear
+    position view attention's causal bias masks against. Lanes backed by no
+    block read as POS_SENTINEL."""
+    tables, lens, pool = cache["block_tables"], cache["len"], cache["pos"]
+    num_blocks, block_size = pool.shape
+    phys, off = _write_targets(tables, lens, pos_update.shape[1],
+                               block_size, num_blocks)
+    new_pool = pool.at[phys, off].set(pos_update)
+    gathered = new_pool[_gather_tables(tables)]  # [B, nbps, bs]
+    gathered = jnp.where((tables >= 0)[:, :, None], gathered, POS_SENTINEL)
+    return new_pool, gathered.reshape(tables.shape[0], -1)
+
+
+def paged_kv_update(ck, cv, cks, cvs, tables, lens, k_w, v_w, ks_w, vs_w):
+    """Per-layer paged write + gathered read.
+
+    ``ck``/``cv`` are one layer's pools ``[NB, bs, KV, d]`` (the layer scan
+    peels the leading L axis); ``k_w``/``v_w`` the new tokens ``[B, T, KV,
+    d]``. Returns updated pools plus the gathered ``[B, W, KV, d]`` views
+    attention reads — element-identical to a dense row for every written
+    lane, sentinel-masked elsewhere."""
+    num_blocks, block_size = ck.shape[0], ck.shape[1]
+    B = k_w.shape[0]
+    phys, off = _write_targets(tables, lens, k_w.shape[1],
+                               block_size, num_blocks)
+    ck = ck.at[phys, off].set(k_w)
+    cv = cv.at[phys, off].set(v_w)
+    if cks is not None:
+        cks = cks.at[phys, off].set(ks_w)
+        cvs = cvs.at[phys, off].set(vs_w)
+    tbl = _gather_tables(tables)
+    k_all = ck[tbl].reshape(B, -1, ck.shape[-2], ck.shape[-1])
+    v_all = cv[tbl].reshape(B, -1, cv.shape[-2], cv.shape[-1])
+    ks_all = cks[tbl].reshape(B, -1, cks.shape[-1]) if cks is not None else None
+    vs_all = cvs[tbl].reshape(B, -1, cvs.shape[-1]) if cvs is not None else None
+    return ck, cv, cks, cvs, k_all, v_all, ks_all, vs_all
+
+
+# --------------------------------------------------------- row import/export
+def _row_targets(table_row: jnp.ndarray, width: int, block_size: int,
+                 num_blocks: int):
+    idx = jnp.arange(width, dtype=jnp.int32)
+    blk, off = idx // block_size, idx % block_size
+    nbps = table_row.shape[0]
+    tbl = table_row[jnp.clip(blk, 0, nbps - 1)]
+    phys = jnp.where((blk < nbps) & (tbl >= 0), tbl, num_blocks)
+    return phys, off
+
+
+def paged_insert_row(cache: Dict, slot, table_row: jnp.ndarray,
+                     row_cache: Dict) -> Dict:
+    """Scatter a dense single-row cache (a prefill/prefix-cache product,
+    ``k [L, 1, W, KV, d]``) into the slot's blocks and install its table.
+    Positions beyond the row's cursor are POS_SENTINEL in the row already,
+    so writing the full width doubles as the block scrub. Linear positions
+    past the slot's allocation are dropped (no block — nothing to strand)."""
+    num_blocks, block_size = cache["pos"].shape
+    W = row_cache["k"].shape[2]
+    phys, off = _row_targets(table_row, W, block_size, num_blocks)
+    out = dict(cache)
+    out["block_tables"] = jax.lax.dynamic_update_slice(
+        cache["block_tables"], table_row[None], (slot, 0))
+    out["k"] = cache["k"].at[:, phys, off].set(row_cache["k"][:, 0])
+    out["v"] = cache["v"].at[:, phys, off].set(row_cache["v"][:, 0])
+    if "k_scale" in cache:
+        out["k_scale"] = cache["k_scale"].at[:, phys, off].set(
+            row_cache["k_scale"][:, 0])
+        out["v_scale"] = cache["v_scale"].at[:, phys, off].set(
+            row_cache["v_scale"][:, 0])
+    out["pos"] = cache["pos"].at[phys, off].set(row_cache["pos"][0])
+    return out
+
+
+def paged_extract_row(cache: Dict, slot, cursor) -> Dict:
+    """Gather a slot's blocks back into a dense single-row cache (the
+    prefix-cache storage format, width = blocks_per_slot × block_size =
+    max_seq_len). The inverse of ``paged_insert_row``; ``cursor`` becomes
+    the row's scalar write cursor so suffix extension picks up exactly where
+    the prompt ended."""
+    nbps = cache["block_tables"].shape[1]
+    table_row = jax.lax.dynamic_slice(
+        cache["block_tables"], (slot, 0), (1, nbps))[0]
+    tbl = _gather_tables(table_row)
+    L = cache["k"].shape[0]
+    kv, d = cache["k"].shape[-2], cache["k"].shape[-1]
+    W = nbps * cache["k"].shape[2]
+    row: Dict = {
+        "k": cache["k"][:, tbl].reshape(L, 1, W, kv, d),
+        "v": cache["v"][:, tbl].reshape(L, 1, W, kv, d),
+        "len": jnp.asarray(cursor, jnp.int32),
+    }
+    if "k_scale" in cache:
+        row["k_scale"] = cache["k_scale"][:, tbl].reshape(L, 1, W, kv)
+        row["v_scale"] = cache["v_scale"][:, tbl].reshape(L, 1, W, kv)
+    pos = cache["pos"][tbl]  # [nbps, bs]
+    pos = jnp.where((table_row >= 0)[:, None], pos, POS_SENTINEL)
+    row["pos"] = pos.reshape(1, W)
+    return row

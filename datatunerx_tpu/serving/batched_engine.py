@@ -9,6 +9,18 @@ generate.go:160-329 deploys LlamaDeployment replicas), rebuilt TPU-first:
 - per-slot KV cache cursors (models/llama.py ``init_cache(per_slot=True)``):
   rows sit at different depths inside one program; sentinel rope positions
   mask free/garbage slots, so no per-slot programs and no re-batching pauses;
+- PAGED KV cache (``kv_block_size > 0``, ops/paged_attention.py): the cache
+  is a pool of fixed-size blocks + per-slot block tables instead of dense
+  ``slots × max_seq_len`` rows. Admission reserves ``ceil((prompt +
+  max_new) / block_size)`` blocks from a free list — a short chat no longer
+  strands a full-width row of HBM, so a smaller pool (``kv_blocks``) carries
+  the same traffic, or the same pool carries more slots;
+- CHUNKED PREFILL (paged mode): a cold prompt prefills directly into its
+  slot's blocks in ``prefill_chunk``-token programs, interleaved with decode
+  — the scheduler spends at most ``prefill_token_budget`` prefill tokens
+  between decode chunks, so one long prompt can no longer stall every
+  in-flight decode for its whole prefill (Sarathi-style stall-free
+  scheduling; bounds TTFT and TPOT under mixed long/short load);
 - decode runs in CHUNKS of K tokens per program (``lax.scan`` over the
   single-token step): K amortizes dispatch latency (fatal over a tunneled
   accelerator at K=1) while keeping admission latency bounded at K tokens;
@@ -21,6 +33,7 @@ generate.go:160-329 deploys LlamaDeployment replicas), rebuilt TPU-first:
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -32,7 +45,15 @@ import numpy as np
 from datatunerx_tpu.data.templates import Template, get_template
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.models.lora import LORA_TARGETS, lora_scaling
+from datatunerx_tpu.ops.paged_attention import (
+    POS_SENTINEL,
+    BlockAllocator,
+    init_paged_cache,
+    paged_extract_row,
+    paged_insert_row,
+)
 from datatunerx_tpu.serving.engine import _sample_jit
+from datatunerx_tpu.utils.decoding import DECODE_BUCKET
 from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
 
 MAX_STOP = 8  # static per-slot stop-token capacity
@@ -176,135 +197,62 @@ def load_checkpoint_state(checkpoint_path: str) -> dict:
     step = step if step is not None else mngr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoint under {checkpoint_path}")
-    restored = mngr.restore(step)
+    from datatunerx_tpu.training.checkpoint import restore_raw_state
+
+    restored = restore_raw_state(mngr, step)
     mngr.close()
     state = restored if isinstance(restored, dict) else dict(restored)
     state["_scaling"] = InferenceEngine._manifest_lora_scaling(root)
     return state
 
 
-class BatchedEngine:
-    def __init__(
-        self,
-        model_path: str,
-        checkpoint_path: Optional[str] = None,
-        adapters: Optional[Dict[str, str]] = None,  # name -> checkpoint path
-        template: str = "llama2",
-        max_seq_len: int = 1024,
-        slots: int = 4,
-        decode_chunk: int = 8,
-        dtype=jnp.bfloat16,
-        kv_quant: Optional[str] = None,  # "int8" halves cache HBM
-        prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
-    ):
-        # serving is single-program: clear any mesh a Trainer left in the
-        # process-global flash context before the engine's jits first trace
-        from datatunerx_tpu.ops.flash_attention import set_flash_context
+# Bounded LRU: each entry pins the donor engine's closure (its jitted bound
+# methods) + the compiled executables, so an unbounded dict would leak across
+# a long-lived process cycling many distinct configs. 8 covers any realistic
+# set of concurrently-live serving configs; evicted entries free their
+# executables once the owning engines are gone.
+_PROGRAM_MEMO: "collections.OrderedDict" = collections.OrderedDict()
+_PROGRAM_MEMO_MAX = 8
 
-        set_flash_context(None)
-        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
-            model_path, dtype=dtype
-        )
-        self.template: Template = get_template(template, self.tokenizer)
-        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
-        self.slots = slots
-        self.chunk = max(1, decode_chunk)
 
-        # ---- adapters: checkpoint_path becomes adapter "default" (unmerged);
-        # full-param checkpoints swap the base instead
-        named: Dict[str, str] = dict(adapters or {})
-        if checkpoint_path:
-            state = load_checkpoint_state(checkpoint_path)
-            if state.get("lora"):
-                named.setdefault("default", checkpoint_path)
-            elif state.get("params"):
-                self.params = jax.device_put(state["params"])
-        self.adapter_ids: Dict[str, int] = {"": 0}  # 0 = base (zero adapter)
-        self.lora_stack: Optional[tuple] = None
-        if named:
-            self._build_adapter_stack(named)
+def _program_memo_key(cfg, max_seq_len: int, kv_quant, named_adapters):
+    """Hashable identity of the engine's traced programs, or None when it
+    can't be established (exotic values → compile fresh). The dataclass repr
+    covers every model-config field deterministically; the adapter mapping is
+    order-sensitive because load order fixes the name→stack-index binding the
+    closure constants encode."""
+    try:
+        return (repr(cfg), int(max_seq_len), kv_quant,
+                tuple(named_adapters.items()))
+    except Exception:  # noqa: BLE001 — memoization is best-effort
+        return None
 
-        self.kv_quant = kv_quant or None
-        self._cache = init_cache(self.cfg, slots, self.max_seq_len,
-                                 dtype=jnp.bfloat16, per_slot=True,
-                                 quantize=self.kv_quant)
-        V = self.cfg.vocab_size
-        self._logits = jnp.zeros((slots, V), jnp.float32)
-        self._pos = jnp.zeros((slots,), jnp.int32)
-        self._remaining = jnp.zeros((slots,), jnp.int32)
-        self._active = jnp.zeros((slots,), bool)
-        self._rng = jnp.stack([jax.random.PRNGKey(i) for i in range(slots)])
-        self._temps = jnp.zeros((slots,), jnp.float32)
-        self._top_ps = jnp.ones((slots,), jnp.float32)
-        self._stops = jnp.full((slots, MAX_STOP), -1, jnp.int32)
-        self._adapter_idx = jnp.zeros((slots,), jnp.int32)
 
-        self._slot_req: List[Optional[Request]] = [None] * slots
-        self._waiting: "queue.Queue[Request]" = queue.Queue()
-        self._wake = threading.Event()
-        self._shutdown = threading.Event()
+class _Programs:
+    """The engine's jitted device programs, factored OFF the engine so the
+    process-wide memo pins only what tracing actually reads — the model
+    config, two cache-geometry scalars, and the (small) LoRA adapter stack —
+    never a donor engine's full params or live KV pool. Everything else
+    (params, cache, per-slot decode state) arrives as an argument, which is
+    what makes the programs shareable across engines in the first place."""
 
-        self._prefill = jax.jit(self._prefill_impl,
-                                static_argnames=("prompt_len",))
-        self._extend = jax.jit(self._extend_impl,
-                               static_argnames=("suffix_len",))
-        self._insert = jax.jit(self._insert_impl)
-        self._decode = jax.jit(self._decode_impl, static_argnames=("K",))
-
-        self._prefix = _PrefixCache(prefix_cache) if prefix_cache > 0 else None
-        # observability: how admissions were served (tests + /metrics)
-        self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
-
-        self._thread = threading.Thread(target=self._scheduler, daemon=True)
-        self._thread.start()
-
-    # ------------------------------------------------------------- adapters
-    def _build_adapter_stack(self, named: Dict[str, str]):
-        """Stack named adapter checkpoints into [L, E, …] leaves (entry 0 is
-        the all-zero base adapter). Mixed ranks are padded to the max rank
-        (zero cols/rows leave the delta unchanged); mixed target sets take
-        the union with zeros where an adapter lacks a target."""
-        from datatunerx_tpu.models.lora import target_dims
-
-        loaded: List[Tuple[str, dict, float]] = []
-        for name, path in named.items():
-            state = load_checkpoint_state(path)
-            lora = state.get("lora")
-            if not lora:
-                raise ValueError(f"adapter {name!r}: no lora tree in {path}")
-            layers = lora["layers"]
-            rank = next(iter(layers.values()))["a"].shape[-1]
-            scaling = state.get("_scaling")
-            if scaling is None:
-                scaling = lora_scaling(32.0, rank)
-            loaded.append((name, layers, float(scaling)))
-
-        targets = sorted({t for _, layers, _ in loaded for t in layers}
-                         & set(LORA_TARGETS))
-        max_rank = max(
-            layers[t]["a"].shape[-1]
-            for _, layers, _ in loaded for t in layers
-        )
-        L = self.cfg.num_layers
-        E = len(loaded) + 1  # + base zero adapter
-        stack: Dict[str, dict] = {}
-        for t in targets:
-            d_in, d_out = target_dims(self.cfg, t)
-            a = np.zeros((L, E, d_in, max_rank), np.float32)
-            b = np.zeros((L, E, max_rank, d_out), np.float32)
-            for e, (_, layers, _) in enumerate(loaded, start=1):
-                if t not in layers:
-                    continue
-                ar = np.asarray(layers[t]["a"], np.float32)  # [L, d_in, r]
-                br = np.asarray(layers[t]["b"], np.float32)
-                r = ar.shape[-1]
-                a[:, e, :, :r] = ar
-                b[:, e, :r, :] = br
-            stack[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
-        scales = jnp.asarray([0.0] + [s for _, _, s in loaded], jnp.float32)
-        self.lora_stack = ({"layers": stack}, scales)
-        for e, (name, _, _) in enumerate(loaded, start=1):
-            self.adapter_ids[name] = e
+    def __init__(self, cfg, max_seq_len: int, kv_quant,
+                 lora_stack: Optional[tuple]):
+        self.cfg = cfg
+        self.max_seq_len = max_seq_len
+        self.kv_quant = kv_quant
+        self.lora_stack = lora_stack
+        self.prefill = jax.jit(self._prefill_impl,
+                               static_argnames=("prompt_len",))
+        self.extend = jax.jit(self._extend_impl,
+                              static_argnames=("suffix_len",))
+        self.insert = jax.jit(self._insert_impl)
+        self.insert_paged = jax.jit(self._insert_paged_impl)
+        self.activate = jax.jit(self._activate_impl)
+        self.prefill_chunk = jax.jit(self._prefill_chunk_impl,
+                                     static_argnames=("chunk_len",))
+        self.extract = jax.jit(paged_extract_row)
+        self.decode = jax.jit(self._decode_impl, static_argnames=("K",))
 
     def _lora_args(self):
         if self.lora_stack is None:
@@ -312,7 +260,6 @@ class BatchedEngine:
         tree, scales = self.lora_stack
         return {"lora": (tree, scales)}
 
-    # --------------------------------------------------------------- jitted
     def _prefill_impl(self, params, tokens, mask, positions, adapter_idx, *,
                       prompt_len: int):
         cache = init_cache(self.cfg, 1, self.max_seq_len, dtype=jnp.bfloat16,
@@ -370,6 +317,75 @@ class BatchedEngine:
             rng.at[slot].set(jax.random.PRNGKey(seed)),
         )
 
+    def _insert_paged_impl(self, cache, logits_all, pos, remaining, active,
+                           temps, top_ps, stops, adapter_idx, rng,
+                           slot, table_row, row_cache, row_logits, cursor,
+                           n_prompt, max_new, temp, top_p, stop_row, adapter,
+                           seed):
+        """Paged twin of ``_insert_impl``: scatter a dense prefill/prefix row
+        into the slot's allocated blocks (installing its block table) and arm
+        the slot's decode state."""
+        cache = paged_insert_row(cache, slot, table_row, row_cache)
+        cache["len"] = jax.lax.dynamic_update_slice(
+            cache["len"], cursor[None], (slot,))
+        return (
+            cache,
+            logits_all.at[slot].set(row_logits),
+            pos.at[slot].set(n_prompt),
+            remaining.at[slot].set(max_new),
+            active.at[slot].set(True),
+            temps.at[slot].set(temp),
+            top_ps.at[slot].set(top_p),
+            stops.at[slot].set(stop_row),
+            adapter_idx.at[slot].set(adapter),
+            rng.at[slot].set(jax.random.PRNGKey(seed)),
+        )
+
+    def _activate_impl(self, logits_all, pos, remaining, active, temps,
+                       top_ps, stops, adapter_idx, rng,
+                       slot, row_logits, n_prompt, max_new, temp, top_p,
+                       stop_row, adapter, seed):
+        """Arm a slot whose prompt was already chunk-prefilled in place (its
+        KV lives in the slot's blocks; only the decode state needs setting)."""
+        return (
+            logits_all.at[slot].set(row_logits),
+            pos.at[slot].set(n_prompt),
+            remaining.at[slot].set(max_new),
+            active.at[slot].set(True),
+            temps.at[slot].set(temp),
+            top_ps.at[slot].set(top_p),
+            stops.at[slot].set(stop_row),
+            adapter_idx.at[slot].set(adapter),
+            rng.at[slot].set(jax.random.PRNGKey(seed)),
+        )
+
+    def _prefill_chunk_impl(self, params, cache, slot, tokens, mask,
+                            positions, adapter_idx, *, chunk_len: int):
+        """One ``chunk_len``-token prefill program writing straight into one
+        slot's blocks of the SHARED pool — the chunk-bounded generalisation of
+        ``_prefill_impl``/``_extend_impl``. Returns the chunk's last-token
+        logits (only the final chunk's are consumed) and the updated cache."""
+        nbps = cache["block_tables"].shape[1]
+        view = dict(cache)
+        view["len"] = jax.lax.dynamic_slice(cache["len"], (slot,), (1,))
+        view["block_tables"] = jax.lax.dynamic_slice(
+            cache["block_tables"], (slot, 0), (1, nbps))
+        logits, new = forward(
+            params, tokens, self.cfg, positions=positions,
+            attention_mask=mask, cache=view,
+            lora_adapter_idx=(adapter_idx[None]
+                              if self.lora_stack is not None else None),
+            compute_dtype=jnp.bfloat16, **self._lora_args(),
+        )
+        out = dict(cache)
+        for key in ("k", "v", "k_scale", "v_scale"):
+            if key in out:
+                out[key] = new[key]
+        out["pos"] = new["pos"]
+        out["len"] = jax.lax.dynamic_update_slice(
+            cache["len"], new["len"], (slot,))
+        return logits[0, chunk_len - 1], out
+
     def _decode_impl(self, params, cache, logits, pos, remaining, active, rng,
                      temps, top_ps, stops, adapter_idx, *, K: int):
         lora_kw = self._lora_args()
@@ -405,55 +421,280 @@ class BatchedEngine:
         )
         return emitted, logits, cache, pos, remaining, active, rng
 
+
+class BatchedEngine:
+    def __init__(
+        self,
+        model_path: str,
+        checkpoint_path: Optional[str] = None,
+        adapters: Optional[Dict[str, str]] = None,  # name -> checkpoint path
+        template: str = "llama2",
+        max_seq_len: int = 1024,
+        slots: int = 4,
+        decode_chunk: int = 8,
+        dtype=jnp.bfloat16,
+        kv_quant: Optional[str] = None,  # "int8" halves cache HBM
+        prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
+        kv_block_size: int = 0,  # >0: paged block-pool cache (elastic HBM)
+        kv_blocks: Optional[int] = None,  # pool size; default = dense parity
+        prefill_chunk: int = 256,  # chunked-prefill program length (paged)
+        prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
+    ):
+        # serving is single-program: clear any mesh a Trainer left in the
+        # process-global flash context before the engine's jits first trace
+        from datatunerx_tpu.ops.flash_attention import set_flash_context
+
+        set_flash_context(None)
+        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
+            model_path, dtype=dtype
+        )
+        self.template: Template = get_template(template, self.tokenizer)
+        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
+        self.slots = slots
+        self.chunk = max(1, decode_chunk)
+
+        # ---- adapters: checkpoint_path becomes adapter "default" (unmerged);
+        # full-param checkpoints swap the base instead
+        named: Dict[str, str] = dict(adapters or {})
+        if checkpoint_path:
+            state = load_checkpoint_state(checkpoint_path)
+            if state.get("lora"):
+                named.setdefault("default", checkpoint_path)
+            elif state.get("params"):
+                self.params = jax.device_put(state["params"])
+        self.adapter_ids: Dict[str, int] = {"": 0}  # 0 = base (zero adapter)
+        self.lora_stack: Optional[tuple] = None
+        if named:
+            self._build_adapter_stack(named)
+
+        self.kv_quant = kv_quant or None
+        self.paged = kv_block_size > 0
+        self.block_size = int(kv_block_size)
+        self._allocator: Optional[BlockAllocator] = None
+        if self.paged:
+            if self.max_seq_len % self.block_size:
+                raise ValueError(
+                    f"kv_block_size {self.block_size} must divide "
+                    f"max_seq_len {self.max_seq_len}")
+            self.blocks_per_slot = self.max_seq_len // self.block_size
+            total_blocks = int(kv_blocks or slots * self.blocks_per_slot)
+            if total_blocks < self.blocks_per_slot:
+                raise ValueError(
+                    f"kv_blocks {total_blocks} cannot hold one full-length "
+                    f"request ({self.blocks_per_slot} blocks of "
+                    f"{self.block_size})")
+            self._allocator = BlockAllocator(total_blocks)
+            self._cache = init_paged_cache(
+                self.cfg, slots, total_blocks, self.block_size,
+                self.blocks_per_slot, dtype=jnp.bfloat16,
+                quantize=self.kv_quant)
+        else:
+            self._cache = init_cache(self.cfg, slots, self.max_seq_len,
+                                     dtype=jnp.bfloat16, per_slot=True,
+                                     quantize=self.kv_quant)
+        # chunked prefill runs in bucket-multiple programs so the compile
+        # count stays bounded (chunk lengths ∈ multiples of DECODE_BUCKET)
+        self.prefill_chunk = max(
+            DECODE_BUCKET, -(-int(prefill_chunk) // DECODE_BUCKET) * DECODE_BUCKET)
+        # the budget is a HARD bound (prefill chunks are clamped to the
+        # remaining budget each tick), so round it up to the bucket quantum —
+        # a sub-bucket budget could never admit a chunk and would starve
+        # prefill outright
+        budget = max(0, int(prefill_token_budget))
+        self.prefill_token_budget = (
+            -(-budget // DECODE_BUCKET) * DECODE_BUCKET if budget else 0)
+        V = self.cfg.vocab_size
+        self._logits = jnp.zeros((slots, V), jnp.float32)
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        self._remaining = jnp.zeros((slots,), jnp.int32)
+        self._active = jnp.zeros((slots,), bool)
+        self._rng = jnp.stack([jax.random.PRNGKey(i) for i in range(slots)])
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._top_ps = jnp.ones((slots,), jnp.float32)
+        self._stops = jnp.full((slots, MAX_STOP), -1, jnp.int32)
+        self._adapter_idx = jnp.zeros((slots,), jnp.int32)
+
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        self._decode_ready: List[bool] = [False] * slots
+        # slot → in-progress chunked-prefill state, in admission order
+        self._pending: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()
+        self._waiting: "queue.Queue[Request]" = queue.Queue()
+        self._waiting_head: Optional[Request] = None  # block-starved FIFO head
+        self._wake = threading.Event()
+        self._shutdown = threading.Event()
+        # scheduler-tick trace, for tests and TTFT/TPOT forensics:
+        # ("admit", slot, plen, mode) / ("prefill", slot, ntokens) /
+        # ("activate", slot) / ("decode", K) / ("finish", slot)
+        self.sched_trace: "collections.deque[tuple]" = \
+            collections.deque(maxlen=4096)
+
+        # Process-wide program memo (the Trainer step-memo pattern,
+        # training/train_lib.py): engines built from an equal (model config,
+        # max_seq_len, kv_quant, adapter mapping) trace identical programs —
+        # everything else the jitted fns touch arrives as an argument, and
+        # dense/paged/slot-count variation lives in argument shapes jax
+        # already keys on — so they share one _Programs holder and with it
+        # jax's in-memory executable cache. Side-by-side paged/dense engines
+        # (parity tests, the serve bench's paged-vs-dense runs, blue/green
+        # replica swaps in one process) compile each program once instead of
+        # once per engine; doubly important on jax 0.4.x where the
+        # persistent compile cache is unusable (tests/conftest.py).
+        # Adapter engines share only on an identical ordered name→checkpoint
+        # mapping: adapter weights enter the trace as closure constants, so
+        # the mapping IS the program identity (checkpoint contents are
+        # assumed stable within a process; the ORDER fixes name→index).
+        key = _program_memo_key(self.cfg, self.max_seq_len, self.kv_quant,
+                                named)
+        progs = None if key is None else _PROGRAM_MEMO.get(key)
+        if progs is None:
+            progs = _Programs(self.cfg, self.max_seq_len, self.kv_quant,
+                              self.lora_stack)
+            if key is not None:
+                _PROGRAM_MEMO[key] = progs
+                while len(_PROGRAM_MEMO) > _PROGRAM_MEMO_MAX:
+                    _PROGRAM_MEMO.popitem(last=False)
+        else:
+            _PROGRAM_MEMO.move_to_end(key)
+        self._prefill = progs.prefill
+        self._extend = progs.extend
+        self._insert = progs.insert
+        self._insert_paged = progs.insert_paged
+        self._activate = progs.activate
+        self._prefill_chunk_fn = progs.prefill_chunk
+        self._extract = progs.extract
+        self._decode = progs.decode
+
+        self._prefix = _PrefixCache(prefix_cache) if prefix_cache > 0 else None
+        # observability: how admissions were served (tests + /metrics)
+        self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
+
+        self._thread = threading.Thread(target=self._scheduler, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ block pool
+    @property
+    def total_kv_blocks(self) -> Optional[int]:
+        return self._allocator.num_blocks if self._allocator else None
+
+    @property
+    def free_kv_blocks(self) -> Optional[int]:
+        return self._allocator.free_count if self._allocator else None
+
+    # ------------------------------------------------------------- adapters
+    def _build_adapter_stack(self, named: Dict[str, str]):
+        """Stack named adapter checkpoints into [L, E, …] leaves (entry 0 is
+        the all-zero base adapter). Mixed ranks are padded to the max rank
+        (zero cols/rows leave the delta unchanged); mixed target sets take
+        the union with zeros where an adapter lacks a target."""
+        from datatunerx_tpu.models.lora import target_dims
+
+        loaded: List[Tuple[str, dict, float]] = []
+        for name, path in named.items():
+            state = load_checkpoint_state(path)
+            lora = state.get("lora")
+            if not lora:
+                raise ValueError(f"adapter {name!r}: no lora tree in {path}")
+            layers = lora["layers"]
+            rank = next(iter(layers.values()))["a"].shape[-1]
+            scaling = state.get("_scaling")
+            if scaling is None:
+                scaling = lora_scaling(32.0, rank)
+            loaded.append((name, layers, float(scaling)))
+
+        targets = sorted({t for _, layers, _ in loaded for t in layers}
+                         & set(LORA_TARGETS))
+        max_rank = max(
+            layers[t]["a"].shape[-1]
+            for _, layers, _ in loaded for t in layers
+        )
+        L = self.cfg.num_layers
+        E = len(loaded) + 1  # + base zero adapter
+        stack: Dict[str, dict] = {}
+        for t in targets:
+            d_in, d_out = target_dims(self.cfg, t)
+            a = np.zeros((L, E, d_in, max_rank), np.float32)
+            b = np.zeros((L, E, max_rank, d_out), np.float32)
+            for e, (_, layers, _) in enumerate(loaded, start=1):
+                if t not in layers:
+                    continue
+                ar = np.asarray(layers[t]["a"], np.float32)  # [L, d_in, r]
+                br = np.asarray(layers[t]["b"], np.float32)
+                r = ar.shape[-1]
+                a[:, e, :, :r] = ar
+                b[:, e, :r, :] = br
+            stack[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+        scales = jnp.asarray([0.0] + [s for _, _, s in loaded], jnp.float32)
+        self.lora_stack = ({"layers": stack}, scales)
+        for e, (name, _, _) in enumerate(loaded, start=1):
+            self.adapter_ids[name] = e
+
+    def _lora_args(self):
+        if self.lora_stack is None:
+            return {"lora": None}
+        tree, scales = self.lora_stack
+        return {"lora": (tree, scales)}
+
     # ------------------------------------------------------------ scheduler
-    def _prefill_row(self, ids, mask, positions, plen, n_prompt, adapter,
-                     budget_needed: int = 1):
-        """Produce (last-token logits, row cache, cache cursor) for a prompt,
-        going through the prefix cache when enabled: exact hit = no compute,
-        prefix hit = suffix-only extension, miss = full prefill (+ store).
+    def _prefix_key(self, ids, plen, n_prompt, adapter):
+        return (tuple(ids[plen - n_prompt:]), adapter)
+
+    def _prefill_row_cached(self, ids, plen, n_prompt, adapter,
+                            budget_needed: int):
+        """Prefix-cache paths only: (logits, dense row, cursor) on an exact
+        hit (no compute) or a strict-prefix hit (suffix-only extension);
+        None on miss or when the cache is disabled.
 
         Reuse must never change the response: a cached row whose cursor sits
         deeper than this request's own plen (extension padding accumulates)
         is only used when it still leaves ``budget_needed`` decode room —
         otherwise the cold path runs, so budget and output match a cache-cold
         server exactly."""
-        from datatunerx_tpu.utils.decoding import DECODE_BUCKET
-
-        used = tuple(ids[plen - n_prompt:])
-        key = (used, adapter)
+        if self._prefix is None:
+            return None
+        used, _ = key = self._prefix_key(ids, plen, n_prompt, adapter)
         # the decode room the cold path would provide; reuse may not shrink
         # the effective budget below min(requested, cold)
-        cold_budget = self.max_seq_len - plen
-        need = min(budget_needed, cold_budget)
-        if self._prefix is not None:
-            ent = self._prefix.get(key)
-            if ent is not None and self.max_seq_len - ent["cursor"] >= need:
-                self.prefill_stats["reuse"] += 1
-                return ent["logits"], ent["cache"], ent["cursor"]
-            pkey, pent = self._prefix.longest_prefix(used, adapter)
-            if pent is not None:
-                n_pref = len(pkey[0])
-                suffix = list(used[n_pref:])
-                pad = (-len(suffix)) % DECODE_BUCKET
-                stoks = [self.tokenizer.eos_token_id or 0] * pad + suffix
-                smask = [0] * pad + [1] * len(suffix)
-                spos = [0] * pad + list(range(n_pref, len(used)))
-                cursor = pent["cursor"] + len(stoks)
-                if self.max_seq_len - cursor >= need:
-                    row_logits, row_cache = self._extend(
-                        self.params, pent["cache"],
-                        jnp.asarray([stoks], jnp.int32),
-                        jnp.asarray([smask], jnp.int32),
-                        jnp.asarray([spos], jnp.int32),
-                        jnp.asarray(adapter, jnp.int32),
-                        suffix_len=len(stoks),
-                    )
-                    self.prefill_stats["extend"] += 1
-                    self._prefix.put(key, {"cache": row_cache,
-                                           "logits": row_logits,
-                                           "cursor": cursor})
-                    return row_logits, row_cache, cursor
+        need = min(budget_needed, self.max_seq_len - plen)
+        ent = self._prefix.get(key)
+        if ent is not None and self.max_seq_len - ent["cursor"] >= need:
+            self.prefill_stats["reuse"] += 1
+            return ent["logits"], ent["cache"], ent["cursor"]
+        pkey, pent = self._prefix.longest_prefix(used, adapter)
+        if pent is not None:
+            n_pref = len(pkey[0])
+            suffix = list(used[n_pref:])
+            pad = (-len(suffix)) % DECODE_BUCKET
+            stoks = [self.tokenizer.eos_token_id or 0] * pad + suffix
+            smask = [0] * pad + [1] * len(suffix)
+            spos = [0] * pad + list(range(n_pref, len(used)))
+            cursor = pent["cursor"] + len(stoks)
+            if self.max_seq_len - cursor >= need:
+                row_logits, row_cache = self._extend(
+                    self.params, pent["cache"],
+                    jnp.asarray([stoks], jnp.int32),
+                    jnp.asarray([smask], jnp.int32),
+                    jnp.asarray([spos], jnp.int32),
+                    jnp.asarray(adapter, jnp.int32),
+                    suffix_len=len(stoks),
+                )
+                self.prefill_stats["extend"] += 1
+                self._prefix.put(key, {"cache": row_cache,
+                                       "logits": row_logits,
+                                       "cursor": cursor})
+                return row_logits, row_cache, cursor
+        return None
 
+    def _prefill_row(self, ids, mask, positions, plen, n_prompt, adapter,
+                     budget_needed: int = 1):
+        """Produce (last-token logits, row cache, cache cursor) for a prompt,
+        going through the prefix cache when enabled: exact hit = no compute,
+        prefix hit = suffix-only extension, miss = full prefill (+ store)."""
+        hit = self._prefill_row_cached(ids, plen, n_prompt, adapter,
+                                       budget_needed)
+        if hit is not None:
+            return hit
         row_logits, row_cache = self._prefill(
             self.params, jnp.asarray([ids], jnp.int32),
             jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
@@ -461,57 +702,239 @@ class BatchedEngine:
         )
         self.prefill_stats["full"] += 1
         if self._prefix is not None:
-            self._prefix.put(key, {"cache": row_cache, "logits": row_logits,
-                                   "cursor": plen})
+            self._prefix.put(self._prefix_key(ids, plen, n_prompt, adapter),
+                             {"cache": row_cache, "logits": row_logits,
+                              "cursor": plen})
         return row_logits, row_cache, plen
 
-    def _admit(self, req: Request, slot: int):
+    @staticmethod
+    def _stop_row(req: Request) -> np.ndarray:
+        row = np.full((MAX_STOP,), -1, np.int32)
+        row[: len(req.stop_ids)] = req.stop_ids
+        return row
+
+    def _arm_args(self, req: Request, n_prompt: int, max_new: int):
+        """The per-slot decode-state scalars _insert/_insert_paged/_activate
+        all share."""
+        return (
+            jnp.asarray(n_prompt, jnp.int32), jnp.asarray(max_new, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(self._stop_row(req)),
+            jnp.asarray(req.adapter, jnp.int32),
+            jnp.asarray(req.seed, jnp.uint32),
+        )
+
+    def _admit(self, req: Request, slot: int) -> bool:
+        """Occupy ``slot`` with ``req``. Dense mode prefills monolithically
+        and arms the slot at once. Paged mode reserves blocks first (False =
+        pool exhausted; the request stays queued), serves prefix-cache hits
+        by scattering the row into the blocks, and registers everything else
+        for chunked prefill interleaved with decode."""
         from datatunerx_tpu.utils.decoding import prepare_prompt
 
         ids, mask, positions, plen, n_prompt, max_new, _ = prepare_prompt(
             req.prompt_ids, self.tokenizer.eos_token_id,
             self.max_seq_len, req.max_new_tokens,
         )
-        row_logits, row_cache, cursor = self._prefill_row(
-            ids, mask, positions, plen, n_prompt, req.adapter,
-            budget_needed=max_new)
-        max_new = max(1, min(max_new, self.max_seq_len - cursor))
-        stop_row = np.full((MAX_STOP,), -1, np.int32)
-        stop_row[: len(req.stop_ids)] = req.stop_ids
-        (self._cache, self._logits, self._pos, self._remaining, self._active,
-         self._temps, self._top_ps, self._stops, self._adapter_idx,
-         self._rng) = self._insert(
-            self._cache, self._logits, self._pos, self._remaining, self._active,
-            self._temps, self._top_ps, self._stops, self._adapter_idx, self._rng,
-            jnp.asarray(slot, jnp.int32), row_cache, row_logits,
-            # the slot's write cursor continues from the row's real KV depth
-            # (prefix reuse can sit deeper than this request's own plen)
-            jnp.asarray(cursor, jnp.int32), jnp.asarray(n_prompt, jnp.int32),
-            jnp.asarray(max_new, jnp.int32),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_p, jnp.float32),
-            jnp.asarray(stop_row), jnp.asarray(req.adapter, jnp.int32),
-            jnp.asarray(req.seed, jnp.uint32),
-        )
+        if not self.paged:
+            row_logits, row_cache, cursor = self._prefill_row(
+                ids, mask, positions, plen, n_prompt, req.adapter,
+                budget_needed=max_new)
+            max_new = max(1, min(max_new, self.max_seq_len - cursor))
+            (self._cache, self._logits, self._pos, self._remaining,
+             self._active, self._temps, self._top_ps, self._stops,
+             self._adapter_idx, self._rng) = self._insert(
+                self._cache, self._logits, self._pos, self._remaining,
+                self._active, self._temps, self._top_ps, self._stops,
+                self._adapter_idx, self._rng,
+                jnp.asarray(slot, jnp.int32), row_cache, row_logits,
+                # the slot's write cursor continues from the row's real KV
+                # depth (prefix reuse can sit deeper than this request's plen)
+                jnp.asarray(cursor, jnp.int32),
+                *self._arm_args(req, n_prompt, max_new),
+            )
+            self._slot_req[slot] = req
+            self._decode_ready[slot] = True
+            self._trace("admit", slot, plen, "dense")
+            return True
+
+        hit = self._prefill_row_cached(ids, plen, n_prompt, req.adapter,
+                                       budget_needed=max_new)
+        if hit is not None:
+            row_logits, row_cache, cursor = hit
+            max_new = max(1, min(max_new, self.max_seq_len - cursor))
+            blocks = self._alloc_blocks(cursor + max_new)
+            if blocks is None:
+                return False
+            try:
+                (self._cache, self._logits, self._pos, self._remaining,
+                 self._active, self._temps, self._top_ps, self._stops,
+                 self._adapter_idx, self._rng) = self._insert_paged(
+                    self._cache, self._logits, self._pos, self._remaining,
+                    self._active, self._temps, self._top_ps, self._stops,
+                    self._adapter_idx, self._rng,
+                    jnp.asarray(slot, jnp.int32), self._table_row(blocks),
+                    row_cache, row_logits, jnp.asarray(cursor, jnp.int32),
+                    *self._arm_args(req, n_prompt, max_new),
+                )
+            except Exception:
+                self._allocator.free(blocks)
+                raise
+            self._slot_blocks[slot] = blocks
+            self._slot_req[slot] = req
+            self._decode_ready[slot] = True
+            self._trace("admit", slot, plen, "cache")
+            return True
+
+        blocks = self._alloc_blocks(plen + max_new)
+        if blocks is None:
+            return False
+        try:
+            # install the table, scrub the blocks' recycled positions to the
+            # sentinel (chunked prefill reveals the whole table to attention
+            # before every lane is written), and rewind the slot cursor
+            self._cache["block_tables"] = \
+                self._cache["block_tables"].at[slot].set(self._table_row(blocks))
+            self._cache["pos"] = self._cache["pos"].at[
+                jnp.asarray(blocks, jnp.int32)].set(POS_SENTINEL)
+            self._cache["len"] = self._cache["len"].at[slot].set(0)
+        except Exception:
+            self._allocator.free(blocks)
+            raise
+        self._slot_blocks[slot] = blocks
         self._slot_req[slot] = req
+        self._decode_ready[slot] = False
+        self._pending[slot] = {
+            "req": req, "ids": ids, "mask": mask, "positions": positions,
+            "plen": plen, "n_prompt": n_prompt, "max_new": max_new,
+            "adapter": req.adapter, "done": 0,
+            "key": self._prefix_key(ids, plen, n_prompt, req.adapter),
+        }
+        self._trace("admit", slot, plen, "chunked")
+        return True
+
+    def _alloc_blocks(self, depth: int) -> Optional[List[int]]:
+        return self._allocator.alloc(-(-depth // self.block_size))
+
+    def _table_row(self, blocks: List[int]) -> jnp.ndarray:
+        row = np.full((self.blocks_per_slot,), -1, np.int32)
+        row[: len(blocks)] = blocks
+        return jnp.asarray(row)
+
+    def _trace(self, *event):
+        self.sched_trace.append(event)
+
+    def _take_waiting(self) -> Optional[Request]:
+        if self._waiting_head is not None:
+            req, self._waiting_head = self._waiting_head, None
+            return req
+        try:
+            return self._waiting.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _admit_waiting(self):
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None:
+                continue
+            req = self._take_waiting()
+            if req is None:
+                break
+            try:
+                if not self._admit(req, slot):
+                    # pool exhausted: the FIFO head waits for freed blocks
+                    # (younger requests must not starve it by sneaking in)
+                    self._waiting_head = req
+                    break
+            except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+                req.finish(error=str(e))
+
+    def _prefill_tick(self):
+        """Spend AT MOST ``prefill_token_budget`` prompt tokens on pending
+        chunked prefills (admission order), then yield back to decode. The
+        bound is hard: the last chunk of a tick is clamped to the remaining
+        budget (all three operands — prefill_chunk, the budget, and plen,
+        whose kept-prompt cap prepare_prompt floors to a bucket multiple — are
+        bucket multiples, so the clamp never produces an off-bucket program).
+        A budget of 0 prefills every pending prompt to completion."""
+        if not self._pending:
+            return
+        budget = self.prefill_token_budget or float("inf")
+        spent = 0
+        for slot in list(self._pending.keys()):
+            st = self._pending[slot]
+            req = st["req"]
+            while spent < budget:
+                c = min(self.prefill_chunk, st["plen"] - st["done"],
+                        budget - spent)
+                lo = st["done"]
+                try:
+                    logits, self._cache = self._prefill_chunk_fn(
+                        self.params, self._cache,
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray([st["ids"][lo:lo + c]], jnp.int32),
+                        jnp.asarray([st["mask"][lo:lo + c]], jnp.int32),
+                        jnp.asarray([st["positions"][lo:lo + c]], jnp.int32),
+                        jnp.asarray(st["adapter"], jnp.int32),
+                        chunk_len=c,
+                    )
+                except Exception as e:  # noqa: BLE001 — fail request, not loop
+                    self._release_slot(slot)
+                    req.finish(error=str(e))
+                    break
+                st["done"] += c
+                spent += c
+                self._trace("prefill", slot, c)
+                if st["done"] >= st["plen"]:
+                    self._finish_prefill(slot, st, logits)
+                    break
+            if spent >= budget:
+                break
+
+    def _finish_prefill(self, slot: int, st: dict, row_logits):
+        del self._pending[slot]
+        req = st["req"]
+        max_new = max(1, min(st["max_new"], self.max_seq_len - st["plen"]))
+        (self._logits, self._pos, self._remaining, self._active, self._temps,
+         self._top_ps, self._stops, self._adapter_idx, self._rng) = \
+            self._activate(
+                self._logits, self._pos, self._remaining, self._active,
+                self._temps, self._top_ps, self._stops, self._adapter_idx,
+                self._rng, jnp.asarray(slot, jnp.int32), row_logits,
+                *self._arm_args(req, st["n_prompt"], max_new),
+            )
+        self._decode_ready[slot] = True
+        self.prefill_stats["full"] += 1
+        if self._prefix is not None:
+            # export the slot's blocks as a dense row so later prompts can
+            # reuse/extend this prefix exactly like in dense mode
+            row = self._extract(self._cache, jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(st["plen"], jnp.int32))
+            self._prefix.put(st["key"], {"cache": row, "logits": row_logits,
+                                         "cursor": st["plen"]})
+        self._trace("activate", slot)
+
+    def _release_slot(self, slot: int):
+        self._slot_req[slot] = None
+        self._pending.pop(slot, None)
+        self._decode_ready[slot] = False
+        blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+        if blocks:
+            # clear the table FIRST: a masked decode write from this slot
+            # must never land in a block the allocator has already re-issued
+            self._cache["block_tables"] = \
+                self._cache["block_tables"].at[slot].set(-1)
+            self._allocator.free(blocks)
 
     def _scheduler(self):
         while not self._shutdown.is_set():
-            admitted = False
-            for slot in range(self.slots):
-                if self._slot_req[slot] is not None:
-                    continue
-                try:
-                    req = self._waiting.get_nowait()
-                except queue.Empty:
-                    break
-                try:
-                    self._admit(req, slot)
-                    admitted = True
-                except Exception as e:  # noqa: BLE001 — fail the request, not the loop
-                    req.finish(error=str(e))
+            self._admit_waiting()
+            self._prefill_tick()
 
-            if not any(r is not None for r in self._slot_req):
+            if not any(self._decode_ready):
+                if self._pending:
+                    continue  # keep prefilling; nothing to decode yet
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
                 continue
@@ -523,6 +946,7 @@ class BatchedEngine:
                     self._remaining, self._active, self._rng, self._temps,
                     self._top_ps, self._stops, self._adapter_idx, K=self.chunk,
                 )
+                self._trace("decode", self.chunk)
                 # the decode loop's ONE designed sync point: K tokens per
                 # chunk cross to host here so req.push can stream them
                 emitted_np = np.asarray(emitted)  # [K, S]  # dtxlint: disable=DTX001
@@ -530,8 +954,8 @@ class BatchedEngine:
             except Exception as e:  # noqa: BLE001 — device fault: fail all in-flight
                 for slot, req in enumerate(self._slot_req):
                     if req is not None:
+                        self._release_slot(slot)
                         req.finish(error=str(e))
-                        self._slot_req[slot] = None
                 continue
 
             for k in range(emitted_np.shape[0]):
@@ -543,11 +967,13 @@ class BatchedEngine:
                         req.push(t)
             for slot in range(self.slots):
                 req = self._slot_req[slot]
-                if req is not None and not bool(active_np[slot]):
+                # pending-prefill slots are inactive by design — only slots
+                # that entered this decode chunk can finish here
+                if (req is not None and self._decode_ready[slot]
+                        and not bool(active_np[slot])):
+                    self._release_slot(slot)
                     req.finish()
-                    self._slot_req[slot] = None
-            # `admitted` intentionally unused beyond debugging
-            del admitted
+                    self._trace("finish", slot)
 
     # ---------------------------------------------------------------- API
     def submit(

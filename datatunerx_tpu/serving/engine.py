@@ -11,6 +11,7 @@ SURVEY.md §7.1).
 
 from __future__ import annotations
 
+import collections
 import os
 from typing import List, Optional
 
@@ -22,108 +23,39 @@ from datatunerx_tpu.data.templates import Template, get_template
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
 
+# Bounded LRU of shared _EnginePrograms — see the memo note in
+# InferenceEngine.__init__. Entries pin only the model config (params arrive
+# as arguments), so a dead donor engine's weights are never kept resident;
+# the dict evicts least-recently-used configs.
+_ENGINE_MEMO: collections.OrderedDict = collections.OrderedDict()
+_ENGINE_MEMO_MAX = 8
 
-class InferenceEngine:
-    def __init__(
-        self,
-        model_path: str,
-        checkpoint_path: Optional[str] = None,
-        template: str = "llama2",
-        max_seq_len: int = 1024,
-        dtype=jnp.bfloat16,
-        quantization: Optional[str] = None,
-    ):
-        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
-            model_path, dtype=dtype
-        )
-        if checkpoint_path:
-            self._apply_checkpoint(checkpoint_path)
-        if quantization:
-            # serve-time weight quantization (int8 ≈ half, nf4 ≈ quarter of
-            # bf16 HBM). Quantize on the HOST, then upload only the quantized
-            # tree — quantizing on-device would need full-precision + quantized
-            # resident simultaneously, OOMing exactly the big-model case this
-            # feature exists for.
-            import dataclasses
 
-            from datatunerx_tpu.ops.quant import quantize_model_params
+def _engine_memo_key(cfg):
+    """Hashable program identity, or None when it can't be established
+    (memoization is best-effort; the dataclass repr covers every field)."""
+    try:
+        return repr(cfg)
+    except Exception:  # noqa: BLE001
+        return None
 
-            host_params = jax.device_get(self.params)
-            cpu = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
-            if cpu is not None:
-                with jax.default_device(cpu):
-                    qparams = quantize_model_params(host_params, quantization)
-                self.params = jax.device_put(jax.device_get(qparams))
-            else:
-                self.params = quantize_model_params(host_params, quantization)
-            self.cfg = dataclasses.replace(self.cfg, quantization=quantization)
-        self.template: Template = get_template(template, self.tokenizer)
-        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
-        self._prefill = jax.jit(self._prefill_impl, static_argnames=("prompt_len",))
+
+class _EnginePrograms:
+    """The engine's jitted (prefill, decode_loop) pair, factored OFF the
+    engine (the BatchedEngine ``_Programs`` pattern) so the process-wide memo
+    pins only what tracing actually reads — the model config. Params, cache,
+    and sampling state all arrive as arguments, which is what makes the
+    programs shareable across engines in the first place."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.prefill = jax.jit(self._prefill_impl,
+                               static_argnames=("prompt_len",))
         # whole decode loop in ONE device program (lax.while_loop): per-token
         # Python dispatch costs ~RTT each — fatal over a tunneled accelerator
-        self._decode_loop = jax.jit(
-            self._decode_loop_impl, static_argnames=("max_new_tokens",)
-        )
+        self.decode_loop = jax.jit(self._decode_loop_impl,
+                                   static_argnames=("max_new_tokens",))
 
-    # ---------------------------------------------------------- checkpoint
-    def _apply_checkpoint(self, checkpoint_path: str):
-        """Merge a trained adapter (or swap full params) from an Orbax
-        TrainState checkpoint or an exported model.npz directory."""
-        if os.path.isdir(checkpoint_path) and os.path.exists(
-            os.path.join(checkpoint_path, "model.npz")
-        ):
-            from datatunerx_tpu.utils.hf_convert import convert_hf_state_dict
-
-            sd = dict(np.load(os.path.join(checkpoint_path, "model.npz")))
-            self.params = convert_hf_state_dict(sd, self.cfg, dtype=np.float32)
-            return
-        # Orbax checkpoint dir (…/checkpoints or …/checkpoints/<step>)
-        import orbax.checkpoint as ocp
-
-        root = checkpoint_path.rstrip("/")
-        step: Optional[int] = None
-        if os.path.basename(root).isdigit():
-            step = int(os.path.basename(root))
-            root = os.path.dirname(root)
-        mngr = ocp.CheckpointManager(root)
-        step = step if step is not None else mngr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {checkpoint_path}")
-        restored = mngr.restore(step)
-        mngr.close()
-        state = restored if isinstance(restored, dict) else dict(restored)
-        lora = state.get("lora")
-        if lora:
-            from datatunerx_tpu.models.lora import lora_scaling, merge_lora
-
-            rank = next(iter(lora["layers"].values()))["a"].shape[-1]
-            scaling = self._manifest_lora_scaling(root)
-            if scaling is None:
-                # manifest absent (ad-hoc checkpoint dir): fall back to the
-                # reference defaults alpha=32 / r (cmd/tuning/parser.py:138-145)
-                scaling = lora_scaling(32.0, rank)
-            self.params = merge_lora(self.params, lora, scaling)
-        elif state.get("params"):
-            self.params = state["params"]
-
-    @staticmethod
-    def _manifest_lora_scaling(ckpt_root: str):
-        """The completion manifest (written next to the checkpoints dir by
-        tuning/train.py) records the trained adapter's alpha/rank scaling;
-        merging with any other value serves a silently-wrong model."""
-        from datatunerx_tpu.training.checkpoint import read_manifest
-
-        run_dir = os.path.dirname(ckpt_root.rstrip("/"))
-        try:
-            manifest = read_manifest(os.path.dirname(run_dir),
-                                     os.path.basename(run_dir))
-            val = (manifest or {}).get("lora_scaling")
-            return float(val) if val is not None else None
-        except (OSError, ValueError, TypeError):
-            return None
-
-    # ------------------------------------------------------------ generate
     def _prefill_impl(self, params, tokens, mask, positions, cache, prompt_len):
         logits, cache = forward(
             params, tokens, self.cfg, positions=positions,
@@ -167,6 +99,122 @@ class InferenceEngine:
         )
         return out, i
 
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model_path: str,
+        checkpoint_path: Optional[str] = None,
+        template: str = "llama2",
+        max_seq_len: int = 1024,
+        dtype=jnp.bfloat16,
+        quantization: Optional[str] = None,
+    ):
+        self.cfg, self.params, self.tokenizer = load_model_and_tokenizer(
+            model_path, dtype=dtype
+        )
+        if checkpoint_path:
+            self._apply_checkpoint(checkpoint_path)
+        if quantization:
+            # serve-time weight quantization (int8 ≈ half, nf4 ≈ quarter of
+            # bf16 HBM). Quantize on the HOST, then upload only the quantized
+            # tree — quantizing on-device would need full-precision + quantized
+            # resident simultaneously, OOMing exactly the big-model case this
+            # feature exists for.
+            import dataclasses
+
+            from datatunerx_tpu.ops.quant import quantize_model_params
+
+            host_params = jax.device_get(self.params)
+            cpu = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
+            if cpu is not None:
+                with jax.default_device(cpu):
+                    qparams = quantize_model_params(host_params, quantization)
+                self.params = jax.device_put(jax.device_get(qparams))
+            else:
+                self.params = quantize_model_params(host_params, quantization)
+            self.cfg = dataclasses.replace(self.cfg, quantization=quantization)
+        self.template: Template = get_template(template, self.tokenizer)
+        self.max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
+        # Process-wide program memo (the BatchedEngine / Trainer step-memo
+        # pattern): the traced programs depend on the engine only through cfg
+        # — params, cache, and sampling state all arrive as arguments — so
+        # engines with an equal config share one set of jitted callables and
+        # jax's in-memory executable cache (N single-slot engines in one
+        # process compile once, not N times).
+        key = _engine_memo_key(self.cfg)
+        progs = None if key is None else _ENGINE_MEMO.get(key)
+        if progs is None:
+            progs = _EnginePrograms(self.cfg)
+            if key is not None:
+                _ENGINE_MEMO[key] = progs
+                while len(_ENGINE_MEMO) > _ENGINE_MEMO_MAX:
+                    _ENGINE_MEMO.popitem(last=False)
+        else:
+            _ENGINE_MEMO.move_to_end(key)
+        self._prefill = progs.prefill
+        self._decode_loop = progs.decode_loop
+
+    # ---------------------------------------------------------- checkpoint
+    def _apply_checkpoint(self, checkpoint_path: str):
+        """Merge a trained adapter (or swap full params) from an Orbax
+        TrainState checkpoint or an exported model.npz directory."""
+        if os.path.isdir(checkpoint_path) and os.path.exists(
+            os.path.join(checkpoint_path, "model.npz")
+        ):
+            from datatunerx_tpu.utils.hf_convert import convert_hf_state_dict
+
+            sd = dict(np.load(os.path.join(checkpoint_path, "model.npz")))
+            self.params = convert_hf_state_dict(sd, self.cfg, dtype=np.float32)
+            return
+        # Orbax checkpoint dir (…/checkpoints or …/checkpoints/<step>)
+        import orbax.checkpoint as ocp
+
+        root = checkpoint_path.rstrip("/")
+        step: Optional[int] = None
+        if os.path.basename(root).isdigit():
+            step = int(os.path.basename(root))
+            root = os.path.dirname(root)
+        mngr = ocp.CheckpointManager(root)
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_path}")
+        from datatunerx_tpu.training.checkpoint import restore_raw_state
+
+        restored = restore_raw_state(mngr, step)
+        mngr.close()
+        state = restored if isinstance(restored, dict) else dict(restored)
+        lora = state.get("lora")
+        if lora:
+            from datatunerx_tpu.models.lora import lora_scaling, merge_lora
+
+            rank = next(iter(lora["layers"].values()))["a"].shape[-1]
+            scaling = self._manifest_lora_scaling(root)
+            if scaling is None:
+                # manifest absent (ad-hoc checkpoint dir): fall back to the
+                # reference defaults alpha=32 / r (cmd/tuning/parser.py:138-145)
+                scaling = lora_scaling(32.0, rank)
+            self.params = merge_lora(self.params, lora, scaling)
+        elif state.get("params"):
+            self.params = state["params"]
+
+    @staticmethod
+    def _manifest_lora_scaling(ckpt_root: str):
+        """The completion manifest (written next to the checkpoints dir by
+        tuning/train.py) records the trained adapter's alpha/rank scaling;
+        merging with any other value serves a silently-wrong model."""
+        from datatunerx_tpu.training.checkpoint import read_manifest
+
+        run_dir = os.path.dirname(ckpt_root.rstrip("/"))
+        try:
+            manifest = read_manifest(os.path.dirname(run_dir),
+                                     os.path.basename(run_dir))
+            val = (manifest or {}).get("lora_scaling")
+            return float(val) if val is not None else None
+        except (OSError, ValueError, TypeError):
+            return None
+
+    # ------------------------------------------------------------ generate
     def generate(
         self,
         prompt_ids: List[int],

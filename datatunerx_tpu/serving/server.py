@@ -103,6 +103,14 @@ class Handler(BaseHTTPRequestHandler):
             lines.append(f"dtx_serving_slots_busy {busy}")
             lines.append("# TYPE dtx_serving_slots_total gauge")
             lines.append(f"dtx_serving_slots_total {eng.slots}")
+        # paged KV cache: FREE BLOCKS are the real admission headroom (the
+        # gateway prefers this gauge over free slots — a slot is cheap, the
+        # blocks behind it are not)
+        if getattr(eng, "total_kv_blocks", None):
+            lines.append("# TYPE dtx_serving_kv_blocks_free gauge")
+            lines.append(f"dtx_serving_kv_blocks_free {eng.free_kv_blocks}")
+            lines.append("# TYPE dtx_serving_kv_blocks_total gauge")
+            lines.append(f"dtx_serving_kv_blocks_total {eng.total_kv_blocks}")
         body = ("\n".join(lines) + "\n").encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -248,7 +256,9 @@ class Handler(BaseHTTPRequestHandler):
 
 def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
-                      adapters=None, kv_quant=None, prefix_cache=0):
+                      adapters=None, kv_quant=None, prefix_cache=0,
+                      kv_block_size=0, kv_blocks=0, prefill_chunk=256,
+                      prefill_token_budget=0):
     def _load():
         try:
             STATE.model_path = model_path
@@ -258,7 +268,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
             # HBM against
             for flag, val in (("--adapters", adapters),
                               ("--prefix_cache", prefix_cache),
-                              ("--kv_quant", kv_quant)):
+                              ("--kv_quant", kv_quant),
+                              ("--kv_block_size", kv_block_size)):
                 if val and not batched:
                     raise ValueError(
                         f"{flag} requires the batched engine "
@@ -272,6 +283,9 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     template=template, max_seq_len=max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
                     kv_quant=kv_quant or None, prefix_cache=prefix_cache,
+                    kv_block_size=kv_block_size, kv_blocks=kv_blocks or None,
+                    prefill_chunk=prefill_chunk,
+                    prefill_token_budget=prefill_token_budget,
                 )
             else:
                 # single-slot path also carries serve-time quantization
@@ -328,13 +342,35 @@ def main(argv=None):
                         "(shared system prompts / repeated probes skip "
                         "prefill; batched engine only; costs one cache row "
                         "of HBM per entry)")
+    p.add_argument("--kv_block_size", type=int, default=0,
+                   help="paged KV cache block size in tokens (0 = dense "
+                        "slots×max_seq_len cache); admission reserves "
+                        "blocks, not full-width rows — see README "
+                        "'Serving performance' for the HBM math")
+    p.add_argument("--kv_blocks", type=int, default=0,
+                   help="total blocks in the paged pool (default "
+                        "slots × max_seq_len / kv_block_size; set lower to "
+                        "serve the same slots in less HBM)")
+    p.add_argument("--prefill_chunk", type=int, default=256,
+                   help="chunked-prefill program length in tokens (paged "
+                        "engine); long prompts prefill in chunks "
+                        "interleaved with decode")
+    p.add_argument("--prefill_token_budget", type=int, default=0,
+                   help="max prefill tokens the scheduler spends between "
+                        "decode chunks (0 = unbounded); bounds the TPOT "
+                        "hit a long admission can inflict on in-flight "
+                        "requests")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
                       args.max_seq_len, quantization=args.quantization,
                       slots=args.slots, decode_chunk=args.decode_chunk,
                       adapters=parse_adapters(args.adapters),
-                      kv_quant=args.kv_quant, prefix_cache=args.prefix_cache)
+                      kv_quant=args.kv_quant, prefix_cache=args.prefix_cache,
+                      kv_block_size=args.kv_block_size,
+                      kv_blocks=args.kv_blocks,
+                      prefill_chunk=args.prefill_chunk,
+                      prefill_token_budget=args.prefill_token_budget)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

@@ -103,6 +103,26 @@ class CheckpointManager:
         self._mngr.close()
 
 
+def restore_raw_state(mngr, step):
+    """Restore a checkpoint WITHOUT a target template, across orbax versions.
+
+    Newer orbax (≥0.5 composite-handler era) refuses a bare
+    ``mngr.restore(step)`` for StandardSave checkpoints (KeyError asking for
+    CheckpointArgs) — it needs an explicit ``StandardRestore()``; versions
+    predating the args API don't have ``ocp.args`` at all. Serving loads
+    adapter/full checkpoints without a state template (the tree shape IS the
+    information being loaded), so both forms are tried."""
+    import orbax.checkpoint as ocp
+
+    args_cls = getattr(getattr(ocp, "args", None), "StandardRestore", None)
+    if args_cls is not None:
+        try:
+            return mngr.restore(step, args=args_cls())
+        except (TypeError, ValueError, KeyError):
+            pass
+    return mngr.restore(step)
+
+
 def write_manifest(
     storage_path: str,
     run_name: str,

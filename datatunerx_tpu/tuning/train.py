@@ -318,20 +318,27 @@ def run(args: TrainArgs) -> dict:
     # background thread, batch N+1 placed on the mesh while step N executes.
     # PPO keeps its synchronous path — its step interleaves rollout
     # generation with optimization and places prompt batches itself.
-    # Streaming + in-training generative eval also stays synchronous: the
-    # stream tokenizes inside the prefetch worker while the eval encodes on
-    # the main thread, and HF fast tokenizers are not thread-safe
-    # ("Already borrowed" RuntimeError would kill the run mid-epoch).
-    # Non-streaming pipelines never tokenize in the worker (examples are
-    # pre-encoded; the worker only pads/packs), so they keep the overlap.
+    # Streaming + in-training generative eval: the stream tokenizes inside
+    # the prefetch worker while the eval encodes on the main thread, and HF
+    # fast tokenizers are not thread-safe ("Already borrowed" RuntimeError
+    # would kill the run mid-epoch) — so the iterator clones the tokenizer
+    # per encoding thread (loader.py ensure_thread_safe_encoding) and the
+    # pipeline stays on; only a non-clonable tokenizer forces the old
+    # synchronous fallback. Non-streaming pipelines never tokenize in the
+    # worker (examples are pre-encoded; the worker only pads/packs).
     gen_eval_in_training = (args.predict_with_generate
                             and args.generate_eval_steps > 0)
+    stream_thread_safe = True
+    if args.prefetch_depth > 0 and args.streaming and gen_eval_in_training:
+        stream_thread_safe = it.ensure_thread_safe_encoding()
     pipelined = (args.prefetch_depth > 0 and args.stage != "ppo"
-                 and not (args.streaming and gen_eval_in_training))
+                 and not (args.streaming and gen_eval_in_training
+                          and not stream_thread_safe))
     if (args.prefetch_depth > 0 and args.streaming and gen_eval_in_training
-            and is_main):
+            and not stream_thread_safe and is_main):
         print("[pipeline] disabled: --streaming with in-training generative "
-              "eval shares one tokenizer across threads", flush=True)
+              "eval shares one NON-CLONABLE tokenizer across threads",
+              flush=True)
     pipe_stats = PipelineStats() if pipelined else None
     accum_batches = grad_accum > 1
     # non-blocking logging: step outputs buffer on device and resolve one
@@ -601,8 +608,23 @@ def _run_eval(trainer, state, eval_examples, args, pad_id, logger, step,
         host_id=dist["process_id"],
         num_hosts=dist["num_processes"],
     )
-    m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
-                                 for b in eval_it.epoch(0)))
+    if args.prefetch_depth > 0 and trainer.mesh is not None:
+        # eval rides the same pipeline as training (ROADMAP follow-on):
+        # batch N+1 builds on the host and lands on the mesh while eval_step
+        # N runs — eval_step already accepts PlacedBatch, and eval examples
+        # are pre-encoded so the worker never touches the tokenizer
+        batches, host_pf = prefetch_batches(
+            eval_it.epoch(0),
+            place_fn=lambda b: place_batch(b, trainer.mesh),
+            depth=args.prefetch_depth,
+        )
+        try:
+            m = trainer.evaluate(state, batches)
+        finally:
+            host_pf.close()
+    else:
+        m = trainer.evaluate(state, ({k: jnp.asarray(v) for k, v in b.items()}
+                                     for b in eval_it.epoch(0)))
     if args.stage in ("dpo", "rm"):
         # eval_loss IS the mean pairwise loss over held-out pairs; exp(loss)
         # is not a perplexity in these stages

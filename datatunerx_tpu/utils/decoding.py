@@ -26,7 +26,13 @@ def prepare_prompt(
 
     buf is the static decode-buffer length (cache width = plen + buf)."""
     max_new = max(1, min(max_new_tokens, max_seq_len - bucket))
+    # floor the kept-prompt cap to a bucket multiple so plen is ALWAYS one:
+    # chunked prefill splits plen into bucket-multiple chunks, so an off-bucket
+    # plen (any off-bucket max_new) would compile a fresh tail-chunk program
+    # per distinct remainder (`or keep`: sub-bucket max_seq_len keeps the
+    # un-floored cap rather than rounding to zero)
     keep = max_seq_len - max_new
+    keep = keep // bucket * bucket or keep
     prompt_ids = list(prompt_ids)[-keep:]
     if not prompt_ids:
         # empty prompt: seed with a single (unmasked) eos — an all-masked

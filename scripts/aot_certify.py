@@ -718,8 +718,9 @@ def extra_artifacts(cert: Certifier, dev):
             plen = 64
             tok = jax.ShapeDtypeStruct((1, plen), jnp.int32, sharding=sh)
             aidx = jax.ShapeDtypeStruct((), jnp.int32, sharding=sh)
-            compiled = jax.jit(
-                eng._prefill_impl, static_argnames=("prompt_len",)).lower(
+            # eng._prefill is the memoized _Programs.prefill jit (the impl
+            # lives on the shared program holder, not the engine)
+            compiled = eng._prefill.lower(
                 to_sds(eng.params), tok, tok, tok, aidx,
                 prompt_len=plen).compile()
             return {"cost": _cost(compiled), "memory": _memory(compiled)}

@@ -211,6 +211,41 @@ def test_dtx005_clean_declared_axes_and_quiet_without_axes():
                     'x = P("whatever")\n', config=LintConfig()) == []
 
 
+def test_dtx005_flags_collective_axis_name_drift():
+    # positional axis_name
+    src = """
+    import jax
+
+    def all_reduce(x):
+        return jax.lax.psum(x, "data")
+    """
+    assert rule_ids(src) == ["DTX005"]
+    # keyword + tuple form, and axis_index's position-0 argument
+    src2 = """
+    import jax
+
+    def gather(x):
+        i = jax.lax.axis_index("mdl")
+        return jax.lax.all_gather(x, axis_name=("dp", "model")), i
+    """
+    assert rule_ids(src2) == ["DTX005", "DTX005"]
+
+
+def test_dtx005_clean_collectives_declared_or_variable_axis():
+    src = """
+    import jax
+
+    def reduce_ok(x, axis_name):
+        y = jax.lax.pmean(x, "dp")
+        z = jax.lax.psum(x, ("dp", "fsdp"))
+        i = jax.lax.axis_index("tp")
+        # a VARIABLE axis name (ring attention's parameter) is out of
+        # static reach — must not be flagged
+        return jax.lax.ppermute(y + z + i, axis_name, [(0, 1)])
+    """
+    assert rule_ids(src) == []
+
+
 # ------------------------------------------------------------------ DTX006
 # the pre-fix /admin/drain shape: a public method flips state the
 # supervisor thread reconciles on, with no lock

@@ -241,6 +241,7 @@ def test_prefetch_batches_composes_and_reports_stats():
 
 def _parity_flags(tmp_path, tag, prefetch_depth):
     train = str(tmp_path / "train.csv")
+    evalp = str(tmp_path / "eval.csv")
     out = str(tmp_path / f"out-{tag}")
     storage = str(tmp_path / f"storage-{tag}")
     if not os.path.exists(train):
@@ -250,9 +251,15 @@ def _parity_flags(tmp_path, tag, prefetch_depth):
             w = csv.writer(f)
             w.writerow(["instruction", "response"])
             w.writerows(rows)
+        with open(evalp, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["instruction", "response"])
+            w.writerows(rows[:8])
     return [
         "--model_name_or_path", "preset:debug",
         "--train_path", train,
+        "--evaluation_path", evalp,  # eval rides the pipeline too
+        "--eval_steps", "2",
         "--output_dir", out,
         "--storage_path", storage,
         "--template", "vanilla",
@@ -291,6 +298,15 @@ def test_pipelined_loop_loss_identical_to_synchronous(tmp_path):
     pipe_losses = _loss_seq(out_pipe)
     assert [s for s, _ in sync_losses] == [s for s, _ in pipe_losses] == [1, 2, 3, 4]
     assert sync_losses == pipe_losses  # bit-identical, not approximately
+    # the EVAL path rides the same pipeline (ROADMAP follow-on): prefetched
+    # eval must be loss-identical to the synchronous eval too
+    def eval_seq(out_dir):
+        path = os.path.join(out_dir, "watch", "eval_log.jsonl")
+        return [(r["current_steps"], r["eval_loss"])
+                for r in map(json.loads, open(path))]
+
+    sync_eval, pipe_eval = eval_seq(out_sync), eval_seq(out_pipe)
+    assert sync_eval and sync_eval == pipe_eval
     # pipeline health metrics ride the pipelined run's log records only
     pipe_recs = [json.loads(line) for line in
                  open(os.path.join(out_pipe, "watch", "trainer_log.jsonl"))]

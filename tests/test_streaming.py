@@ -146,3 +146,55 @@ def test_streaming_cli_e2e(tmp_path):
     res = run(args)
     assert res["steps"] == 3
     assert res["manifest"]
+
+
+def test_thread_safe_encoding_clones_per_thread(tmp_path, tok):
+    """ensure_thread_safe_encoding opts the iterator into per-thread
+    tokenizer CLONES: a worker thread (HostPrefetcher) must never share the
+    original tokenizer object with the main thread's generative-eval encode
+    ("Already borrowed" with HF fast tokenizers)."""
+    import threading
+
+    p = _write_jsonl(tmp_path / "d.jsonl", 8)
+    it = StreamingBatchIterator(
+        StreamingCsvDataset(p), get_template("vanilla", tok), tok,
+        global_batch=2, block_size=32, buffer_size=2,
+    )
+    assert it.ensure_thread_safe_encoding() is True
+    assert it.ensure_thread_safe_encoding() is True  # idempotent
+    # main thread gets its own clone too — never the shared original
+    assert it._thread_tokenizer() is not tok
+    assert it._thread_tokenizer() is it._thread_tokenizer()  # cached per thread
+
+    seen = {}
+
+    def worker(name):
+        seen[name] = it._thread_tokenizer()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen[0] is not seen[1]
+    assert tok not in seen.values()
+    # clones encode identically: batches still come out the same
+    batches = list(it.epoch(0))
+    assert batches and all(b["input_ids"].shape[0] == 2 for b in batches)
+
+
+def test_thread_safe_encoding_falls_back_when_not_clonable(tmp_path, tok):
+    """A tokenizer that refuses deepcopy keeps the old behavior: the caller
+    (tuning/train.py) sees False and leaves the pipeline synchronous."""
+    class Unclonable(type(tok)):
+        def __deepcopy__(self, memo):
+            raise RuntimeError("rust tokenizer state is not forkable")
+
+    bad = Unclonable()
+    p = _write_jsonl(tmp_path / "d2.jsonl", 4)
+    it = StreamingBatchIterator(
+        StreamingCsvDataset(p), get_template("vanilla", bad), bad,
+        global_batch=2, block_size=32, buffer_size=2,
+    )
+    assert it.ensure_thread_safe_encoding() is False
+    assert it._thread_tokenizer() is bad  # unchanged: shared original
