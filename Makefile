@@ -4,13 +4,16 @@
 IMG_OPERATOR ?= datatunerx-tpu/operator:latest
 IMG_TRAINER  ?= datatunerx-tpu/trainer:latest
 
-.PHONY: test test-fast native bench graft-check aot-certify docker-build deploy undeploy fmt lint
+.PHONY: test test-fast native bench graft-check aot-certify docker-build deploy undeploy fmt lint lint-fix
 
 test:            ## full test suite (8-device virtual CPU mesh)
 	python -m pytest tests/ -q
 
-lint:            ## dtxlint: JAX-aware static analysis (the tier-1 CI gate)
-	python -m datatunerx_tpu.analysis datatunerx_tpu/
+lint:            ## dtxlint: program-level JAX-aware static analysis (the tier-1 CI gate)
+	python -m datatunerx_tpu.analysis datatunerx_tpu/ scripts/ bench.py __graft_entry__.py
+
+lint-fix:        ## apply dtxlint's mechanical autofixes (DTX002/DTX008), then re-lint
+	python -m datatunerx_tpu.analysis datatunerx_tpu/ scripts/ bench.py __graft_entry__.py --fix
 
 test-fast:       ## skip the slow live-pipeline e2e
 	python -m pytest tests/ -q -m "not slow"

@@ -155,6 +155,10 @@ def child_tinyllama():
         "value": round(toks_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3) if vs is not None else None,
+        # explicit provenance so a CPU-only round can never be read as TPU
+        # signal: the MEASURED platform, straight from the device that ran
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
     }
     if pipe_stats is not None:
         line["pipeline"] = {k: round(v, 3)
@@ -247,7 +251,7 @@ def child_serve():
     tpots = [(s[-1] - s[0]) / (len(s) - 1)
              for _, s, e in per_req if len(s) > 1 and not e]
     total_tokens = sum(len(s) for _, s, _ in per_req)
-    mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
+    mean = lambda xs: sum(xs) / len(xs) if xs else 0.0
     p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] if ttfts else 0.0
     tag = (f"{model},slots{slots}," +
            (f"paged,bs{block},budget{budget}" if paged else "dense"))
@@ -256,6 +260,10 @@ def child_serve():
         "value": round(total_tokens / wall, 1) if wall > 0 else 0.0,
         "unit": "tokens/s",
         "vs_baseline": None,  # no prior serve-bench round to compare against
+        # explicit provenance so a CPU-only round can never be read as TPU
+        # signal: the MEASURED platform, straight from the device that ran
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
         "serve": {
             "requests": len(per_req),
             "errors": len(errors),
@@ -266,8 +274,6 @@ def child_serve():
             "prefill_stats": dict(eng.prefill_stats),
         },
     }
-    if not on_tpu:
-        line["cpu_fallback"] = True
     print(json.dumps(line), flush=True)
 
 

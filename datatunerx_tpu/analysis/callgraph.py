@@ -31,8 +31,16 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
-def collect_aliases(tree: ast.Module) -> Dict[str, str]:
-    """Local name → canonical dotted prefix for every import in the module."""
+def collect_aliases(tree: ast.Module, module: Optional[str] = None,
+                    is_package: bool = False) -> Dict[str, str]:
+    """Local name → canonical dotted prefix for every import in the module.
+
+    ``module``/``is_package`` give the importing module's own dotted name so
+    RELATIVE imports resolve to canonical names too: inside
+    ``datatunerx_tpu.gateway.server``, ``from ..utils.storage import open_uri``
+    maps ``open_uri`` → ``datatunerx_tpu.utils.storage.open_uri``. Without
+    module context (fixtures, stdin) relative imports are skipped as before.
+    """
     aliases: Dict[str, str] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -41,11 +49,25 @@ def collect_aliases(tree: ast.Module) -> Dict[str, str]:
                     aliases[a.asname] = a.name
                 else:
                     aliases[a.name.split(".")[0]] = a.name.split(".")[0]
-        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            elif module:
+                parts = module.split(".")
+                if not is_package:
+                    parts = parts[:-1]
+                if node.level - 1 > len(parts):
+                    continue
+                parts = parts[:len(parts) - (node.level - 1)]
+                base = ".".join(parts + ([node.module] if node.module else []))
+            else:
+                continue
+            if not base:
+                continue
             for a in node.names:
                 if a.name == "*":
                     continue
-                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+                aliases[a.asname or a.name] = f"{base}.{a.name}"
     return aliases
 
 
@@ -98,10 +120,25 @@ class ModuleGraph:
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[str, ClassInfo] = {}
         self.edges: Dict[str, Set[str]] = {}
+        # per-caller call/reference sites with line numbers: local targets
+        # (qualnames) and external dotted names (through import aliases) —
+        # the raw material for hot-region roots and the program graph
+        self.edge_sites: Dict[str, List[Tuple[str, int]]] = {}
+        self.external_sites: Dict[str, List[Tuple[str, int]]] = {}
+        # CALL-only subsets (no reference/nesting edges): a function handed
+        # to Thread(target=...) or map() runs on another frame — DTX009's
+        # held-lock reachability must not follow it, while DTX001 hot-path
+        # reachability deliberately does
+        self.call_edges: Dict[str, Set[str]] = {}
+        self.external_calls: Dict[str, List[Tuple[str, int]]] = {}
+        # calls executed at import time (module/class bodies, not functions)
+        self.module_sites: List[Tuple[str, int]] = []
+        self.module_external_sites: List[Tuple[str, int]] = []
         self._module_level: Dict[str, str] = {}  # bare name → qualname
         self._collect(tree.body, prefix="", cls=None)
         for qualname, info in self.functions.items():
             self.edges[qualname] = self._edges_from(qualname, info)
+        self._collect_module_sites(tree)
 
     # ------------------------------------------------------------ building
     def _collect(self, body, prefix: str, cls: Optional[str]):
@@ -138,32 +175,83 @@ class ModuleGraph:
 
     def _edges_from(self, qualname: str, info: FunctionInfo) -> Set[str]:
         out: Set[str] = set()
+        sites = self.edge_sites.setdefault(qualname, [])
+        ext = self.external_sites.setdefault(qualname, [])
+        calls = self.call_edges.setdefault(qualname, set())
+        ext_calls = self.external_calls.setdefault(qualname, [])
         # nesting edges
         nested_prefix = f"{qualname}.<locals>."
         for other in self.functions:
             if other.startswith(nested_prefix) and "." not in other[len(nested_prefix):]:
                 out.add(other)
+                sites.append((other, info.lineno))
         for node in walk_function(info.node):
             if not isinstance(node, ast.Call):
                 continue
             callee = self._target_of(node.func, info)
             if callee:
                 out.add(callee)
+                sites.append((callee, node.lineno))
+                calls.add(callee)
+            else:
+                dotted = resolve_name(node.func, self.aliases)
+                if dotted:
+                    ext.append((dotted, node.lineno))
+                    ext_calls.append((dotted, node.lineno))
             # reference edges: functions handed to another callable
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 ref = self._target_of(arg, info)
                 if ref:
                     out.add(ref)
+                    sites.append((ref, node.lineno))
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    dotted = resolve_name(arg, self.aliases)
+                    if dotted:
+                        ext.append((dotted, node.lineno))
         return out
 
+    def _collect_module_sites(self, tree: ast.Module):
+        """Call sites at import time: module body and class bodies, stopping
+        at function boundaries (their bodies run when called)."""
+        stack: List[ast.AST] = list(tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in self._module_level:
+                self.module_sites.append(
+                    (self._module_level[node.func.id], node.lineno))
+            else:
+                dotted = resolve_name(node.func, self.aliases)
+                if dotted:
+                    self.module_external_sites.append((dotted, node.lineno))
+
     # ------------------------------------------------------------- queries
+    def call_target(self, expr: ast.AST, caller: str) -> Optional[str]:
+        """Qualname a call's func expression refers to, when it names a
+        function in this module and ``caller`` is the enclosing function's
+        qualname (public form of the edge-building resolution, used by the
+        program-pass summary builder)."""
+        info = self.functions.get(caller)
+        if info is None:
+            return None
+        return self._target_of(expr, info)
+
     def reachable(self, patterns: Tuple[str, ...]) -> Set[str]:
         """Every function reachable (inclusive) from functions whose BARE
         name matches one of the fnmatch patterns."""
         roots = [q for q, i in self.functions.items()
                  if any(fnmatch.fnmatchcase(i.name, p) for p in patterns)]
+        return self.reachable_from(roots)
+
+    def reachable_from(self, roots) -> Set[str]:
+        """Every function reachable (inclusive) from the given qualnames."""
         seen: Set[str] = set()
-        stack = list(roots)
+        stack = [q for q in roots if q in self.functions]
         while stack:
             cur = stack.pop()
             if cur in seen:

@@ -38,6 +38,16 @@ class LintConfig:
     mesh_module: str = ""
     # rule ids disabled globally (inline suppressions handle point FPs)
     disable: Tuple[str, ...] = ()
+    # per-file rule disables as "glob:RULE1,RULE2" (or "glob:all") entries —
+    # the dtxlint analogue of ruff's per-file-ignores, matched against the
+    # finding's display path
+    per_file_disable: Tuple[str, ...] = ()
+    # cross-module program analysis (call graph over the linted package):
+    # DTX001/DTX007/DTX009 follow calls across files when on
+    program: bool = True
+    # module-summary cache file ("" disables); relative to root. Keyed on
+    # each file's mtime+size so repeat `dtx lint` runs skip re-analysis.
+    cache: str = ".dtxlint-cache.json"
     # directory the config file was found in ("" = cwd); baseline and
     # mesh_module resolve against it
     root: str = ""
@@ -186,7 +196,24 @@ def rule_enabled(config: LintConfig, rule_id: str) -> bool:
     return rule_id not in set(config.disable)
 
 
+def per_file_disabled(config: LintConfig, path: str) -> frozenset:
+    """Rule ids disabled for ``path`` by ``per-file-disable`` entries
+    ("glob:RULE1,RULE2" / "glob:all"), matched on /-normalized paths."""
+    import fnmatch
+
+    norm = path.replace(os.sep, "/")
+    out: set = set()
+    for entry in config.per_file_disable:
+        glob, sep, rules = entry.partition(":")
+        if not sep:
+            continue
+        if fnmatch.fnmatch(norm, glob.strip()) \
+                or fnmatch.fnmatch(os.path.basename(norm), glob.strip()):
+            out.update(r.strip() for r in rules.split(",") if r.strip())
+    return frozenset(out)
+
+
 __all__: Sequence[str] = (
     "LintConfig", "find_pyproject", "load_config", "mesh_axes_for",
-    "rule_enabled",
+    "per_file_disabled", "rule_enabled",
 )

@@ -21,9 +21,53 @@ from datatunerx_tpu.analysis.callgraph import (
     collect_aliases,
     resolve_name,
 )
-from datatunerx_tpu.analysis.config import LintConfig, rule_enabled
+from datatunerx_tpu.analysis.config import (
+    LintConfig,
+    per_file_disabled,
+    rule_enabled,
+)
 
 _SUPPRESS_RE = re.compile(r"#\s*dtxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_HOT_BEGIN_RE = re.compile(r"#\s*dtxlint:\s*hot-begin\b")
+_HOT_END_RE = re.compile(r"#\s*dtxlint:\s*hot-end\b")
+
+
+def hot_region_spans(source: str) -> List[Tuple[int, int]]:
+    """Inclusive (start, end) line ranges between ``# dtxlint: hot-begin``
+    and ``# dtxlint: hot-end`` markers. An unmatched begin extends to EOF
+    (the conservative direction for a hot-path rule); nested begins fold
+    into the enclosing region."""
+    spans: List[Tuple[int, int]] = []
+    start = None
+    lines = source.splitlines()
+    for i, line in enumerate(lines, start=1):
+        if _HOT_BEGIN_RE.search(line):
+            if start is None:
+                start = i
+        elif _HOT_END_RE.search(line) and start is not None:
+            spans.append((start, i))
+            start = None
+    if start is not None:
+        spans.append((start, len(lines)))
+    return spans
+
+
+def module_name_for_path(path: str) -> Tuple[Optional[str], bool]:
+    """(dotted module name, is_package) for a file inside a package tree —
+    climbs parent directories while ``__init__.py`` exists. Files outside
+    any package get (None, False); relative imports then stay unresolved."""
+    ap = os.path.abspath(path)
+    d, base = os.path.split(ap)
+    if not base.endswith(".py"):
+        return None, False
+    is_package = base == "__init__.py"
+    parts: List[str] = [] if is_package else [base[:-3]]
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        d, pkg = os.path.split(d)
+        parts.insert(0, pkg)
+    if not parts:
+        return None, False
+    return ".".join(parts), is_package
 
 
 @dataclass(frozen=True)
@@ -55,15 +99,27 @@ class ModuleContext:
     """Per-file state shared by every rule (parse once, analyze N times)."""
 
     def __init__(self, path: str, source: str, tree: ast.Module,
-                 config: LintConfig):
+                 config: LintConfig, module: Optional[str] = None,
+                 is_package: bool = False):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
         self.config = config
-        self.aliases = collect_aliases(tree)
+        self.module = module
+        self.is_package = is_package
+        self.aliases = collect_aliases(tree, module=module,
+                                       is_package=is_package)
+        self.hot_regions = hot_region_spans(source)
+        # DTX007 cross-module candidates: resource handles whose only
+        # disposition is "passed to a resolvable internal callee" — the
+        # program pass adjudicates them against the callee's summary
+        self.xescape_candidates: List[dict] = []
         self._graph: Optional[ModuleGraph] = None
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def in_hot_region(self, line: int) -> bool:
+        return any(s <= line <= e for s, e in self.hot_regions)
 
     @property
     def graph(self) -> ModuleGraph:
@@ -130,9 +186,32 @@ def _default_rules() -> Sequence[Rule]:
     return all_rules()
 
 
+def filter_findings(raw: Sequence[Finding], sup: Dict[int, Set[str]],
+                    config: LintConfig) -> Tuple[List[Finding], int]:
+    """Apply inline suppressions + per-file config disables to raw findings;
+    returns (kept, suppressed_count). ``sup`` is a ``suppressions()`` map —
+    passed in (rather than derived from source here) so the program-level
+    pass can filter findings against CACHED modules without re-reading
+    their files."""
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
+        pfd = per_file_disabled(config, f.path)
+        if "all" in pfd or f.rule in pfd:
+            continue  # config-level: not counted as inline suppression
+        disabled = sup.get(f.line, ())
+        if "all" in disabled or f.rule in disabled:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
 def lint_source(source: str, path: str = "<string>",
                 config: Optional[LintConfig] = None,
-                rules: Optional[Sequence[Rule]] = None) -> LintResult:
+                rules: Optional[Sequence[Rule]] = None,
+                module: Optional[str] = None,
+                is_package: bool = False) -> LintResult:
     config = config or LintConfig()
     rules = _default_rules() if rules is None else rules
     result = LintResult(files=1)
@@ -143,19 +222,15 @@ def lint_source(source: str, path: str = "<string>",
             "DTX000", path, e.lineno or 0, e.offset or 0,
             f"syntax error: {e.msg}", "error"))
         return result
-    ctx = ModuleContext(path, source, tree, config)
+    ctx = ModuleContext(path, source, tree, config, module=module,
+                        is_package=is_package)
     raw: List[Finding] = []
     for rule in rules:
         if not rule_enabled(config, rule.id):
             continue
         raw.extend(rule.check(ctx))
-    sup = suppressions(source)
-    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
-        disabled = sup.get(f.line, ())
-        if "all" in disabled or f.rule in disabled:
-            result.suppressed += 1
-        else:
-            result.findings.append(f)
+    result.findings, result.suppressed = filter_findings(
+        raw, suppressions(source), config)
     return result
 
 
@@ -164,8 +239,9 @@ def lint_file(path: str, config: Optional[LintConfig] = None,
               display_path: Optional[str] = None) -> LintResult:
     with open(path, encoding="utf-8", errors="replace") as f:
         source = f.read()
+    module, is_package = module_name_for_path(path)
     return lint_source(source, path=display_path or path, config=config,
-                       rules=rules)
+                       rules=rules, module=module, is_package=is_package)
 
 
 def iter_python_files(paths: Sequence[str],

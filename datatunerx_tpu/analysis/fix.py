@@ -1,0 +1,347 @@
+"""``dtxlint --fix``: AST-anchored span edits for the mechanical rules.
+
+Scope is deliberately narrow — a fix is only offered when it is
+*provably* behavior-preserving at the AST level, and every applied fix
+is validated by re-parsing and re-linting the result (a fix that does
+not strictly reduce the fixable-finding count is rolled back and the
+file left untouched):
+
+  * DTX002 hoist-jit-out-of-loop — ``name = jax.jit(...)`` directly in a
+    loop body is hoisted above the (outermost enclosing) loop, but ONLY
+    when the right-hand side reads no name assigned anywhere in those
+    loops (``for f in fns: g = jax.jit(f)`` is NOT hoistable — ``f``
+    varies — and is reported as unfixable instead of mangled).
+  * DTX008 wrap-import-time-device-work — a device-allocating function
+    DEFAULT (``def f(x, fill=jnp.zeros((4,))):``) becomes ``fill=None``
+    plus an ``if fill is None: fill = jnp.zeros((4,))`` materialization
+    at the top of the body: the classic default-argument deferral.
+    Module-level constants (``TABLE = jnp.ones(...)``) have no
+    call-site-compatible mechanical rewrite and stay manual.
+
+The edit engine is a flat list of non-overlapping ``SpanEdit``s in
+character offsets; ``apply_edits`` refuses (raises ``OverlapError``)
+rather than guessing when two edits touch the same span.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from datatunerx_tpu.analysis.callgraph import walk_function
+from datatunerx_tpu.analysis.config import LintConfig, rule_enabled
+from datatunerx_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    filter_findings,
+    module_name_for_path,
+    suppressions,
+)
+
+FIXABLE_RULES = ("DTX002", "DTX008")
+_MAX_PASSES = 8
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+class OverlapError(ValueError):
+    """Two edits touch the same span — refuse rather than guess."""
+
+
+@dataclass(frozen=True)
+class SpanEdit:
+    start: int
+    end: int
+    text: str
+
+
+def apply_edits(source: str, edits: Sequence[SpanEdit]) -> str:
+    """Apply non-overlapping edits (insertions are zero-width spans).
+    Adjacent edits are fine; overlapping ones raise OverlapError."""
+    out: List[str] = []
+    pos = 0
+    for e in sorted(edits, key=lambda e: (e.start, e.end)):
+        if e.end < e.start or e.start < 0 or e.end > len(source):
+            raise OverlapError(f"edit span ({e.start}, {e.end}) out of range")
+        if e.start < pos:
+            raise OverlapError(
+                f"edit at {e.start} overlaps a previous edit ending at {pos}")
+        out.append(source[pos:e.start])
+        out.append(e.text)
+        pos = e.end
+    out.append(source[pos:])
+    return "".join(out)
+
+
+def _line_offsets(source: str) -> List[int]:
+    """offsets[i] = char offset where 1-based line i starts (offsets[0]
+    unused); one trailing sentinel for end-of-source."""
+    offsets = [0, 0]
+    for i, ch in enumerate(source):
+        if ch == "\n":
+            offsets.append(i + 1)
+    offsets.append(len(source))
+    return offsets
+
+
+def _node_span(offsets: List[int], node: ast.AST) -> Tuple[int, int]:
+    start = offsets[node.lineno] + node.col_offset
+    end = offsets[node.end_lineno] + node.end_col_offset
+    return start, end
+
+
+def _line_start(offsets: List[int], line: int) -> int:
+    return offsets[min(line, len(offsets) - 1)]
+
+
+def _find_call(ctx: ModuleContext, finding: Finding) -> Optional[ast.Call]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and node.lineno == finding.line \
+                and node.col_offset == finding.col:
+            return node
+    return None
+
+
+# ------------------------------------------------------------ DTX002 hoist
+
+def _stores_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                          ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def _loads_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _fix_dtx002(ctx: ModuleContext, finding: Finding,
+                offsets: List[int]) -> Optional[List[SpanEdit]]:
+    call = _find_call(ctx, finding)
+    if call is None or ctx.resolve(call.func) not in _JIT_NAMES:
+        return None  # the static_argnums sub-finding anchors on the kwarg
+    stmt = ctx.parents.get(call)
+    if not (isinstance(stmt, ast.Assign) and stmt.value is call
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)):
+        return None
+    # the statement must sit DIRECTLY in a loop body; collect the chain of
+    # enclosing loops up to the function/module boundary
+    loops: List[ast.AST] = []
+    cur: Optional[ast.AST] = stmt
+    while cur is not None:
+        parent = ctx.parents.get(cur)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda, ast.ClassDef)) or parent is None:
+            break
+        if isinstance(parent, _LOOPS):
+            loops.append(parent)
+        cur = parent
+    if not loops or stmt not in loops[0].body:
+        return None
+    mutated: Set[str] = set()
+    for loop in loops:
+        mutated |= _stores_in(loop)
+    if _loads_in(stmt.value) & mutated:
+        return None  # rhs depends on loop state: hoisting changes behavior
+    outer = loops[-1]
+    # whole-line statement only (no `a = 1; b = jax.jit(f)` splicing)
+    line = ctx.lines[stmt.lineno - 1]
+    if line[:stmt.col_offset].strip():
+        return None
+    tail = ctx.lines[stmt.end_lineno - 1][stmt.end_col_offset:].strip()
+    if tail and not tail.startswith("#"):
+        return None
+    dedent = stmt.col_offset - outer.col_offset
+    moved_lines = []
+    for ln in range(stmt.lineno, stmt.end_lineno + 1):
+        text = ctx.lines[ln - 1]
+        moved_lines.append(text[dedent:] if text[:dedent].strip() == ""
+                           else text)
+    moved = "\n".join(moved_lines) + "\n"
+    del_start = _line_start(offsets, stmt.lineno)
+    del_end = _line_start(offsets, stmt.end_lineno + 1)
+    ins_at = _line_start(offsets, outer.lineno)
+    return [SpanEdit(ins_at, ins_at, moved),
+            SpanEdit(del_start, del_end, "")]
+
+
+# --------------------------------------------------- DTX008 default-arg fix
+
+def _default_param(fn: ast.AST, node: ast.AST) -> Optional[str]:
+    """Param name when ``node`` is exactly one of ``fn``'s default-value
+    expressions."""
+    a = fn.args
+    pos_params = [p.arg for p in a.posonlyargs + a.args]
+    for i, default in enumerate(a.defaults):
+        if default is node:
+            return pos_params[len(pos_params) - len(a.defaults) + i]
+    for i, default in enumerate(a.kw_defaults):
+        if default is node:
+            return a.kwonlyargs[i].arg
+    return None
+
+
+def _fix_dtx008(ctx: ModuleContext, finding: Finding,
+                offsets: List[int]) -> Optional[List[SpanEdit]]:
+    call = _find_call(ctx, finding)
+    if call is None:
+        return None
+    fn = ctx.parents.get(call)
+    while fn is not None and not isinstance(fn, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef)):
+        fn = ctx.parents.get(fn)
+    if fn is None:
+        return None  # module/class-level device work: no mechanical rewrite
+    pname = _default_param(fn, call)
+    if pname is None:
+        return None  # flagged call is a SUBEXPRESSION of the default
+    if call.lineno != call.end_lineno:
+        return None  # multiline default: keep the fix mechanical
+    expr = ast.get_source_segment(ctx.source, call)
+    if expr is None:
+        return None
+    body = fn.body
+    has_doc = (isinstance(body[0], ast.Expr)
+               and isinstance(body[0].value, ast.Constant)
+               and isinstance(body[0].value.value, str))
+    if has_doc and len(body) > 1:
+        insert_before = body[1]  # keep the docstring first
+        indent = " " * insert_before.col_offset
+        ins_at = _line_start(offsets, insert_before.lineno)
+    elif has_doc:
+        # docstring-only body: insert AFTER it (inserting before would
+        # demote it to a bare string and destroy __doc__)
+        indent = " " * body[0].col_offset
+        ins_at = _line_start(offsets, body[0].end_lineno + 1)
+    else:
+        insert_before = body[0]
+        indent = " " * insert_before.col_offset
+        ins_at = _line_start(offsets, insert_before.lineno)
+    guard = (f"{indent}if {pname} is None:\n"
+             f"{indent}    {pname} = {expr}\n")
+    start, end = _node_span(offsets, call)
+    return [SpanEdit(start, end, "None"), SpanEdit(ins_at, ins_at, guard)]
+
+
+_FIXERS = {"DTX002": _fix_dtx002, "DTX008": _fix_dtx008}
+
+
+def _overlaps(group: Sequence[SpanEdit],
+              spans: Sequence[Tuple[int, int]]) -> bool:
+    """True when any edit in ``group`` intersects an already-chosen span.
+    Zero-width insertions never overlap anything (apply_edits orders
+    same-offset insertions stably)."""
+    for ge in group:
+        for s, e in spans:
+            if max(ge.start, s) < min(ge.end, e):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------- driver
+
+@dataclass
+class FixResult:
+    path: str
+    applied: int = 0
+    unfixable: int = 0
+    changed: bool = False
+
+
+def _fixable_findings(source: str, path: str, config: LintConfig,
+                      rule_ids: Sequence[str]) -> Tuple[List[Finding],
+                                                        Optional[ModuleContext]]:
+    from datatunerx_tpu.analysis.rules import rules_by_id
+
+    module, is_package = module_name_for_path(path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return [], None
+    ctx = ModuleContext(path, source, tree, config, module=module,
+                        is_package=is_package)
+    raw: List[Finding] = []
+    for rule in rules_by_id(list(rule_ids)):
+        if rule_enabled(config, rule.id):
+            raw.extend(rule.check(ctx))
+    findings, _ = filter_findings(raw, suppressions(source), config)
+    return findings, ctx
+
+
+def fix_source(source: str, path: str,
+               config: Optional[LintConfig] = None,
+               rule_ids: Sequence[str] = FIXABLE_RULES,
+               ) -> Tuple[str, FixResult]:
+    """Iteratively apply safe fixes to one module's source. Each pass
+    re-parses and re-lints; a pass that fails to strictly reduce the
+    fixable-finding count is rolled back."""
+    config = config or LintConfig()
+    rule_ids = [r for r in rule_ids if r in _FIXERS]
+    res = FixResult(path=path)
+    for _ in range(_MAX_PASSES):
+        findings, ctx = _fixable_findings(source, path, config, rule_ids)
+        if ctx is None or not findings:
+            res.unfixable = len(findings)
+            break
+        offsets = _line_offsets(source)
+        chosen: List[SpanEdit] = []
+        spans: List[Tuple[int, int]] = []
+        for finding in findings:
+            fixer = _FIXERS.get(finding.rule)
+            group = fixer(ctx, finding, offsets) if fixer else None
+            if not group:
+                continue
+            if _overlaps(group, spans):
+                continue  # refused: the next pass re-derives it post-shift
+            chosen.extend(group)
+            spans.extend((ge.start, ge.end) for ge in group)
+        if not chosen:
+            res.unfixable = len(findings)
+            break
+        try:
+            candidate = apply_edits(source, chosen)
+            ast.parse(candidate)
+        except (OverlapError, SyntaxError):
+            res.unfixable = len(findings)
+            break
+        after, _ = _fixable_findings(candidate, path, config, rule_ids)
+        if len(after) >= len(findings):
+            res.unfixable = len(findings)
+            break  # the fix didn't resolve its finding: roll back
+        res.applied += len(findings) - len(after)
+        source = candidate
+        res.changed = True
+    else:
+        findings, _ = _fixable_findings(source, path, config, rule_ids)
+        res.unfixable = len(findings)
+    return source, res
+
+
+def fix_paths(paths: Sequence[str], config: Optional[LintConfig] = None,
+              rule_ids: Sequence[str] = FIXABLE_RULES,
+              write: bool = True) -> List[FixResult]:
+    """Run the fixer over files/trees. ``write=False`` is ``--fix
+    --check``: report what WOULD change, touch nothing."""
+    from datatunerx_tpu.analysis.core import _display_path, iter_python_files
+
+    config = config or LintConfig()
+    results: List[FixResult] = []
+    for path in iter_python_files(paths, config):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        fixed, res = fix_source(source, path, config=config,
+                                rule_ids=rule_ids)
+        res.path = _display_path(path, config)
+        if res.changed and write:
+            tmp = f"{path}.dtxfix.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(fixed)
+            os.replace(tmp, path)
+        results.append(res)
+    return results

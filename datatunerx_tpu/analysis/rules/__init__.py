@@ -5,7 +5,9 @@ tests/test_dtxlint.py (see README "Static analysis")."""
 from typing import List, Sequence
 
 from datatunerx_tpu.analysis.core import Rule
+from datatunerx_tpu.analysis.rules.blocking import BlockingUnderLock
 from datatunerx_tpu.analysis.rules.concurrency import LockDiscipline, ResourceLeak
+from datatunerx_tpu.analysis.rules.donation import DonatedBufferReuse
 from datatunerx_tpu.analysis.rules.host_sync import HostSyncInHotPath
 from datatunerx_tpu.analysis.rules.prng import PRNGKeyReuse
 from datatunerx_tpu.analysis.rules.retrace import JitInLoop, ModuleImportDeviceWork
@@ -21,6 +23,8 @@ RULE_CLASSES = (
     LockDiscipline,       # DTX006
     ResourceLeak,         # DTX007
     ModuleImportDeviceWork,  # DTX008
+    BlockingUnderLock,    # DTX009
+    DonatedBufferReuse,   # DTX010
 )
 
 

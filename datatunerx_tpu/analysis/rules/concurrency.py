@@ -208,6 +208,11 @@ class ResourceLeak(Rule):
         return ""
 
     def _check_local(self, ctx, info, name: str, kind: str) -> str:
+        # handle-passed-to-an-internal-callee uses: with the program graph
+        # on, the callee's parameter disposition decides whether this was a
+        # true handoff or a drop — recorded for the program pass, treated
+        # as an escape (no module-level finding) either way
+        candidates: List[dict] = []
         for node in walk_function(info.node, include_nested=True):
             if not (isinstance(node, ast.Name) and node.id == name):
                 continue
@@ -220,13 +225,48 @@ class ResourceLeak(Rule):
                         and parent.attr in _CLEANUP_METHODS:
                     return ""
                 continue  # p.poll()/p.pid — neutral receiver use
-            # any other Load use — call argument, return, yield, `with p:`,
-            # container literal, alias assignment — escapes to code we
-            # can't see; its new owner is responsible
+            cand = self._call_arg_candidate(ctx, node, parent)
+            if cand is not None:
+                candidates.append(cand)
+                continue
+            # any other Load use — return, yield, `with p:`, container
+            # literal, alias assignment — escapes to code we can't see;
+            # its new owner is responsible
+            return ""
+        if candidates:
+            ctx.xescape_candidates.append({
+                "var": name, "kind": kind,
+                "line": candidates[0]["line"], "col": candidates[0]["col"],
+                "targets": candidates,
+            })
             return ""
         return (f"{kind} handle `{name}` has no reachable "
                 "terminate/join/close in this function and never escapes "
                 "— it leaks when the function returns")
+
+    @staticmethod
+    def _call_arg_candidate(ctx, node, parent) -> Optional[dict]:
+        """When ``node`` is a plain positional/keyword argument of a call
+        whose callee resolves to a name, describe the pass-through:
+        {callee, arg (int position or str kwarg), line, col}. None for any
+        other use."""
+        call, arg = None, None
+        if isinstance(parent, ast.Call) and node in parent.args:
+            if any(isinstance(a, ast.Starred) for a in parent.args):
+                return None  # positional index unknowable
+            call, arg = parent, parent.args.index(node)
+        elif isinstance(parent, ast.keyword) and parent.value is node \
+                and parent.arg is not None:
+            grand = ctx.parents.get(parent)
+            if isinstance(grand, ast.Call):
+                call, arg = grand, parent.arg
+        if call is None or call.func is node:
+            return None
+        callee = ctx.resolve(call.func)
+        if not callee:
+            return None
+        return {"callee": callee, "arg": arg,
+                "line": node.lineno, "col": node.col_offset}
 
     def _check_class_attr(self, ctx, cls: str, attr: str, kind: str) -> str:
         graph = ctx.graph
@@ -278,3 +318,30 @@ class ResourceLeak(Rule):
             if isinstance(node, ast.Name) and node.id in derived:
                 return True
         return False
+
+
+def param_disposition(ctx, fn_node, pname: str) -> str:
+    """What a function does with one of its parameters, for the program
+    pass's cross-module escape analysis:
+
+      * ``disposes`` — a cleanup method is called on it (or ``with p:``);
+      * ``escapes``  — returned/stored/passed on: someone else owns it;
+      * ``drops``    — only neutral receiver uses (or none): a resource
+        handle passed here dies with the frame, so the CALLER still leaks.
+    """
+    for node in walk_function(fn_node, include_nested=True):
+        if not (isinstance(node, ast.Name) and node.id == pname):
+            continue
+        if isinstance(node.ctx, ast.Store):
+            return "escapes"  # rebound: can't track further, be safe
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Attribute):
+            grand = ctx.parents.get(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent \
+                    and parent.attr in _CLEANUP_METHODS:
+                return "disposes"
+            continue  # p.poll()/p.pid — neutral receiver use
+        if isinstance(parent, ast.withitem) and parent.context_expr is node:
+            return "disposes"
+        return "escapes"
+    return "drops"

@@ -1,0 +1,274 @@
+"""DTX010: donated-buffer reuse — reading a variable after passing it to a
+``donate_argnums`` call.
+
+``jax.jit(step, donate_argnums=(0,))`` tells XLA it may alias the donated
+operand's buffer for an output: after ``new = step(state, batch)`` the
+old ``state`` is DELETED on TPU (reads raise) and silently ALIASED on
+CPU — the worst kind of platform-dependent bug, because the CPU test
+suite passes while the TPU run corrupts or crashes. This repo's serving
+plane donates the KV cache through every decode step, so the shape is
+one refactor away at all times.
+
+Detection, per function scope:
+  * donated callables: ``g = jax.jit(f, donate_argnums=…)`` at module or
+    local level (also ``donate_argnames``), and direct
+    ``jax.jit(f, donate_argnums=…)(args)`` calls;
+  * at each call of one, map the donated positions/names to plain-Name
+    arguments;
+  * flag any LOAD of that name after the call statement — unless the
+    call's own statement rebinds the name (``state = step(state, b)``,
+    the loop-carry idiom, which is exactly how donation is meant to be
+    used) or the name is rebound before the use by a store that
+    DOMINATES it (a conditional rebind — ``if err: state = reset()`` —
+    does not clear the un-rebound path, which still reads the donated
+    buffer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from datatunerx_tpu.analysis.callgraph import walk_function
+from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# statement-list fields whose execution is conditional on control flow;
+# `finally` bodies always run and are deliberately absent
+_COND_ARMS = {
+    ast.If: ("body", "orelse"),
+    ast.While: ("body", "orelse"),
+    ast.For: ("body", "orelse"),
+    ast.AsyncFor: ("body", "orelse"),
+    ast.Try: ("body", "handlers", "orelse"),
+    ast.ExceptHandler: ("body",),
+}
+
+
+def _branch_paths(fn_node: ast.AST) -> Dict[int, Tuple]:
+    """id(node) → tuple of (construct id, arm field) conditional arms
+    enclosing it within ``fn_node``. A store dominates a load iff the
+    store's path is a prefix of the load's — same or enclosing arm."""
+    paths: Dict[int, Tuple] = {id(fn_node): ()}
+
+    def visit(node: ast.AST, path: Tuple):
+        cond_fields = _COND_ARMS.get(type(node), ())
+        for field, value in ast.iter_fields(node):
+            arm = path + ((id(node), field),) if field in cond_fields \
+                else path
+            children = value if isinstance(value, list) else [value]
+            for child in children:
+                if not isinstance(child, ast.AST):
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # separate frame; walk_function skips it too
+                paths[id(child)] = arm
+                visit(child, arm)
+
+    visit(fn_node, ())
+    return paths
+
+
+def donated_spec(ctx: ModuleContext,
+                 call: ast.Call) -> Optional[Tuple[Tuple[int, ...],
+                                                   Tuple[str, ...]]]:
+    """(donated positions, donated kwarg names) when ``call`` is a
+    jit-with-donation, else None."""
+    if ctx.resolve(call.func) not in _JIT_NAMES:
+        return None
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, int):
+                        nums.append(elt.value)
+        elif kw.arg == "donate_argnames":
+            v = kw.value
+            vals = [v] if isinstance(v, ast.Constant) else \
+                list(v.elts) if isinstance(v, (ast.Tuple, ast.List)) else []
+            for elt in vals:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.append(elt.value)
+    if not nums and not names:
+        return None
+    return tuple(nums), tuple(names)
+
+
+def _assigned_names(stmt: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    stack = targets
+    while stack:
+        t = stack.pop()
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List, ast.Starred)):
+            stack.extend(ast.iter_child_nodes(t))
+    return out
+
+
+class DonatedBufferReuse(Rule):
+    id = "DTX010"
+    name = "donated-buffer-reuse"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        module_donated = self._donated_bindings(ctx, ctx.tree.body)
+        for qualname in sorted(ctx.graph.functions):
+            info = ctx.graph.functions[qualname]
+            donated = dict(module_donated)
+            donated.update(self._donated_bindings(ctx, info.node.body))
+            out.extend(self._check_function(ctx, info.node, donated))
+        return out
+
+    def _donated_bindings(
+            self, ctx: ModuleContext,
+            body: Sequence[ast.stmt]) -> Dict[str, Tuple[Tuple[int, ...],
+                                                         Tuple[str, ...]]]:
+        """name → donation spec for ``g = jax.jit(..., donate_argnums=…)``
+        assignments directly in ``body`` (no nested descent: inner scopes
+        collect their own)."""
+        out: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+        for stmt in body:
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            spec = donated_spec(ctx, stmt.value)
+            if spec is None:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = spec
+        return out
+
+    def _check_function(self, ctx: ModuleContext, fn_node: ast.AST,
+                        donated) -> List[Finding]:
+        out: List[Finding] = []
+        # gather calls of donated callables (by name, or direct jit(...)())
+        for node in walk_function(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = None
+            shown = ""
+            if isinstance(node.func, ast.Name) and node.func.id in donated:
+                spec = donated[node.func.id]
+                shown = node.func.id
+            elif isinstance(node.func, ast.Call):
+                spec = donated_spec(ctx, node.func)
+                shown = "jax.jit(...)"
+            if spec is None:
+                continue
+            nums, names = spec
+            victims: List[Tuple[str, ast.Name]] = []
+            for i in nums:
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    victims.append((node.args[i].id, node.args[i]))
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, ast.Name):
+                    victims.append((kw.value.id, kw.value))
+            if victims:
+                out.extend(self._reads_after(ctx, fn_node, node, shown,
+                                             victims))
+        return out
+
+    def _reads_after(self, ctx: ModuleContext, fn_node: ast.AST,
+                     call: ast.Call, shown: str,
+                     victims: List[Tuple[str, ast.Name]]) -> List[Finding]:
+        stmt = self._enclosing_stmt(ctx, call)
+        rebound_here = _assigned_names(stmt) if stmt is not None else set()
+        end = getattr(stmt, "end_lineno", call.lineno) if stmt is not None \
+            else call.lineno
+        loop = self._enclosing_loop(ctx, stmt, fn_node)
+        out: List[Finding] = []
+        for name, arg_node in victims:
+            if name in rebound_here:
+                continue  # state = step(state, …): the donation idiom
+            use = self._first_read_after(fn_node, name, end)
+            if use is None and loop is not None \
+                    and not self._stored_in(loop, name):
+                # the loop back-edge: nothing in the loop rebinds the
+                # victim, so iteration N+1's call argument reads the
+                # buffer iteration N donated
+                use = arg_node
+            if use is not None:
+                out.append(self.finding(
+                    ctx, use,
+                    f"`{name}` was donated to {shown}() "
+                    "(donate_argnums) and is read afterwards — the "
+                    "buffer is deleted on TPU after the call (and "
+                    "silently aliased on CPU); use the returned value "
+                    "or drop the donation"))
+        return out
+
+    @staticmethod
+    def _enclosing_loop(ctx: ModuleContext, stmt: Optional[ast.AST],
+                        fn_node: ast.AST) -> Optional[ast.AST]:
+        cur = stmt
+        while cur is not None and cur is not fn_node:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return cur
+            cur = ctx.parents.get(cur)
+        return None
+
+    @staticmethod
+    def _stored_in(scope: ast.AST, name: str) -> bool:
+        """Any Store of ``name`` within ``scope``, nested defs excluded
+        (they run on their own frame and bind their own scope)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Name) and node.id == name \
+                    and isinstance(node.ctx, ast.Store):
+                return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def _enclosing_stmt(self, ctx: ModuleContext,
+                        node: ast.AST) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = ctx.parents.get(cur)
+        return cur
+
+    def _first_read_after(self, fn_node: ast.AST, name: str,
+                          after_line: int) -> Optional[ast.Name]:
+        """First Load of ``name`` after ``after_line`` that is not preceded
+        by a DOMINATING rebinding — a store whose branch path is a prefix
+        of the load's. ``if err: state = reset()`` only clears reads on
+        the ``err`` path; the fall-through still reads the donated buffer."""
+        paths = _branch_paths(fn_node)
+        events: List[Tuple[int, str, ast.AST]] = []
+        for node in walk_function(fn_node):
+            if isinstance(node, ast.Name) and node.id == name:
+                kind = "store" if isinstance(node.ctx, ast.Store) else "load"
+                events.append((node.lineno, kind, node))
+        events.sort(key=lambda e: e[0])
+        stores: List[Tuple] = []
+        for line, kind, node in events:
+            if line <= after_line:
+                continue
+            p = paths.get(id(node), ())
+            if kind == "store":
+                stores.append(p)
+                continue
+            if any(p[:len(sp)] == sp for sp in stores):
+                continue  # every path to this read rebound the name
+            return node
+        return None

@@ -6,8 +6,13 @@ the step loop blocks the host on the device stream every step, draining
 the dispatch pipeline — silent, and worth double-digit % of step time.
 
 "Hot path" = any function whose bare name matches a configured
-``hot-functions`` pattern, plus everything reachable from one through the
-intra-module call graph (call, reference, and nesting edges).
+``hot-functions`` pattern, plus any code inside a ``# dtxlint: hot-begin``
+/ ``# dtxlint: hot-end`` region, plus everything reachable from either
+through the call graph (call, reference, and nesting edges). With the
+program graph on (the default for ``dtx lint``), reachability crosses
+module boundaries — this per-module rule is then replaced by the
+program-level pass in ``analysis/program.py``, which reuses the helpers
+here.
 
 Not flagged: ``float()``/``int()`` of plain constants (unit conversion,
 argument parsing) — only conversions of computed values can sync.
@@ -16,7 +21,7 @@ argument parsing) — only conversions of computed values can sync.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Iterable, List, Set, Tuple
 
 from datatunerx_tpu.analysis.callgraph import walk_function
 from datatunerx_tpu.analysis.core import Finding, ModuleContext, Rule
@@ -32,6 +37,73 @@ _SYNC_CALLS = {
 _SYNC_METHODS = {"item", "block_until_ready", "tolist"}
 
 
+def sync_label(ctx: ModuleContext, node: ast.Call) -> str:
+    """Human label when ``node`` is a host-sync call, else ''. Shared by
+    the per-module rule, the program-level pass, and DTX009."""
+    func = node.func
+    # float(x)/int(x) of a computed value
+    if isinstance(func, ast.Name) and func.id in ("float", "int"):
+        if node.args and not isinstance(node.args[0], ast.Constant):
+            return f"{func.id}() on a device value"
+        return ""
+    resolved = ctx.resolve(func)
+    if resolved in _SYNC_CALLS:
+        return f"{_SYNC_CALLS[resolved]}()"
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+        return f".{func.attr}()"
+    return ""
+
+
+def hot_roots(ctx: ModuleContext) -> Set[str]:
+    """Module-local hot roots: functions matching a hot-functions pattern,
+    functions DEFINED inside a hot region, and local targets of calls made
+    from inside a hot region (at module import time or within any
+    function)."""
+    graph = ctx.graph
+    roots = set(graph.reachable(tuple(ctx.config.hot_functions)))
+    if not ctx.hot_regions:
+        return roots
+    for qualname, info in graph.functions.items():
+        if ctx.in_hot_region(info.lineno):
+            roots.add(qualname)
+    for caller, sites in graph.edge_sites.items():
+        for target, line in sites:
+            if ctx.in_hot_region(line):
+                roots.add(target)
+    for target, line in graph.module_sites:
+        if ctx.in_hot_region(line):
+            roots.add(target)
+    return roots
+
+
+def region_sync_findings(rule: Rule, ctx: ModuleContext,
+                         hot: Set[str]) -> List[Tuple[ast.Call, str, str]]:
+    """(call node, label, where) for sync calls lexically inside a hot
+    region but NOT already covered by a hot function in ``hot`` — so a
+    marked step loop inside an otherwise-cold ``main`` still flags."""
+    out: List[Tuple[ast.Call, str, str]] = []
+    if not ctx.hot_regions:
+        return out
+    covered_spans = []
+    for qualname in hot:
+        info = ctx.graph.functions.get(qualname)
+        if info is not None:
+            covered_spans.append(
+                (info.lineno, getattr(info.node, "end_lineno", info.lineno),
+                 qualname))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not ctx.in_hot_region(node.lineno):
+            continue
+        if any(s <= node.lineno <= e for s, e, _ in covered_spans):
+            continue
+        label = sync_label(ctx, node)
+        if label:
+            out.append((node, label, "a `# dtxlint: hot-begin` region"))
+    return out
+
+
 class HostSyncInHotPath(Rule):
     id = "DTX001"
     name = "host-sync-in-hot-path"
@@ -39,13 +111,13 @@ class HostSyncInHotPath(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         out: List[Finding] = []
-        hot = ctx.graph.reachable(tuple(ctx.config.hot_functions))
+        hot = ctx.graph.reachable_from(hot_roots(ctx))
         for qualname in sorted(hot):
             info = ctx.graph.functions[qualname]
             for node in walk_function(info.node):
                 if not isinstance(node, ast.Call):
                     continue
-                label = self._sync_label(ctx, node)
+                label = sync_label(ctx, node)
                 if label:
                     out.append(self.finding(
                         ctx, node,
@@ -54,18 +126,10 @@ class HostSyncInHotPath(Rule):
                         "this blocks the host on the device stream every "
                         "step — move it behind a logging boundary or use "
                         "MetricsBuffer"))
+        for node, label, where in region_sync_findings(self, ctx, hot):
+            out.append(self.finding(
+                ctx, node,
+                f"{label} in hot path (inside {where}); this blocks the "
+                "host on the device stream every step — move it behind a "
+                "logging boundary or use MetricsBuffer"))
         return out
-
-    def _sync_label(self, ctx: ModuleContext, node: ast.Call) -> str:
-        func = node.func
-        # float(x)/int(x) of a computed value
-        if isinstance(func, ast.Name) and func.id in ("float", "int"):
-            if node.args and not isinstance(node.args[0], ast.Constant):
-                return f"{func.id}() on a device value"
-            return ""
-        resolved = ctx.resolve(func)
-        if resolved in _SYNC_CALLS:
-            return f"{_SYNC_CALLS[resolved]}()"
-        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
-            return f".{func.attr}()"
-        return ""

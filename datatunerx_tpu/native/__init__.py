@@ -52,7 +52,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        so = _build()
+        # serializing every caller behind the one-time first-use compile is
+        # the point (a second concurrent g++ on the same .so would race);
+        # the subprocess.run inside carries timeout=120
+        so = _build()  # dtxlint: disable=DTX009 -- deliberate one-time build under lock
         if so is None:
             return None
         try:

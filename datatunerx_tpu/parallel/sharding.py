@@ -141,7 +141,10 @@ def place_batch(batch: dict, mesh: Optional[Mesh], accum: bool = False) -> dict:
         import numpy as np
 
         return {
-            k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))
+            # v is the host-local numpy slice from the input pipeline (never
+            # a device array): asarray is the no-copy coercion
+            # make_array_from_process_local_data requires, not a device sync
+            k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))  # dtxlint: disable=DTX001 -- host numpy, no sync
             for k, v in flat.items()
         }
     return {k: jax.device_put(v, sh[k]) for k, v in flat.items()}

@@ -396,6 +396,8 @@ def run(args: TrainArgs) -> dict:
         else:
             batches = src
         try:
+            # dtxlint: hot-begin -- the step loop: one iteration per train
+            # step, so any host sync here stalls the dispatch pipeline
             for batch in batches:
                 saw_batch = True
                 if step >= total_steps:
@@ -408,7 +410,9 @@ def run(args: TrainArgs) -> dict:
                 state, metrics = step_fn(state, batch)
                 step += 1
                 if profiling["active"] and step >= profiling["until"]:
-                    jax.block_until_ready(metrics["loss"])
+                    # one-shot sync when the profiler window closes, so the
+                    # trace contains finished steps; not a per-step stall
+                    jax.block_until_ready(metrics["loss"])  # dtxlint: disable=DTX001
                     jax.profiler.stop_trace()
                     profiling.update(active=False, done=True)
                     if is_main:
@@ -430,6 +434,9 @@ def run(args: TrainArgs) -> dict:
                 if eval_examples and args.eval_steps > 0 and step % args.eval_steps == 0:
                     _run_eval(trainer, state, eval_examples, args, pad_id, logger,
                               step, is_main, dist)
+                # dtxlint: hot-end -- the periodic generative eval below is
+                # host-driven autoregressive decode by design (small sample,
+                # main process only); its syncs are inherent, not stalls
                 if (args.predict_with_generate and eval_records
                         and args.generate_eval_steps > 0
                         and step % args.generate_eval_steps == 0

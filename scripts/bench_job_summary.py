@@ -1,0 +1,49 @@
+"""Append one bench JSON line's provenance to the GitHub job summary.
+
+Usage: bench_job_summary.py LABEL FILE — FILE holds a bench.py run's stdout;
+the last JSON object with a "metric" key is the line. The row leads with the
+explicit `platform` / `cpu_fallback` fields so a CPU-only smoke round can
+never be skim-read as TPU signal in the checks tab.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print("usage: bench_job_summary.py LABEL FILE", file=sys.stderr)
+        return 2
+    label, path = sys.argv[1], sys.argv[2]
+    last = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                try:
+                    obj = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and "metric" in obj:
+                    last = obj
+    except OSError:
+        pass
+    if last is None:
+        row = f"- **{label}**: no bench JSON line produced"
+    else:
+        row = (f"- **{label}**: `platform={last.get('platform', '?')}` "
+               f"`cpu_fallback={last.get('cpu_fallback', '?')}` — "
+               f"{last.get('metric')} = {last.get('value')} "
+               f"{last.get('unit', '')}")
+    summary = os.environ.get("GITHUB_STEP_SUMMARY")
+    if summary:
+        with open(summary, "a", encoding="utf-8") as f:
+            f.write(row + "\n")
+    print(row)
+    # a missing line means bench crashed or printed garbage — the step must
+    # go red (the smoke jobs are continue-on-error, so this never blocks)
+    return 0 if last is not None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
