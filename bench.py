@@ -166,7 +166,7 @@ def child_tinyllama():
     print(json.dumps(line))
 
 
-def child_serve():
+def child_serve(preflight=None):
     """DTX_BENCH_SERVE=1: continuous-batching serve bench. A mixed long/short
     chat workload runs through one BatchedEngine (paged KV cache + chunked
     prefill by default; DTX_BENCH_SERVE_PAGED=0 compares the dense cache) and
@@ -248,11 +248,12 @@ def child_serve():
 
     errors = [e for _, _, e in per_req if e]
     ttfts = sorted((s[0] - t0) for t0, s, e in per_req if s and not e)
-    tpots = [(s[-1] - s[0]) / (len(s) - 1)
-             for _, s, e in per_req if len(s) > 1 and not e]
+    tpots = sorted((s[-1] - s[0]) / (len(s) - 1)
+                   for _, s, e in per_req if len(s) > 1 and not e)
     total_tokens = sum(len(s) for _, s, _ in per_req)
     mean = lambda xs: sum(xs) / len(xs) if xs else 0.0
-    p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] if ttfts else 0.0
+    pct = lambda xs, q: (xs[min(len(xs) - 1, int(q * len(xs)))]
+                         if xs else 0.0)
     tag = (f"{model},slots{slots}," +
            (f"paged,bs{block},budget{budget}" if paged else "dense"))
     line = {
@@ -269,45 +270,107 @@ def child_serve():
             "errors": len(errors),
             "tokens": total_tokens,
             "ttft_ms_mean": round(mean(ttfts) * 1e3, 1),
-            "ttft_ms_p95": round(p95 * 1e3, 1),
+            "ttft_ms_p50": round(pct(ttfts, 0.5) * 1e3, 1),
+            "ttft_ms_p95": round(pct(ttfts, 0.95) * 1e3, 1),
             "tpot_ms_mean": round(mean(tpots) * 1e3, 2),
+            "tpot_ms_p50": round(pct(tpots, 0.5) * 1e3, 2),
+            "tpot_ms_p95": round(pct(tpots, 0.95) * 1e3, 2),
             "prefill_stats": dict(eng.prefill_stats),
         },
     }
+    if preflight is not None:
+        line["preflight"] = preflight
     print(json.dumps(line), flush=True)
 
 
 # ------------------------------------------------------------- orchestrator
 
-def _preflight_device_ok():
-    """Probe the default device with a tiny matmul in a SUBPROCESS, retrying
-    over a window.
+# The probe reports each phase AS IT COMPLETES (one JSON line, flushed), so
+# when the backend wedges the parent can read the partial stdout of the
+# killed child and name the phase that hung — backend init, the first XLA
+# compile, or the first execution. That turns the ROADMAP "TPU hang since
+# r03" line from a mystery into a diagnosis.
+PREFLIGHT_PHASES = ("backend_init", "first_compile", "first_execute")
+
+_PREFLIGHT_CODE = """\
+import json, os, time
+t0 = time.perf_counter()
+import jax
+if os.environ.get("DTX_BENCH_FORCE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+dev = jax.devices()[0]
+t1 = time.perf_counter()
+print(json.dumps({"phase": "backend_init", "ms": round((t1 - t0) * 1e3, 1),
+                  "platform": dev.platform}), flush=True)
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+compiled = jax.jit(lambda a: a @ a).lower(x).compile()
+t2 = time.perf_counter()
+print(json.dumps({"phase": "first_compile",
+                  "ms": round((t2 - t1) * 1e3, 1)}), flush=True)
+out = float(compiled(x)[0, 0])
+t3 = time.perf_counter()
+print(json.dumps({"phase": "first_execute", "ms": round((t3 - t2) * 1e3, 1),
+                  "result": out}), flush=True)
+"""
+
+
+def _preflight_probe():
+    """Probe the default device in a SUBPROCESS with per-phase timing,
+    retrying over a window.
 
     The tunneled TPU backend wedges by hanging (not erroring), and once a
     process has initialized the wedged platform it cannot recover — so each
     probe must be isolated. The wedge is transient (VERDICT r2 weak #1), so
     one failed probe is not evidence: retry a few times before degrading.
+
+    Returns a report dict written into the bench JSON: ``ok``, ``attempts``,
+    ``phases_ms`` (per completed phase), ``platform``, and — on failure —
+    ``timed_out_phase`` / ``failed_phase`` naming where the probe died.
     """
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((256, 256), jnp.float32);"
-        "print(float((x @ x)[0, 0]))"
-    )
+    report = {"ok": False, "attempts": 0, "phases_ms": {}, "platform": None,
+              "timed_out_phase": None, "failed_phase": None}
     for attempt in range(PREFLIGHT_TRIES):
+        report["attempts"] = attempt + 1
+        timed_out = False
         try:
             p = subprocess.run(
-                [sys.executable, "-c", code],
+                [sys.executable, "-c", _PREFLIGHT_CODE],
                 timeout=PREFLIGHT_TIMEOUT_S, capture_output=True, text=True,
             )
-            if p.returncode == 0 and "256.0" in p.stdout:
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        print(f"[bench] pre-flight attempt {attempt + 1}/{PREFLIGHT_TRIES} "
-              f"failed (device hung or errored)", file=sys.stderr)
+            stdout = p.stdout or ""
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            stdout = e.stdout or b""
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode("utf-8", "replace")
+        phases, result = {}, None
+        for ln in stdout.splitlines():
+            try:
+                obj = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "phase" in obj:
+                phases[obj["phase"]] = obj.get("ms")
+                report["platform"] = obj.get("platform",
+                                             report["platform"])
+                result = obj.get("result", result)
+        report["phases_ms"] = phases
+        if all(ph in phases for ph in PREFLIGHT_PHASES) and result == 256.0:
+            report.update(ok=True, timed_out_phase=None, failed_phase=None)
+            return report
+        # the phase the child died in: the first that never reported done
+        hung = next((ph for ph in PREFLIGHT_PHASES if ph not in phases),
+                    PREFLIGHT_PHASES[-1])
+        report["timed_out_phase" if timed_out else "failed_phase"] = hung
+        done = [ph for ph in PREFLIGHT_PHASES if ph in phases]
+        print(f"[bench] pre-flight attempt {attempt + 1}/{PREFLIGHT_TRIES}: "
+              f"device {'hung' if timed_out else 'errored'} in phase "
+              f"'{hung}' (completed: {', '.join(done) or 'none'})",
+              file=sys.stderr)
         if attempt + 1 < PREFLIGHT_TRIES:
             time.sleep(PREFLIGHT_SLEEP_S)
-    return False
+    return report
 
 
 def _run_child(argv, timeout_s, env_extra=None):
@@ -379,6 +442,11 @@ def main():
     def remaining():
         return DEADLINE_S - (time.monotonic() - t_start)
 
+    # the probe runs even forced-CPU (it probes the CPU backend then):
+    # every bench line carries per-phase pre-flight timing, and a
+    # cpu_fallback line names the phase the TPU died in
+    preflight = _preflight_probe()
+
     def emit_cpu_fallback():
         # CPU smoke: explicitly marked; can never read as a TPU result.
         line = _run_child(
@@ -391,13 +459,15 @@ def main():
                     "unit": "cpu smoke failed", "vs_baseline": None}
         line["cpu_fallback"] = True
         line["vs_baseline"] = None
+        line["preflight"] = preflight
         ev = _tpu_evidence()
         if ev is not None:
             line["tpu_evidence"] = ev
         print(json.dumps(line), flush=True)
 
     forced_cpu = bool(os.environ.get("DTX_BENCH_FORCE_CPU"))
-    on_tpu = False if forced_cpu else _preflight_device_ok()
+    on_tpu = (not forced_cpu and preflight["ok"]
+              and preflight.get("platform") == "tpu")
 
     if not on_tpu:
         return emit_cpu_fallback()
@@ -445,12 +515,15 @@ def main():
     out = dict(headline)
     if secondary is not None:
         out["secondary"] = secondary
+    out["preflight"] = preflight
     print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
     if os.environ.get("DTX_BENCH_SERVE"):
-        child_serve()
+        # serve mode is its own entry (no orchestrator): probe first so the
+        # serve line carries the same per-phase pre-flight diagnosis
+        child_serve(preflight=_preflight_probe())
     elif "--child" in sys.argv:
         child_tinyllama()
     else:

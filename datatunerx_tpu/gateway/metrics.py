@@ -1,172 +1,28 @@
-"""Prometheus metrics registry + correct text exposition.
+"""Compatibility shim: the metrics registry moved to ``obs/metrics.py``.
 
-The serving server hand-assembles its exposition lines; the gateway has
-enough series (labeled counters, histograms, per-replica gauges) that a tiny
-registry pays for itself and guarantees the format invariants the scraper
-relies on: one # TYPE line per metric name preceding all its samples, no
-duplicate series, label values escaped per the exposition spec
-(backslash, double-quote, newline).
+PR 2 grew the registry here for the gateway's own exposition; PR 7 promoted
+it to the shared observability plane (``datatunerx_tpu/obs``) so the serving
+server and training logger build their expositions from the same classes.
+Existing imports (`from datatunerx_tpu.gateway.metrics import Registry`)
+keep working through this re-export.
 """
 
-from __future__ import annotations
+from datatunerx_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    MS_BUCKETS,
+    Histogram,
+    Metric,
+    Registry,
+    escape_label_value,
+    format_sample,
+)
 
-import threading
-from typing import Dict, List, Optional, Sequence, Tuple
-
-LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
-                   60.0, float("inf"))
-
-
-def escape_label_value(v: str) -> str:
-    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
-            .replace('"', r'\"'))
-
-
-def format_sample(name: str, labels: Optional[dict], value) -> str:
-    if labels:
-        inner = ",".join(
-            f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
-        )
-        return f"{name}{{{inner}}} {value}"
-    return f"{name} {value}"
-
-
-class Metric:
-    def __init__(self, name: str, mtype: str, help_text: str = ""):
-        self.name = name
-        self.mtype = mtype
-        self.help_text = help_text
-        self._lock = threading.Lock()
-        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
-
-    def _key(self, labels: Optional[dict]):
-        return tuple(sorted((labels or {}).items()))
-
-    def inc(self, labels: Optional[dict] = None, by: float = 1.0):
-        with self._lock:
-            k = self._key(labels)
-            self._series[k] = self._series.get(k, 0.0) + by
-
-    def set(self, value: float, labels: Optional[dict] = None):
-        with self._lock:
-            self._series[self._key(labels)] = float(value)
-
-    def get(self, labels: Optional[dict] = None) -> float:
-        with self._lock:
-            return self._series.get(self._key(labels), 0.0)
-
-    def clear(self):
-        """Drop all series (per-replica gauges are re-stated each scrape so
-        removed replicas don't linger as stale series)."""
-        with self._lock:
-            self._series.clear()
-
-    def expose(self) -> List[str]:
-        lines = []
-        if self.help_text:
-            lines.append(f"# HELP {self.name} {self.help_text}")
-        lines.append(f"# TYPE {self.name} {self.mtype}")
-        with self._lock:
-            for key, value in sorted(self._series.items()):
-                fv = int(value) if float(value).is_integer() else value
-                lines.append(format_sample(self.name, dict(key), fv))
-        return lines
-
-
-class Histogram:
-    """Cumulative-bucket histogram (classic Prometheus shape)."""
-
-    def __init__(self, name: str, help_text: str = "",
-                 buckets: Sequence[float] = LATENCY_BUCKETS):
-        self.name = name
-        self.help_text = help_text
-        self.buckets = tuple(buckets)
-        if self.buckets[-1] != float("inf"):
-            self.buckets = self.buckets + (float("inf"),)
-        self._counts = [0] * len(self.buckets)
-        self._sum = 0.0
-        self._total = 0
-        self._lock = threading.Lock()
-
-    def observe(self, value: float):
-        with self._lock:
-            self._sum += value
-            self._total += 1
-            for i, edge in enumerate(self.buckets):
-                if value <= edge:
-                    self._counts[i] += 1
-                    break
-
-    def percentile(self, q: float) -> float:
-        """Approximate quantile from bucket upper edges (the autoscale
-        signal's p95; the +inf bucket reports the largest finite edge)."""
-        with self._lock:
-            if self._total == 0:
-                return 0.0
-            target = q * self._total
-            run = 0
-            for i, edge in enumerate(self.buckets):
-                run += self._counts[i]
-                if run >= target:
-                    if edge == float("inf"):
-                        return self.buckets[-2] if len(self.buckets) > 1 else 0.0
-                    return edge
-            return self.buckets[-2]
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._total
-
-    def expose(self) -> List[str]:
-        lines = []
-        if self.help_text:
-            lines.append(f"# HELP {self.name} {self.help_text}")
-        lines.append(f"# TYPE {self.name} histogram")
-        with self._lock:
-            cumulative = 0
-            for i, edge in enumerate(self.buckets):
-                cumulative += self._counts[i]
-                le = "+Inf" if edge == float("inf") else repr(edge)
-                lines.append(format_sample(
-                    f"{self.name}_bucket", {"le": le}, cumulative))
-            lines.append(f"{self.name}_sum {self._sum}")
-            lines.append(f"{self.name}_count {self._total}")
-        return lines
-
-
-class Registry:
-    def __init__(self):
-        self._metrics: "Dict[str, object]" = {}
-        self._lock = threading.Lock()
-
-    def counter(self, name: str, help_text: str = "") -> Metric:
-        return self._register(name, "counter", help_text)
-
-    def gauge(self, name: str, help_text: str = "") -> Metric:
-        return self._register(name, "gauge", help_text)
-
-    def histogram(self, name: str, help_text: str = "",
-                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help_text, buckets)
-                self._metrics[name] = m
-            return m
-
-    def _register(self, name: str, mtype: str, help_text: str) -> Metric:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Metric(name, mtype, help_text)
-                self._metrics[name] = m
-            return m
-
-    def expose(self) -> str:
-        with self._lock:
-            metrics = list(self._metrics.values())
-        lines: List[str] = []
-        for m in metrics:
-            lines.extend(m.expose())
-        return "\n".join(lines) + "\n"
+__all__ = [
+    "LATENCY_BUCKETS",
+    "MS_BUCKETS",
+    "Histogram",
+    "Metric",
+    "Registry",
+    "escape_label_value",
+    "format_sample",
+]

@@ -27,7 +27,15 @@ from typing import Dict, Iterator, List, Optional
 
 class ReplicaError(Exception):
     """A replica failed to serve a request (connection refused, died
-    mid-stream, 5xx). The gateway fails over; the breaker records it."""
+    mid-stream, 5xx). The gateway fails over; the breaker records it.
+
+    ``status`` optionally carries the upstream HTTP status (e.g. a
+    replica's 409 profile-conflict) so the gateway can relay the real
+    code instead of guessing from the message text."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
 
 
 def _client_error_message(e: BaseException) -> str:
@@ -124,6 +132,19 @@ class Replica:
         replica)."""
         return self.stats()
 
+    # -------------------------------------------------------- observability
+    def fetch_trace(self, trace_id: str) -> Optional[dict]:
+        """The replica's span timeline for one trace id (None = unknown or
+        unsupported) — the gateway merges this into its own trace view so
+        GET /debug/trace/<id> spans gateway→replica→engine."""
+        return None
+
+    def start_profile(self, seconds: float,
+                      log_dir: Optional[str] = None) -> Optional[dict]:
+        """Arm an N-second jax.profiler capture on the replica (None =
+        unsupported; raises ReplicaError on a refused/failed capture)."""
+        return None
+
     # ------------------------------------------------------------ lifecycle
     def available(self) -> bool:
         return self.healthy and not self.draining and self.breaker.allow()
@@ -175,8 +196,16 @@ class InProcessReplica(Replica):
         super().__init__(name, **kw)
         self.engine = engine
 
+    def _trace_kwargs(self, kwargs: dict) -> dict:
+        """Engines that keep span timelines (BatchedEngine) take the trace
+        id; duck-typed stand-ins get it popped like before."""
+        trace_id = kwargs.pop("trace_id", "")
+        if trace_id and getattr(self.engine, "trace_store", None) is not None:
+            kwargs["trace_id"] = trace_id
+        return kwargs
+
     def chat(self, messages, **kwargs) -> str:
-        kwargs.pop("trace_id", None)
+        kwargs = self._trace_kwargs(kwargs)
         try:
             return self.engine.chat(messages, **kwargs)
         except (ValueError, KeyError) as e:
@@ -189,8 +218,10 @@ class InProcessReplica(Replica):
             raise ReplicaError(f"{self.name}: {e}") from e
 
     def chat_stream(self, messages, **kwargs):
-        kwargs.pop("trace_id", None)
+        kwargs = self._trace_kwargs(kwargs)
         stream_fn = getattr(self.engine, "chat_stream", None)
+        if stream_fn is None:
+            kwargs.pop("trace_id", None)  # duck-typed chat may not take it
         try:
             if stream_fn is None:
                 yield self.engine.chat(messages, **kwargs)
@@ -214,6 +245,35 @@ class InProcessReplica(Replica):
         else:
             self.healthy = self.engine is not None
         return self.healthy
+
+    def fetch_trace(self, trace_id: str) -> Optional[dict]:
+        store = getattr(self.engine, "trace_store", None)
+        if store is None:
+            return None
+        return store.get(trace_id)
+
+    def start_profile(self, seconds: float,
+                      log_dir: Optional[str] = None) -> Optional[dict]:
+        """In-process replica = the gateway's own process, so the capture
+        covers the engine's decode/prefill ticks directly. Raises
+        ValueError for a dir escaping the allowed root (client error) and
+        ReplicaError(status=409) when a capture is already running."""
+        from datatunerx_tpu.obs.profiling import (
+            process_profiler,
+            resolve_profile_dir,
+        )
+
+        log_dir = resolve_profile_dir(log_dir)
+        try:
+            effective = process_profiler().start(log_dir, seconds)
+        except Exception as e:  # noqa: BLE001 — profiler fault, not replica
+            raise ReplicaError(f"{self.name}: profiler failed: {e}") from e
+        if effective is None:
+            raise ReplicaError(
+                f"{self.name}: a profile capture is already running",
+                status=409)
+        return {"profiling": log_dir, "seconds": effective,
+                "replica": self.name}
 
     def stats(self) -> dict:
         slot_req = getattr(self.engine, "_slot_req", None)
@@ -334,6 +394,40 @@ class HTTPReplica(Replica):
             self.healthy = False
         return self.healthy
 
+    def fetch_trace(self, trace_id: str) -> Optional[dict]:
+        """GET the replica's half of a trace. Debug path, not routing: a
+        short timeout and None on any failure (the gateway still returns
+        its own spans)."""
+        try:
+            with urllib.request.urlopen(
+                    self.base_url + "/debug/trace/" + trace_id,
+                    timeout=2) as r:
+                return json.load(r)
+        except Exception:  # noqa: BLE001 — trace fetch is best-effort
+            return None
+
+    def start_profile(self, seconds: float,
+                      log_dir: Optional[str] = None) -> Optional[dict]:
+        payload: dict = {"seconds": seconds}
+        if log_dir:
+            payload["dir"] = log_dir
+        try:
+            with self._post("/debug/profile", payload) as r:
+                out = json.load(r)
+            out["replica"] = self.name
+            return out
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.load(e).get("error", e.reason)
+            except Exception:  # noqa: BLE001
+                detail = e.reason
+            # carry the replica's real status (409 conflict, 400 bad dir)
+            # so the gateway relays it instead of guessing from the text
+            raise ReplicaError(f"{self.name}: {detail}",
+                               status=e.code) from e
+        except (OSError, ValueError) as e:
+            raise ReplicaError(f"{self.name}: {e}") from e
+
     def stats(self) -> dict:
         now = time.monotonic()
         if (self._stats_cache is not None
@@ -345,13 +439,17 @@ class HTTPReplica(Replica):
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
                 for line in r.read().decode().splitlines():
+                    # *_capacity is the PR 7 name; *_total accepted so a new
+                    # gateway can front not-yet-restarted older replicas
                     if line.startswith("dtx_serving_slots_busy "):
                         out["slots_busy"] = int(float(line.split()[-1]))
-                    elif line.startswith("dtx_serving_slots_total "):
+                    elif line.startswith(("dtx_serving_slots_capacity ",
+                                          "dtx_serving_slots_total ")):
                         out["slots_total"] = int(float(line.split()[-1]))
                     elif line.startswith("dtx_serving_kv_blocks_free "):
                         out["kv_blocks_free"] = int(float(line.split()[-1]))
-                    elif line.startswith("dtx_serving_kv_blocks_total "):
+                    elif line.startswith(("dtx_serving_kv_blocks_capacity ",
+                                          "dtx_serving_kv_blocks_total ")):
                         out["kv_blocks_total"] = int(float(line.split()[-1]))
         except Exception:  # noqa: BLE001 — stats are advisory
             pass

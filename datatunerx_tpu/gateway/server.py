@@ -36,7 +36,7 @@ from typing import List, Optional
 
 from datatunerx_tpu.gateway.admission import AdmissionController, Overloaded
 from datatunerx_tpu.gateway.autoscale import autoscale_hint
-from datatunerx_tpu.gateway.metrics import Registry
+from datatunerx_tpu.gateway.metrics import MS_BUCKETS, Registry
 from datatunerx_tpu.gateway.replica_pool import (
     HTTPReplica,
     NoReplicaAvailable,
@@ -45,6 +45,8 @@ from datatunerx_tpu.gateway.replica_pool import (
     ReplicaPool,
 )
 from datatunerx_tpu.gateway.router import Router
+from datatunerx_tpu.obs.metrics import set_build_info, set_uptime
+from datatunerx_tpu.obs.trace import Span, Tracer, TraceStore
 from datatunerx_tpu.serving.local_backend import _free_port
 
 
@@ -54,13 +56,16 @@ class Gateway:
 
     def __init__(self, pool: ReplicaPool, policy: str = "least_busy",
                  admission: Optional[AdmissionController] = None,
-                 max_attempts: int = 3, model_name: str = ""):
+                 max_attempts: int = 3, model_name: str = "",
+                 trace_ring: int = 256,
+                 trace_log_path: Optional[str] = None):
         self.pool = pool
         self.router = Router(pool, policy=policy)
         self.admission = admission or AdmissionController()
         self.max_attempts = max_attempts
         self.model_name = model_name
         self.registry = Registry()
+        self.started_at = time.monotonic()
         self._requests = self.registry.counter(
             "dtx_gateway_requests_total", "Requests by terminal HTTP code.")
         self._failovers = self.registry.counter(
@@ -69,6 +74,17 @@ class Gateway:
         self._latency = self.registry.histogram(
             "dtx_gateway_request_latency_seconds",
             "End-to-end request latency through the gateway.")
+        self._queue_wait = self.registry.histogram(
+            "dtx_gateway_queue_wait_ms",
+            "Admission + routing time before the first replica attempt "
+            "(time a request spends queued at the gateway, not serving).",
+            buckets=MS_BUCKETS)
+        # the gateway's half of a request's trace: spans for admission /
+        # route / retry / stream land here, keyed by the X-DTX-Trace-Id the
+        # handler mints; GET /debug/trace/<id> merges the replica's half in
+        self.trace_store = TraceStore(capacity=trace_ring,
+                                      jsonl_path=trace_log_path)
+        self.tracer = Tracer(store=self.trace_store)
         self.replica_set = None  # ManagedReplicaSet when the gateway spawns
         # serializes snapshot-gauge restating (concurrent scrapes would race
         # clear/set and drop per-replica series) and the shed-delta tracking
@@ -97,6 +113,24 @@ class Gateway:
         replica.breaker.record_failure()
         self.router.forget_replica(replica.name)
 
+    # -------------------------------------------------------------- tracing
+    def _begin_request_span(self, name: str, trace_id: str,
+                            adapter: str) -> Span:
+        """Open the gateway's root span for one request. Explicit spans
+        (Tracer.start / finish), not the contextvar manager: chat_stream is
+        a generator and a ``with`` block suspending across yields would
+        leak the contextvar into the HTTP handler's context."""
+        sp = self.tracer.start(name, trace_id=trace_id)
+        if adapter:
+            sp.set(adapter=adapter)
+        return sp
+
+    def _finish_request_span(self, sp: Span, status: str = "ok",
+                             error: Optional[BaseException] = None):
+        if error is not None and "error" not in sp.attrs:
+            sp.set(error=str(error) or type(error).__name__)
+        self.tracer.finish(sp, status=status)
+
     # ----------------------------------------------------------- non-stream
     def chat(self, req: dict, trace_id: str = "",
              session_id: Optional[str] = None) -> str:
@@ -110,26 +144,43 @@ class Gateway:
         if adapter:
             kwargs["adapter"] = adapter
         t0 = time.monotonic()
-        with self.admission.try_admit(messages):
-            tried: set = set()
-            last: Optional[Exception] = None
-            for attempt in range(self.max_attempts):
-                replica = self._route(messages, adapter, session_id, tried)
-                tried.add(replica.name)
-                replica.acquire()
-                try:
-                    text = replica.chat(messages, trace_id=trace_id, **kwargs)
-                    replica.breaker.record_success()
-                    self._latency.observe(time.monotonic() - t0)
-                    return text
-                except ReplicaError as e:
-                    self._replica_failed(replica)
-                    self._failovers.inc()
-                    last = e
-                finally:
-                    replica.release()
-            raise NoReplicaAvailable(
-                f"all {len(tried)} attempted replicas failed: {last}")
+        root = self._begin_request_span("gateway.request", trace_id, adapter)
+        try:
+            with self.admission.try_admit(messages):
+                root.event("admitted")
+                tried: set = set()
+                last: Optional[Exception] = None
+                for attempt in range(self.max_attempts):
+                    replica = self._route(messages, adapter, session_id,
+                                          tried)
+                    tried.add(replica.name)
+                    root.event("route", replica=replica.name,
+                               attempt=attempt)
+                    if attempt == 0:
+                        self._queue_wait.observe(
+                            (time.monotonic() - t0) * 1e3)
+                    replica.acquire()
+                    try:
+                        text = replica.chat(messages, trace_id=root.trace_id,
+                                            **kwargs)
+                        replica.breaker.record_success()
+                        self._latency.observe(time.monotonic() - t0)
+                        root.set(replica=replica.name, attempts=attempt + 1)
+                        self._finish_request_span(root)
+                        return text
+                    except ReplicaError as e:
+                        self._replica_failed(replica)
+                        self._failovers.inc()
+                        root.event("retry", replica=replica.name,
+                                   error=str(e))
+                        last = e
+                    finally:
+                        replica.release()
+                raise NoReplicaAvailable(
+                    f"all {len(tried)} attempted replicas failed: {last}")
+        except BaseException as e:
+            self._finish_request_span(root, status="error", error=e)
+            raise
 
     # --------------------------------------------------------------- stream
     def chat_stream(self, req: dict, trace_id: str = "",
@@ -148,35 +199,58 @@ class Gateway:
         if adapter:
             kwargs["adapter"] = adapter
         t0 = time.monotonic()
-        with self.admission.try_admit(messages):
-            emitted = ""
-            tried: set = set()
-            for attempt in range(self.max_attempts):
-                replica = self._route(messages, adapter, session_id, tried)
-                tried.add(replica.name)
-                replica.acquire()
-                skip = len(emitted)
-                try:
-                    for delta in replica.chat_stream(
-                            messages, trace_id=trace_id, **kwargs):
-                        if skip > 0:
-                            if len(delta) <= skip:
-                                skip -= len(delta)
-                                continue
-                            delta = delta[skip:]
-                            skip = 0
-                        emitted += delta
-                        yield delta
-                    replica.breaker.record_success()
-                    self._latency.observe(time.monotonic() - t0)
-                    return
-                except ReplicaError:
-                    self._replica_failed(replica)
-                    self._failovers.inc()
-                finally:
-                    replica.release()
-            raise NoReplicaAvailable(
-                f"stream failed over {len(tried)} replicas")
+        root = self._begin_request_span("gateway.stream", trace_id, adapter)
+        try:
+            with self.admission.try_admit(messages):
+                root.event("admitted")
+                emitted = ""
+                tried: set = set()
+                for attempt in range(self.max_attempts):
+                    replica = self._route(messages, adapter, session_id,
+                                          tried)
+                    tried.add(replica.name)
+                    root.event("route", replica=replica.name,
+                               attempt=attempt)
+                    if attempt == 0:
+                        self._queue_wait.observe(
+                            (time.monotonic() - t0) * 1e3)
+                    replica.acquire()
+                    skip = len(emitted)
+                    try:
+                        for delta in replica.chat_stream(
+                                messages, trace_id=root.trace_id, **kwargs):
+                            if skip > 0:
+                                if len(delta) <= skip:
+                                    skip -= len(delta)
+                                    continue
+                                delta = delta[skip:]
+                                skip = 0
+                            if not emitted:
+                                root.event("first_delta",
+                                           replica=replica.name)
+                            emitted += delta
+                            yield delta
+                        replica.breaker.record_success()
+                        self._latency.observe(time.monotonic() - t0)
+                        root.set(replica=replica.name, attempts=attempt + 1,
+                                 chars=len(emitted))
+                        self._finish_request_span(root)
+                        return
+                    except ReplicaError as e:
+                        self._replica_failed(replica)
+                        self._failovers.inc()
+                        root.event("retry", replica=replica.name,
+                                   error=str(e),
+                                   resumed_at_char=len(emitted))
+                    finally:
+                        replica.release()
+                raise NoReplicaAvailable(
+                    f"stream failed over {len(tried)} replicas")
+        except BaseException as e:
+            # GeneratorExit included: a client hanging up mid-stream still
+            # closes the gateway's span (status error, error=GeneratorExit)
+            self._finish_request_span(root, status="error", error=e)
+            raise
 
     # ----------------------------------------------------------- perplexity
     def perplexity(self, req: dict, trace_id: str = "") -> dict:
@@ -209,6 +283,52 @@ class Gateway:
         finally:
             replica.release()
 
+    # -------------------------------------------------------- observability
+    def trace(self, trace_id: str) -> Optional[dict]:
+        """The merged end-to-end view of one trace: the gateway's own spans
+        (admission/route/retry/stream) plus every replica's half (engine
+        span timelines with per-request TTFT/TPOT), sorted by wall-clock
+        start. None = no plane has seen the id."""
+        doc = self.trace_store.get(trace_id)
+        spans = list(doc["spans"]) if doc else []
+        for replica in self.pool.replicas():
+            try:
+                rdoc = replica.fetch_trace(trace_id)
+            except Exception:  # noqa: BLE001 — debug path, best-effort
+                rdoc = None
+            if rdoc:
+                for sp in rdoc.get("spans", []):
+                    # copy: an in-process replica hands back references into
+                    # its live ring — annotating those in place would write
+                    # gateway state into the engine's store
+                    sp = dict(sp)
+                    sp.setdefault("replica", replica.name)
+                    spans.append(sp)
+        if not spans:
+            return None
+        spans.sort(key=lambda s: s.get("start_ms") or 0)
+        return {"trace_id": trace_id, "spans": spans}
+
+    def profile(self, seconds: float, log_dir: Optional[str] = None,
+                replica_name: str = "") -> dict:
+        """Arm a jax.profiler window on one replica (named, or the first
+        available). Raises NoReplicaAvailable / ReplicaError /
+        NotImplementedError (replica kind has no profiler)."""
+        if replica_name:
+            replica = self.pool.get(replica_name)
+            if replica is None:
+                raise NoReplicaAvailable(f"no replica {replica_name!r}")
+        else:
+            available = self.pool.available()
+            if not available:
+                raise NoReplicaAvailable("no replica available to profile")
+            replica = available[0]
+        out = replica.start_profile(seconds, log_dir)
+        if out is None:
+            raise NotImplementedError(
+                f"replica {replica.name!r} does not support profiling")
+        return out
+
     # -------------------------------------------------------------- reports
     def healthy(self) -> bool:
         return len(self.pool.available()) > 0
@@ -237,7 +357,13 @@ class Gateway:
 
     def _metrics_text_locked(self) -> str:
         # re-state snapshot gauges at scrape time
+        set_build_info(self.registry, "gateway")
+        set_uptime(self.registry, "gateway", self.started_at)
         g = self.registry.gauge
+        g("dtx_gateway_trace_open_spans",
+          "Spans opened and not yet finished (a growing value means "
+          "leaking request handlers; orphans reap at 10 min).").set(
+            self.tracer.open_count())
         g("dtx_gateway_up", "1 when at least one replica is available.").set(
             1 if self.healthy() else 0)
         g("dtx_gateway_queue_depth",
@@ -516,6 +642,13 @@ def make_handler(gw: Gateway):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.startswith("/debug/trace/"):
+                tid = self.path[len("/debug/trace/"):]
+                doc = self.gateway.trace(tid) if tid else None
+                if doc is None:
+                    self._json(404, {"error": f"no trace {tid!r}"})
+                else:
+                    self._json(200, doc)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -537,6 +670,8 @@ def make_handler(gw: Gateway):
                 self._scale(req, trace_id)
             elif self.path == "/admin/drain":
                 self._drain(req, trace_id)
+            elif self.path == "/debug/profile":
+                self._profile(req, trace_id)
             else:
                 self._json(404, {"error": "not found"}, trace_id)
 
@@ -662,6 +797,33 @@ def make_handler(gw: Gateway):
             else:
                 self._json(404, {"error": f"no replica {name!r}"}, trace_id)
 
+        def _profile(self, req: dict, trace_id: str):
+            """Pass a profiling request through to a replica (serving's
+            POST /debug/profile); in-process replicas capture the gateway's
+            own process."""
+            try:
+                seconds = float(req.get("seconds", 2.0))
+            except (TypeError, ValueError):
+                self._json(400, {"error": "seconds must be a number"},
+                           trace_id)
+                return
+            try:
+                out = self.gateway.profile(
+                    seconds, log_dir=str(req.get("dir") or "") or None,
+                    replica_name=str(req.get("replica") or ""))
+                self._json(202, out, trace_id)
+            except ValueError as e:  # dir escapes the allowed root
+                self._json(400, {"error": str(e)}, trace_id)
+            except NoReplicaAvailable as e:
+                self._json(503, {"error": str(e)}, trace_id)
+            except NotImplementedError as e:
+                self._json(501, {"error": str(e)}, trace_id)
+            except ReplicaError as e:
+                # relay the replica's own status (409 conflict, 400 bad
+                # dir); no status on the error = the replica itself failed
+                code = e.status if e.status in (400, 409) else 502
+                self._json(code, {"error": str(e)}, trace_id)
+
         def log_message(self, *a):
             pass
 
@@ -689,6 +851,12 @@ def main(argv=None):
                    help="model dir or preset:NAME for token-accurate "
                         "admission estimates (defaults to --model_path)")
     p.add_argument("--health_interval", type=float, default=2.0)
+    p.add_argument("--trace_ring", type=int, default=256,
+                   help="completed request traces kept for "
+                        "GET /debug/trace/<id>")
+    p.add_argument("--trace_log", default="",
+                   help="append every completed gateway span as one JSON "
+                        "line to this file (offline trace forensics)")
     p.add_argument("--replica_url", action="append", default=[],
                    help="front an EXISTING serving server (repeatable); "
                         "mutually exclusive with --replicas spawning")
@@ -738,7 +906,9 @@ def main(argv=None):
                      token_budget=args.token_budget,
                      chars_per_token=args.chars_per_token,
                      count_tokens=count_tokens),
-                 model_name=args.model_path)
+                 model_name=args.model_path,
+                 trace_ring=args.trace_ring,
+                 trace_log_path=args.trace_log or None)
     for i, url in enumerate(args.replica_url):
         pool.add(HTTPReplica(f"replica-{i}", url))
     if args.replicas > 0:

@@ -1,0 +1,34 @@
+"""Unified observability plane (stdlib-only, dtxlint house style).
+
+Three pieces, one story — see what every plane of the platform is doing:
+
+  obs.metrics    — the shared Prometheus registry (counters / gauges /
+                   fixed-bucket histograms, one exposition encoder) behind
+                   every /metrics endpoint and the training logger's
+                   ``watch/metrics.prom``.
+  obs.trace      — Dapper-style spans over the gateway's X-DTX-Trace-Id:
+                   context-propagated tracer, bounded trace ring behind
+                   ``GET /debug/trace/<id>``, and the engine bridge that
+                   folds scheduler timelines into per-request spans with
+                   true TTFT/TPOT.
+  obs.profiling  — on-demand N-second ``jax.profiler`` windows behind
+                   ``POST /debug/profile`` (serving + gateway passthrough).
+"""
+
+from datatunerx_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    MS_BUCKETS,
+    Histogram,
+    Metric,
+    Registry,
+    serving_latency_histograms,
+    set_build_info,
+    set_uptime,
+)
+from datatunerx_tpu.obs.profiling import Profiler, process_profiler  # noqa: F401
+from datatunerx_tpu.obs.trace import (  # noqa: F401
+    Span,
+    Tracer,
+    TraceStore,
+    build_request_span,
+)

@@ -1,0 +1,242 @@
+"""Shared Prometheus metrics registry + correct text exposition.
+
+One registry implementation for every plane — the gateway, the serving
+server, and the training MetricsLogger all build their /metrics (or
+``watch/metrics.prom``) exposition from the classes here, so the format
+invariants the scraper relies on hold everywhere: one # TYPE line per
+metric name preceding all its samples, no duplicate series, label values
+escaped per the exposition spec (backslash, double-quote, newline).
+
+Grew out of ``gateway/metrics.py`` (PR 2), which now re-exports from here;
+the serving server's hand-assembled exposition lines and the training
+logger's jsonl-only path both migrate onto this registry in PR 7.
+
+Hot-path discipline (dtxlint DTX001): ``Histogram.observe`` and
+``Metric.inc`` never convert device values — callers observe plain host
+floats that already crossed at a designed sync point (token arrival on
+the engine's host queue, a perf_counter delta). Recording is a short
+uncontended lock around dict/int arithmetic; exposition (the expensive
+string work) happens only at scrape time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   60.0, float("inf"))
+
+# Millisecond-scale buckets for the serving latency histograms
+# (dtx_serving_ttft_ms / dtx_serving_tpot_ms / dtx_gateway_queue_wait_ms /
+# dtx_serving_prefill_chunk_ms). Spans sub-ms decode ticks on a warm TPU up
+# to multi-second cold prefills; fixed edges so replicas aggregate.
+MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+              1000.0, 2500.0, 5000.0, 10000.0, 30000.0, float("inf"))
+
+
+def escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def format_sample(name: str, labels: Optional[dict], value) -> str:
+    if labels:
+        inner = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+class Metric:
+    def __init__(self, name: str, mtype: str, help_text: str = ""):
+        self.name = name
+        self.mtype = mtype
+        self.help_text = help_text
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def _key(self, labels: Optional[dict]):
+        return tuple(sorted((labels or {}).items()))
+
+    def inc(self, labels: Optional[dict] = None, by: float = 1.0):
+        with self._lock:
+            k = self._key(labels)
+            self._series[k] = self._series.get(k, 0.0) + by
+
+    def set(self, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+    def get(self, labels: Optional[dict] = None) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+    def clear(self):
+        """Drop all series (per-replica gauges are re-stated each scrape so
+        removed replicas don't linger as stale series)."""
+        with self._lock:
+            self._series.clear()
+
+    def expose(self) -> List[str]:
+        lines = []
+        if self.help_text:
+            lines.append(f"# HELP {self.name} {self.help_text}")
+        lines.append(f"# TYPE {self.name} {self.mtype}")
+        with self._lock:
+            for key, value in sorted(self._series.items()):
+                fv = int(value) if float(value).is_integer() else value
+                lines.append(format_sample(self.name, dict(key), fv))
+        return lines
+
+
+class Histogram:
+    """Cumulative-bucket histogram (classic Prometheus shape)."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        self.name = name
+        self.help_text = help_text
+        self.buckets = tuple(buckets)
+        if self.buckets[-1] != float("inf"):
+            self.buckets = self.buckets + (float("inf"),)
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self._counts[i] += 1
+                    break
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile from bucket upper edges (the autoscale
+        signal's p95; the +inf bucket reports the largest finite edge)."""
+        with self._lock:
+            if self._total == 0:
+                return 0.0
+            target = q * self._total
+            run = 0
+            for i, edge in enumerate(self.buckets):
+                run += self._counts[i]
+                if run >= target:
+                    if edge == float("inf"):
+                        return self.buckets[-2] if len(self.buckets) > 1 else 0.0
+                    return edge
+            return self.buckets[-2]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    def expose(self) -> List[str]:
+        lines = []
+        if self.help_text:
+            lines.append(f"# HELP {self.name} {self.help_text}")
+        lines.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            cumulative = 0
+            for i, edge in enumerate(self.buckets):
+                cumulative += self._counts[i]
+                le = "+Inf" if edge == float("inf") else repr(edge)
+                lines.append(format_sample(
+                    f"{self.name}_bucket", {"le": le}, cumulative))
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._total}")
+        return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: "Dict[str, object]" = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Metric:
+        return self._register(name, "counter", help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Metric:
+        return self._register(name, "gauge", help_text)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_text, buckets)
+                self._metrics[name] = m
+            return m
+
+    def _register(self, name: str, mtype: str, help_text: str) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Metric(name, mtype, help_text)
+                self._metrics[name] = m
+            return m
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+def serving_latency_histograms(
+        registry: Registry) -> Tuple[Histogram, Histogram, Histogram]:
+    """The serving plane's (ttft, tpot, prefill_chunk) histograms,
+    declared ONCE here: the engine records into them and the serving
+    server pre-declares them at scrape time, and Registry keeps the first
+    registration — two call sites with their own HELP text would make the
+    exposition depend on whether the first scrape beats the engine load."""
+    return (
+        registry.histogram(
+            "dtx_serving_ttft_ms",
+            "Per-request time to first streamed token (queue + prefill + "
+            "first decode chunk).", buckets=MS_BUCKETS),
+        registry.histogram(
+            "dtx_serving_tpot_ms",
+            "Per-request mean inter-token time after the first token.",
+            buckets=MS_BUCKETS),
+        registry.histogram(
+            "dtx_serving_prefill_chunk_ms",
+            "Wall time per chunked-prefill program as seen by the "
+            "scheduler (dispatch + any queue drain on async backends).",
+            buckets=MS_BUCKETS),
+    )
+
+
+# ------------------------------------------------------------ process plumbing
+
+_PROCESS_START = time.monotonic()
+
+
+def set_build_info(registry: Registry, plane: str):
+    """State the ``dtx_build_info`` gauge: value 1, the interesting bits in
+    labels (the node_exporter idiom — joinable against any other series)."""
+    from datatunerx_tpu import __version__
+
+    registry.gauge(
+        "dtx_build_info",
+        "Build/version identity; value is always 1, the payload is the "
+        "labels.").set(1, {"version": __version__, "plane": plane})
+
+
+def set_uptime(registry: Registry, plane: str,
+               started_at: Optional[float] = None):
+    """Re-state the per-plane uptime gauge (call at scrape time).
+    ``started_at`` is a ``time.monotonic()`` stamp; default = process start."""
+    t0 = _PROCESS_START if started_at is None else started_at
+    registry.gauge(
+        f"dtx_{plane}_uptime_seconds",
+        "Seconds since this server process started.").set(
+        round(time.monotonic() - t0, 3))

@@ -1,0 +1,116 @@
+"""On-demand JAX profiler capture behind ``POST /debug/profile``.
+
+The training loop already self-profiles a step window (tuning/train.py,
+``--profile_steps``); serving had nothing — diagnosing a TPOT regression on
+a live replica meant restarting it under a profiler. This module arms
+``jax.profiler`` for an N-second window on request: the serving server
+captures its own process (the engine's decode/prefill ticks are labeled via
+``jax.profiler.TraceAnnotation``, same as PR 3's pipeline annotations), and
+the gateway passes the request through to a replica.
+
+One capture at a time per process — ``jax.profiler.start_trace`` is
+process-global state, so a second concurrent request is refused (409 at the
+HTTP layer) rather than corrupting the active trace.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+MAX_SECONDS = 120.0
+
+
+def resolve_profile_dir(requested: Optional[str] = None) -> str:
+    """Resolve a capture directory, confined under the allowed root.
+
+    /debug/profile is unauthenticated on the serving port (like /admin/*,
+    it trusts the operator network) — but a requested ``dir`` must not turn
+    into arbitrary filesystem writes. Paths resolve under the root
+    (``DTX_PROFILE_DIR``, default the system tempdir): relative requests
+    join it, absolute requests must already lie inside it; anything
+    escaping raises ValueError (a client error, not a server fault).
+    No request → a fresh ``dtx-profile-*`` tempdir under the root."""
+    base = os.path.realpath(
+        os.environ.get("DTX_PROFILE_DIR") or tempfile.gettempdir())
+    if not requested:
+        os.makedirs(base, exist_ok=True)
+        return tempfile.mkdtemp(prefix="dtx-profile-", dir=base)
+    path = os.path.realpath(os.path.join(base, requested))
+    if path != base and not path.startswith(base + os.sep):
+        raise ValueError(
+            f"profile dir {requested!r} escapes the allowed root {base!r} "
+            "(set DTX_PROFILE_DIR to change it)")
+    return path
+
+
+class Profiler:
+    """One-at-a-time background profiler window. ``start`` returns the
+    EFFECTIVE window length (the request clamped to [0.05, MAX_SECONDS] —
+    callers echo this, not the raw request, so an operator never waits on
+    a 600s window that actually stopped at 120), or None when a capture is
+    already running; the worker thread stops the trace after the window
+    elapses (or earlier on ``close``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: Optional[dict] = None
+        self._cancel = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, log_dir: str, seconds: float) -> Optional[float]:
+        seconds = min(max(float(seconds), 0.05), MAX_SECONDS)
+        with self._lock:
+            if self._active is not None:
+                return None
+            self._active = {"dir": log_dir, "seconds": seconds,
+                            "started": time.time()}
+            self._cancel.clear()
+        os.makedirs(log_dir, exist_ok=True)
+        import jax
+
+        try:
+            jax.profiler.start_trace(log_dir)
+        except Exception:
+            with self._lock:
+                self._active = None
+            raise
+        self._thread = threading.Thread(
+            target=self._window, args=(seconds,),
+            name="dtx-profile-window", daemon=True)
+        self._thread.start()
+        return seconds
+
+    def _window(self, seconds: float):
+        self._cancel.wait(timeout=seconds)
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a failed stop must not leak state
+            pass
+        with self._lock:
+            self._active = None
+
+    def status(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+    def close(self):
+        """Cancel an in-flight window and join the worker (shutdown path)."""
+        self._cancel.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+
+_PROFILER = Profiler()
+
+
+def process_profiler() -> Profiler:
+    """The process-wide profiler (jax.profiler state is process-global)."""
+    return _PROFILER

@@ -36,6 +36,8 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from datatunerx_tpu.data.templates import Template, get_template
+from datatunerx_tpu.obs.metrics import Registry, serving_latency_histograms
+from datatunerx_tpu.obs.trace import TraceStore, build_request_span
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.models.lora import LORA_TARGETS, lora_scaling
 from datatunerx_tpu.ops.paged_attention import (
@@ -155,7 +159,8 @@ class _PrefixCache:
 class Request:
     def __init__(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
-                 stop_ids: Sequence[int], adapter: int):
+                 stop_ids: Sequence[int], adapter: int,
+                 trace_id: str = ""):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -167,8 +172,26 @@ class Request:
         self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
         self.done = threading.Event()
         self.error: Optional[str] = None
+        # --- observability: the request's own span timeline. Stamps are
+        # plain attribute writes from the scheduler thread (no locks, no
+        # device reads) so recording never perturbs the decode loop.
+        self.trace_id = trace_id
+        self.t_submit = time.perf_counter()
+        self.wall_submit_ms = time.time() * 1e3
+        self.timeline: List[tuple] = []  # (perf stamp, event, detail dict)
+        self.first_token_ts: Optional[float] = None
+        self.last_token_ts: Optional[float] = None
+
+    def mark(self, event: str, **detail):
+        self.timeline.append((time.perf_counter(), event, detail))
 
     def push(self, token: int):
+        # token arrival stamps: taken right after the decode chunk's designed
+        # host sync, so TTFT/TPOT derived from them are true wall numbers
+        now = time.perf_counter()
+        if self.first_token_ts is None:
+            self.first_token_ts = now
+        self.last_token_ts = now
         self.tokens.append(token)
         self.stream.put(token)
 
@@ -439,6 +462,10 @@ class BatchedEngine:
         kv_blocks: Optional[int] = None,  # pool size; default = dense parity
         prefill_chunk: int = 256,  # chunked-prefill program length (paged)
         prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
+        registry: Optional[Registry] = None,  # shared /metrics registry
+        tracing: bool = True,  # per-request span timelines + trace ring
+        trace_ring: int = 256,  # completed traces kept for /debug/trace
+        trace_log_path: Optional[str] = None,  # optional JSONL span log
     ):
         # serving is single-program: clear any mesh a Trainer left in the
         # process-global flash context before the engine's jits first trace
@@ -569,6 +596,20 @@ class BatchedEngine:
         self._prefix = _PrefixCache(prefix_cache) if prefix_cache > 0 else None
         # observability: how admissions were served (tests + /metrics)
         self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
+        # Shared-registry latency histograms. Recording is BUFFERED off the
+        # hot path: token stamps are plain attribute writes in Request.push;
+        # the observes below fire once per completed request (TTFT/TPOT) or
+        # once per prefill chunk — never per token.
+        self.registry = registry or Registry()
+        (self._h_ttft, self._h_tpot,
+         self._h_prefill_chunk) = serving_latency_histograms(self.registry)
+        # Per-request span timelines (the PR 5 sched_trace deque, promoted):
+        # completed requests land in a bounded trace ring keyed by trace id,
+        # served by GET /debug/trace/<id> on the serving server and merged
+        # into the gateway's trace view.
+        self.tracing = tracing
+        self.trace_store = TraceStore(capacity=trace_ring,
+                                      jsonl_path=trace_log_path)
 
         self._thread = threading.Thread(target=self._scheduler, daemon=True)
         self._thread.start()
@@ -757,6 +798,8 @@ class BatchedEngine:
             self._slot_req[slot] = req
             self._decode_ready[slot] = True
             self._trace("admit", slot, plen, "dense")
+            if self.tracing:
+                req.mark("admit", slot=slot, plen=plen, mode="dense")
             return True
 
         hit = self._prefill_row_cached(ids, plen, n_prompt, req.adapter,
@@ -785,6 +828,8 @@ class BatchedEngine:
             self._slot_req[slot] = req
             self._decode_ready[slot] = True
             self._trace("admit", slot, plen, "cache")
+            if self.tracing:
+                req.mark("admit", slot=slot, plen=plen, mode="cache")
             return True
 
         blocks = self._alloc_blocks(plen + max_new)
@@ -812,6 +857,8 @@ class BatchedEngine:
             "key": self._prefix_key(ids, plen, n_prompt, req.adapter),
         }
         self._trace("admit", slot, plen, "chunked")
+        if self.tracing:
+            req.mark("admit", slot=slot, plen=plen, mode="chunked")
         return True
 
     def _alloc_blocks(self, depth: int) -> Optional[List[int]]:
@@ -824,6 +871,26 @@ class BatchedEngine:
 
     def _trace(self, *event):
         self.sched_trace.append(event)
+
+    def _complete(self, req: Request, error: Optional[str] = None):
+        """Finish a request AND flush its buffered observability: one
+        TTFT/TPOT observe pair per request (never per token) and, with
+        tracing on, the request's span timeline into the trace ring."""
+        n = len(req.tokens)
+        if req.first_token_ts is not None:
+            self._h_ttft.observe((req.first_token_ts - req.t_submit) * 1e3)
+            if req.last_token_ts is not None and n > 1:
+                self._h_tpot.observe(
+                    (req.last_token_ts - req.first_token_ts) / (n - 1) * 1e3)
+        if self.tracing:
+            span = build_request_span(
+                req.trace_id, req.t_submit, req.timeline,
+                req.first_token_ts, req.last_token_ts, n,
+                req.wall_submit_ms, error=error,
+                attrs={"adapter": req.adapter, "prompt_len": len(req.prompt_ids)},
+            )
+            self.trace_store.add(span)
+        req.finish(error=error)
 
     def _take_waiting(self) -> Optional[Request]:
         if self._waiting_head is not None:
@@ -848,7 +915,7 @@ class BatchedEngine:
                     self._waiting_head = req
                     break
             except Exception as e:  # noqa: BLE001 — fail the request, not the loop
-                req.finish(error=str(e))
+                self._complete(req, error=str(e))
 
     def _prefill_tick(self):
         """Spend AT MOST ``prefill_token_budget`` prompt tokens on pending
@@ -869,23 +936,34 @@ class BatchedEngine:
                 c = min(self.prefill_chunk, st["plen"] - st["done"],
                         budget - spent)
                 lo = st["done"]
+                t0 = time.perf_counter()
                 try:
-                    logits, self._cache = self._prefill_chunk_fn(
-                        self.params, self._cache,
-                        jnp.asarray(slot, jnp.int32),
-                        jnp.asarray([st["ids"][lo:lo + c]], jnp.int32),
-                        jnp.asarray([st["mask"][lo:lo + c]], jnp.int32),
-                        jnp.asarray([st["positions"][lo:lo + c]], jnp.int32),
-                        jnp.asarray(st["adapter"], jnp.int32),
-                        chunk_len=c,
-                    )
+                    with jax.profiler.TraceAnnotation("dtx_engine_prefill_chunk"):
+                        logits, self._cache = self._prefill_chunk_fn(
+                            self.params, self._cache,
+                            jnp.asarray(slot, jnp.int32),
+                            jnp.asarray([st["ids"][lo:lo + c]], jnp.int32),
+                            jnp.asarray([st["mask"][lo:lo + c]], jnp.int32),
+                            jnp.asarray([st["positions"][lo:lo + c]], jnp.int32),
+                            jnp.asarray(st["adapter"], jnp.int32),
+                            chunk_len=c,
+                        )
                 except Exception as e:  # noqa: BLE001 — fail request, not loop
                     self._release_slot(slot)
-                    req.finish(error=str(e))
+                    self._complete(req, error=str(e))
                     break
+                # wall time as the scheduler sees it: on a synchronous
+                # backend this is the chunk's execution; under async
+                # dispatch it is dispatch + queue drain — no extra sync is
+                # added here to make it "exact" (the budget bound, not this
+                # number, is the scheduling contract)
+                self._h_prefill_chunk.observe(
+                    (time.perf_counter() - t0) * 1e3)
                 st["done"] += c
                 spent += c
                 self._trace("prefill", slot, c)
+                if self.tracing:
+                    req.mark("prefill", slot=slot, tokens=c)
                 if st["done"] >= st["plen"]:
                     self._finish_prefill(slot, st, logits)
                     break
@@ -914,6 +992,8 @@ class BatchedEngine:
             self._prefix.put(st["key"], {"cache": row, "logits": row_logits,
                                          "cursor": st["plen"]})
         self._trace("activate", slot)
+        if self.tracing:
+            req.mark("activate", slot=slot)
 
     def _release_slot(self, slot: int):
         self._slot_req[slot] = None
@@ -940,12 +1020,14 @@ class BatchedEngine:
                 continue
 
             try:
-                (emitted, self._logits, self._cache, self._pos,
-                 self._remaining, self._active, self._rng) = self._decode(
-                    self.params, self._cache, self._logits, self._pos,
-                    self._remaining, self._active, self._rng, self._temps,
-                    self._top_ps, self._stops, self._adapter_idx, K=self.chunk,
-                )
+                with jax.profiler.TraceAnnotation("dtx_engine_decode"):
+                    (emitted, self._logits, self._cache, self._pos,
+                     self._remaining, self._active, self._rng) = self._decode(
+                        self.params, self._cache, self._logits, self._pos,
+                        self._remaining, self._active, self._rng, self._temps,
+                        self._top_ps, self._stops, self._adapter_idx,
+                        K=self.chunk,
+                    )
                 self._trace("decode", self.chunk)
                 # the decode loop's ONE designed sync point: K tokens per
                 # chunk cross to host here so req.push can stream them
@@ -955,7 +1037,7 @@ class BatchedEngine:
                 for slot, req in enumerate(self._slot_req):
                     if req is not None:
                         self._release_slot(slot)
-                        req.finish(error=str(e))
+                        self._complete(req, error=str(e))
                 continue
 
             for k in range(emitted_np.shape[0]):
@@ -972,7 +1054,9 @@ class BatchedEngine:
                 if (req is not None and self._decode_ready[slot]
                         and not bool(active_np[slot])):
                     self._release_slot(slot)
-                    req.finish()
+                    if self.tracing:
+                        req.mark("finish", slot=slot)
+                    self._complete(req)
                     self._trace("finish", slot)
 
     # ---------------------------------------------------------------- API
@@ -985,6 +1069,7 @@ class BatchedEngine:
         seed: int = 0,
         stop_ids: Optional[set] = None,
         adapter: str = "",
+        trace_id: str = "",
     ) -> Request:
         if adapter not in self.adapter_ids:
             raise KeyError(
@@ -993,8 +1078,13 @@ class BatchedEngine:
             )
         stops = {int(s) for s in (stop_ids or set())}
         stops.add(int(self.tokenizer.eos_token_id))
+        # every request gets a trace id (callers without one — bench, bare
+        # generate() — still get a /debug/trace timeline); the gateway's
+        # X-DTX-Trace-Id arrives here via serving/server.py or
+        # InProcessReplica so one id follows the request end to end
         req = Request(prompt_ids, max_new_tokens, temperature, top_p, seed,
-                      sorted(stops), self.adapter_ids[adapter])
+                      sorted(stops), self.adapter_ids[adapter],
+                      trace_id=trace_id or f"dtx-{uuid.uuid4().hex[:16]}")
         self._waiting.put(req)
         self._wake.set()
         return req
@@ -1046,21 +1136,23 @@ class BatchedEngine:
 
     def chat(self, messages: List[dict], max_new_tokens: int = 128,
              temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-             adapter: str = "") -> str:
+             adapter: str = "", trace_id: str = "") -> str:
         prompt_ids, stop_ids = self._encode_chat(messages)
         out = self.generate(prompt_ids, max_new_tokens=max_new_tokens,
                             temperature=temperature, top_p=top_p, seed=seed,
-                            stop_ids=stop_ids, adapter=adapter)
+                            stop_ids=stop_ids, adapter=adapter,
+                            trace_id=trace_id)
         return self.tokenizer.decode(out, skip_special_tokens=True)
 
     def chat_stream(self, messages: List[dict], max_new_tokens: int = 128,
                     temperature: float = 0.0, top_p: float = 1.0,
-                    seed: int = 0, adapter: str = ""):
+                    seed: int = 0, adapter: str = "", trace_id: str = ""):
         """Yields text deltas as tokens stream off the decode chunks."""
         prompt_ids, stop_ids = self._encode_chat(messages)
         req = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                           temperature=temperature, top_p=top_p, seed=seed,
-                          stop_ids=stop_ids, adapter=adapter)
+                          stop_ids=stop_ids, adapter=adapter,
+                          trace_id=trace_id)
         sent = ""
         acc: List[int] = []
         while True:

@@ -20,15 +20,110 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from datatunerx_tpu.obs.metrics import (
+    Registry,
+    serving_latency_histograms,
+    set_build_info,
+    set_uptime,
+)
+
 
 class ServingState:
     def __init__(self):
         self.engine = None
         self.error: Optional[str] = None
         self.model_path = ""
+        # the server's ONE registry: engine latency histograms record into
+        # it (load_engine_async passes it down) and every scrape-time gauge
+        # is re-stated into it, so /metrics is a single exposition
+        self.registry = Registry()
+        self.started_at = time.monotonic()
+        # serializes scrape-time gauge restating (concurrent scrapes would
+        # race clear/set on the labeled counters)
+        self.scrape_lock = threading.Lock()
 
 
 STATE = ServingState()
+
+
+def metrics_text() -> str:
+    """The /metrics body: scrape-time gauges re-stated into the shared
+    registry next to the engine's live histograms. Factored off the HTTP
+    handler so scripts/metrics_lint.py validates the same bytes a scraper
+    sees."""
+    with STATE.scrape_lock:
+        return _metrics_text_locked()
+
+
+def _metrics_text_locked() -> str:
+    reg = STATE.registry
+    eng = STATE.engine
+    set_build_info(reg, "serving")
+    set_uptime(reg, "serving", STATE.started_at)
+    # declare the serving latency histograms even before the engine loads:
+    # a scraper sees stable series from the first scrape (zero counts), and
+    # an engine sharing this registry observes into these same objects
+    # (one declaration site in obs.metrics — help text cannot diverge)
+    serving_latency_histograms(reg)
+    reg.gauge("dtx_serving_up", "1 once the model is fully loaded.").set(
+        1 if eng is not None else 0)
+    stats = getattr(eng, "prefill_stats", None)
+    pf = reg.counter("dtx_serving_prefill_total",
+                     "Admissions by prefill kind (full/reuse/extend).")
+    # engine-derived series are re-stated per scrape — cleared first so a
+    # swapped/reloaded engine can't leave stale samples behind
+    hits = reg.counter("dtx_serving_prefix_cache_hits_total",
+                       "Exact prefix-cache hits (prefill skipped).")
+    partial = reg.counter("dtx_serving_prefix_cache_partial_hits_total",
+                          "Strict-prefix hits (suffix-only prefill).")
+    misses = reg.counter("dtx_serving_prefix_cache_misses_total",
+                         "Full prefills.")
+    evictions = reg.counter("dtx_serving_prefix_cache_evictions_total",
+                            "Prefix-cache LRU evictions.")
+    for c in (pf, hits, partial, misses, evictions):
+        c.clear()
+    if stats is not None:
+        for kind, n in sorted(stats.items()):
+            pf.set(n, {"kind": kind})
+        # hit = exact reuse, partial = suffix extension, miss = full;
+        # .get so a partially-populated stats dict (engine mid-init or a
+        # duck-typed test engine) can't 500 the scrape
+        hits.set(stats.get("reuse", 0))
+        partial.set(stats.get("extend", 0))
+        misses.set(stats.get("full", 0))
+    prefix = getattr(eng, "_prefix", None)
+    entries = reg.gauge("dtx_serving_prefix_cache_entries",
+                        "Live prefix-cache entries.")
+    entries.clear()
+    if prefix is not None:
+        entries.set(len(prefix))
+        evictions.set(prefix.evictions)
+    slots_busy = reg.gauge("dtx_serving_slots_busy",
+                           "Cache slots holding an in-flight request.")
+    # _capacity, not _total: the Prometheus _total suffix is reserved for
+    # counters, and these are gauges (PR 7 naming unification — the old
+    # dtx_serving_{slots,kv_blocks}_total names are gone; the gateway's
+    # scrape parser accepts both during a rolling upgrade)
+    slots_total = reg.gauge("dtx_serving_slots_capacity",
+                            "Configured cache slots.")
+    slots_busy.clear()
+    slots_total.clear()
+    if eng is not None and hasattr(eng, "_slot_req"):
+        slots_busy.set(sum(1 for r in eng._slot_req if r is not None))
+        slots_total.set(eng.slots)
+    # paged KV cache: FREE BLOCKS are the real admission headroom (the
+    # gateway prefers this gauge over free slots — a slot is cheap, the
+    # blocks behind it are not)
+    blocks_free = reg.gauge("dtx_serving_kv_blocks_free",
+                            "Free paged KV-cache blocks.")
+    blocks_total = reg.gauge("dtx_serving_kv_blocks_capacity",
+                             "Total paged KV-cache blocks.")
+    blocks_free.clear()
+    blocks_total.clear()
+    if getattr(eng, "total_kv_blocks", None):
+        blocks_free.set(eng.free_kv_blocks)
+        blocks_total.set(eng.total_kv_blocks)
+    return reg.expose()
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -44,6 +139,13 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("X-DTX-Trace-Id", trace)
         self.end_headers()
         self.wfile.write(body)
+        self._record(code)
+
+    def _record(self, code: int):
+        STATE.registry.counter(
+            "dtx_serving_requests_total",
+            "Requests by terminal HTTP code (gateway-parity naming).").inc(
+            {"code": str(code)})
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -58,69 +160,74 @@ class Handler(BaseHTTPRequestHandler):
                 {"id": STATE.model_path, "object": "model"}]})
         elif self.path == "/metrics":
             self._metrics()
+        elif self.path.startswith("/debug/trace/"):
+            self._debug_trace(self.path[len("/debug/trace/"):])
         else:
             self._json(404, {"error": "not found"})
 
     def _metrics(self):
-        """Prometheus text exposition: prefill/prefix-cache counters (batched
-        engine). Serving-side twin of the operator's /metrics endpoint."""
-        lines = [
-            "# TYPE dtx_serving_up gauge",
-            f"dtx_serving_up {1 if STATE.engine is not None else 0}",
-        ]
-        eng = STATE.engine
-        stats = getattr(eng, "prefill_stats", None)
-        if stats is not None:
-            lines.append("# TYPE dtx_serving_prefill_total counter")
-            for kind, n in sorted(stats.items()):
-                lines.append(
-                    f'dtx_serving_prefill_total{{kind="{kind}"}} {n}')
-            # hit = exact reuse, partial = suffix extension, miss = full;
-            # .get so a partially-populated stats dict (engine mid-init or a
-            # duck-typed test engine) can't 500 the scrape
-            lines.append("# TYPE dtx_serving_prefix_cache_hits_total counter")
-            lines.append(
-                f"dtx_serving_prefix_cache_hits_total {stats.get('reuse', 0)}")
-            lines.append(
-                "# TYPE dtx_serving_prefix_cache_partial_hits_total counter")
-            lines.append(
-                "dtx_serving_prefix_cache_partial_hits_total "
-                f"{stats.get('extend', 0)}")
-            lines.append("# TYPE dtx_serving_prefix_cache_misses_total counter")
-            lines.append(
-                f"dtx_serving_prefix_cache_misses_total {stats.get('full', 0)}")
-        prefix = getattr(eng, "_prefix", None)
-        if prefix is not None:
-            lines.append("# TYPE dtx_serving_prefix_cache_entries gauge")
-            lines.append(f"dtx_serving_prefix_cache_entries {len(prefix)}")
-            lines.append(
-                "# TYPE dtx_serving_prefix_cache_evictions_total counter")
-            lines.append(
-                f"dtx_serving_prefix_cache_evictions_total {prefix.evictions}")
-        if eng is not None and hasattr(eng, "_slot_req"):
-            busy = sum(1 for r in eng._slot_req if r is not None)
-            lines.append("# TYPE dtx_serving_slots_busy gauge")
-            lines.append(f"dtx_serving_slots_busy {busy}")
-            lines.append("# TYPE dtx_serving_slots_total gauge")
-            lines.append(f"dtx_serving_slots_total {eng.slots}")
-        # paged KV cache: FREE BLOCKS are the real admission headroom (the
-        # gateway prefers this gauge over free slots — a slot is cheap, the
-        # blocks behind it are not)
-        if getattr(eng, "total_kv_blocks", None):
-            lines.append("# TYPE dtx_serving_kv_blocks_free gauge")
-            lines.append(f"dtx_serving_kv_blocks_free {eng.free_kv_blocks}")
-            lines.append("# TYPE dtx_serving_kv_blocks_total gauge")
-            lines.append(f"dtx_serving_kv_blocks_total {eng.total_kv_blocks}")
-        body = ("\n".join(lines) + "\n").encode()
+        """Prometheus text exposition from the shared registry (obs.metrics):
+        engine latency histograms + scrape-time gauges, one encoder."""
+        body = metrics_text().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
+    def _debug_trace(self, trace_id: str):
+        """Per-request span timeline from the engine's trace ring — the
+        replica half of the gateway's GET /debug/trace/<id> merge."""
+        store = getattr(STATE.engine, "trace_store", None)
+        doc = store.get(trace_id) if store is not None and trace_id else None
+        if doc is None:
+            self._json(404, {"error": f"no trace {trace_id!r}"})
+        else:
+            self._json(200, doc)
+
+    def _debug_profile(self, req: dict):
+        """Arm an N-second jax.profiler window (one at a time per process).
+        Engine decode/prefill ticks are TraceAnnotation-labeled, so the
+        capture reads like the scheduler's own timeline in XProf."""
+        from datatunerx_tpu.obs.profiling import (
+            process_profiler,
+            resolve_profile_dir,
+        )
+
+        try:
+            seconds = float(req.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            self._json(400, {"error": "seconds must be a number"})
+            return
+        try:
+            log_dir = resolve_profile_dir(str(req.get("dir") or ""))
+        except ValueError as e:  # dir escapes the allowed root
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            effective = process_profiler().start(log_dir, seconds)
+        except Exception as e:  # noqa: BLE001 — profiler fault ≠ server fault
+            self._json(500, {"error": f"profiler failed to start: {e}"})
+            return
+        if effective is None:
+            self._json(409, {"error": "a profile capture is already running",
+                             "active": process_profiler().status()})
+            return
+        # echo the CLAMPED window, not the request — what will actually run
+        self._json(202, {"profiling": log_dir, "seconds": effective})
+
     def do_POST(self):
         if self.path == "/perplexity":
             self._perplexity()
+            return
+        if self.path == "/debug/profile":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"invalid JSON body: {e}"})
+                return
+            self._debug_profile(req)
             return
         if self.path not in ("/chat/completions", "/v1/chat/completions"):
             self._json(404, {"error": "not found"})
@@ -155,6 +262,11 @@ class Handler(BaseHTTPRequestHandler):
                     self._json(400, {"error": f"unknown model/adapter {adapter!r}"})
                     return
                 kwargs["adapter"] = adapter
+            # hand the gateway's trace id to engines that keep span
+            # timelines (duck-typed/single-slot engines just don't get it)
+            trace = self.headers.get("X-DTX-Trace-Id") or ""
+            if trace and getattr(STATE.engine, "trace_store", None) is not None:
+                kwargs["trace_id"] = trace
             if req.get("stream"):
                 self._stream_chat(messages, kwargs)
                 return
@@ -225,6 +337,7 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
             self.wfile.flush()
 
+        code = 200
         try:
             try:
                 for delta in stream_fn(messages, **kwargs):
@@ -245,10 +358,12 @@ class Handler(BaseHTTPRequestHandler):
                 # a second HTTP response would corrupt the stream, so errors
                 # become a terminal SSE event instead
                 event({"error": {"message": str(e)}})
+                code = 500
             self.wfile.write(b"data: [DONE]\n\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
-            pass
+            code = 499
+        self._record(code)
 
     def log_message(self, *a):
         pass
@@ -258,7 +373,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
                       adapters=None, kv_quant=None, prefix_cache=0,
                       kv_block_size=0, kv_blocks=0, prefill_chunk=256,
-                      prefill_token_budget=0):
+                      prefill_token_budget=0, trace_ring=256,
+                      trace_log_path=None):
     def _load():
         try:
             STATE.model_path = model_path
@@ -286,6 +402,11 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     kv_block_size=kv_block_size, kv_blocks=kv_blocks or None,
                     prefill_chunk=prefill_chunk,
                     prefill_token_budget=prefill_token_budget,
+                    # the server's registry: engine TTFT/TPOT/prefill-chunk
+                    # histograms land in the same /metrics exposition
+                    registry=STATE.registry,
+                    trace_ring=trace_ring,
+                    trace_log_path=trace_log_path or None,
                 )
             else:
                 # single-slot path also carries serve-time quantization
@@ -360,6 +481,12 @@ def main(argv=None):
                         "decode chunks (0 = unbounded); bounds the TPOT "
                         "hit a long admission can inflict on in-flight "
                         "requests")
+    p.add_argument("--trace_ring", type=int, default=256,
+                   help="completed request traces kept for "
+                        "GET /debug/trace/<id>")
+    p.add_argument("--trace_log", default="",
+                   help="append every completed request span as one JSON "
+                        "line to this file (offline trace forensics)")
     args = p.parse_args(argv)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
@@ -370,7 +497,9 @@ def main(argv=None):
                       kv_block_size=args.kv_block_size,
                       kv_blocks=args.kv_blocks,
                       prefill_chunk=args.prefill_chunk,
-                      prefill_token_budget=args.prefill_token_budget)
+                      prefill_token_budget=args.prefill_token_budget,
+                      trace_ring=args.trace_ring,
+                      trace_log_path=args.trace_log)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

@@ -20,10 +20,13 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import struct
 import time
 import urllib.request
 from typing import Dict, Optional
+
+from datatunerx_tpu.obs.metrics import Registry, set_build_info
 
 # ------------------------------------------------------------------ protobuf
 
@@ -129,6 +132,7 @@ class MetricsLogger:
         total_steps: int,
         metrics_export_address: Optional[str] = None,
         uid: Optional[str] = None,
+        registry: Optional[Registry] = None,
     ):
         self.output_dir = output_dir
         self.total_steps = max(total_steps, 1)
@@ -137,6 +141,37 @@ class MetricsLogger:
         self.start = time.time()
         self.watch_dir = os.path.join(output_dir, "watch")
         os.makedirs(self.watch_dir, exist_ok=True)
+        # Shared-registry mirror of the training plane (obs/metrics.py, PR 7):
+        # every logged record re-states dtx_train_*/dtx_eval_* gauges —
+        # including the pipeline-health signals pipe_step_wait_ms and
+        # pipe_queue_depth (prefetch occupancy), the autotuning input ROADMAP
+        # wants — and the exposition is written to watch/metrics.prom for
+        # node-exporter-textfile-style scraping. Purely additive: jsonl,
+        # stdout, and remote-write behavior are unchanged.
+        self.registry = registry if registry is not None else Registry()
+        self._expo_path = os.path.join(self.watch_dir, "metrics.prom")
+
+    def _mirror(self, prefix: str, step: int, metrics: Dict[str, float]):
+        set_build_info(self.registry, "training")
+        labels = {"uid": self.uid} if self.uid else None
+        self.registry.gauge(
+            f"{prefix}_step", "Steps completed at the last logged record."
+        ).set(step, labels)
+        for k, v in metrics.items():
+            f = _f(v)
+            if math.isnan(f):
+                continue
+            # jsonl keys like "rouge-1" are not valid metric-name chars
+            name = re.sub(r"[^a-zA-Z0-9_]", "_", f"{prefix}_{k}")
+            self.registry.gauge(name).set(f, labels)
+        # atomic replace: a scraper never reads a half-written exposition
+        tmp = self._expo_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(self.registry.expose())
+            os.replace(tmp, self._expo_path)
+        except OSError:
+            pass  # metrics export must never kill training
 
     def _common(self, step: int) -> Dict:
         elapsed = time.time() - self.start
@@ -156,6 +191,7 @@ class MetricsLogger:
     def log_train(self, step: int, metrics: Dict[str, float]):
         rec = {**self._common(step), **{k: _f(v) for k, v in metrics.items()}}
         self._write("trainer_log.jsonl", rec)
+        self._mirror("dtx_train", step, metrics)
         print(f"[train] {json.dumps(rec)}", flush=True)
         if self.address:
             push_remote_write(
@@ -167,6 +203,7 @@ class MetricsLogger:
     def log_eval(self, step: int, metrics: Dict[str, float]):
         rec = {**self._common(step), **{k: _f(v) for k, v in metrics.items()}}
         self._write("eval_log.jsonl", rec)
+        self._mirror("dtx_eval", step, metrics)
         print(f"[eval] {json.dumps(rec)}", flush=True)
         if self.address:
             push_remote_write(

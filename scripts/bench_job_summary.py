@@ -35,6 +35,31 @@ def main() -> int:
                f"`cpu_fallback={last.get('cpu_fallback', '?')}` — "
                f"{last.get('metric')} = {last.get('value')} "
                f"{last.get('unit', '')}")
+        # pre-flight phase timings (backend init / first compile / first
+        # execute) next to the provenance fields; a degraded line names the
+        # phase the device died in
+        pf = last.get("preflight")
+        if isinstance(pf, dict):
+            phases = pf.get("phases_ms") or {}
+            shown = " ".join(f"{k}={phases[k]}ms" for k in
+                             ("backend_init", "first_compile",
+                              "first_execute") if k in phases)
+            hung = pf.get("timed_out_phase") or pf.get("failed_phase")
+            row += (f"\n  - preflight: `ok={pf.get('ok')}` "
+                    f"attempts={pf.get('attempts')} {shown}")
+            if hung:
+                row += f" — **died in `{hung}`**"
+        # serving latency distribution: the p50/p95 TTFT/TPOT the serve
+        # smoke exists to surface
+        sv = last.get("serve")
+        if isinstance(sv, dict):
+            row += ("\n  - serve: "
+                    f"ttft p50={sv.get('ttft_ms_p50')}ms "
+                    f"p95={sv.get('ttft_ms_p95')}ms · "
+                    f"tpot p50={sv.get('tpot_ms_p50')}ms "
+                    f"p95={sv.get('tpot_ms_p95')}ms · "
+                    f"requests={sv.get('requests')} "
+                    f"errors={sv.get('errors')}")
     summary = os.environ.get("GITHUB_STEP_SUMMARY")
     if summary:
         with open(summary, "a", encoding="utf-8") as f:

@@ -1,0 +1,130 @@
+"""Exposition-format + naming lint for the gateway and serving /metrics.
+
+Builds each server's exposition IN PROCESS (the same bytes a scraper gets:
+`Gateway.metrics_text()` and `serving.server.metrics_text()` against a
+duck-typed engine), then validates:
+
+  format  — the invariants a real Prometheus server enforces: one # TYPE
+            line per metric preceding all its samples, no duplicate
+            series, parseable samples, escaped label values, trailing
+            newline.
+  naming  — house conventions the dashboards rely on: every metric starts
+            with ``dtx_``, carries its plane (``dtx_gateway_`` /
+            ``dtx_serving_`` — shared identity series like
+            ``dtx_build_info`` are the deliberate exceptions), counters
+            end in ``_total``, and time-valued metrics carry an explicit
+            unit suffix (``_ms`` / ``_seconds``).
+
+Run by tier1.yml next to dtxlint: a metric added with the wrong shape
+fails the PR, not the dashboard. Exit 0 clean, 1 on findings.
+"""
+
+import re
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root when run from CI
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# metrics whose name carries no plane prefix on purpose (shared identity /
+# process series stated by obs.metrics on every plane)
+SHARED_NAMES = {"dtx_build_info"}
+# words that mean "this samples a duration" and demand a unit suffix
+TIME_WORDS = ("latency", "wait", "duration", "uptime", "elapsed", "ttft",
+              "tpot")
+UNIT_SUFFIXES = ("_ms", "_seconds", "_ms_bucket", "_ms_sum", "_ms_count",
+                 "_seconds_bucket", "_seconds_sum", "_seconds_count")
+
+
+def lint_exposition(text: str, plane: str):
+    """-> list of finding strings for one server's exposition."""
+    from tests.test_prometheus_exposition import parse_exposition
+
+    findings = []
+    try:
+        _, types = parse_exposition(text)
+    except AssertionError as e:
+        return [f"{plane}: exposition format invalid: {e}"]
+    for name, mtype in sorted(types.items()):
+        where = f"{plane}: {name}"
+        if not NAME_RE.match(name):
+            findings.append(f"{where}: invalid metric name")
+        if not name.startswith("dtx_"):
+            findings.append(f"{where}: missing dtx_ prefix")
+        elif (name not in SHARED_NAMES
+              and not name.startswith(f"dtx_{plane}_")):
+            findings.append(
+                f"{where}: missing plane prefix dtx_{plane}_ (shared "
+                "names must be registered in metrics_lint SHARED_NAMES)")
+        if mtype == "counter" and not name.endswith("_total"):
+            findings.append(f"{where}: counter must end in _total")
+        if mtype != "counter" and name.endswith("_total"):
+            findings.append(f"{where}: _total suffix on a {mtype}")
+        if (any(w in name for w in TIME_WORDS)
+                and not name.endswith(("_ms", "_seconds"))):
+            findings.append(
+                f"{where}: time-valued metric needs a _ms or _seconds "
+                "unit suffix")
+    return findings
+
+
+class _StatsEngine:
+    """Duck-typed engine exposing what serving.metrics_text reads."""
+
+    slots = 4
+    _slot_req = [object(), None, None, None]
+    prefill_stats = {"full": 2, "reuse": 1, "extend": 0}
+
+    def chat(self, messages, **kw):
+        return "ok"
+
+
+def gateway_exposition() -> str:
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+
+    pool = ReplicaPool([InProcessReplica("r0", _StatsEngine())])
+    gw = Gateway(pool, model_name="preset:lint")
+    try:
+        # drive one request so the labeled counters and the queue-wait
+        # histogram expose real series, not just TYPE lines
+        gw.chat({"messages": [{"role": "user", "content": "hi"}]},
+                trace_id="lint-trace")
+        gw.record_request(200)
+        return gw.metrics_text()
+    finally:
+        gw.close()
+
+
+def serving_exposition() -> str:
+    from datatunerx_tpu.serving import server as serving
+
+    old_engine = serving.STATE.engine
+    serving.STATE.engine = _StatsEngine()
+    try:
+        return serving.metrics_text()
+    finally:
+        serving.STATE.engine = old_engine
+
+
+def main() -> int:
+    findings = []
+    for plane, build in (("gateway", gateway_exposition),
+                         ("serving", serving_exposition)):
+        try:
+            text = build()
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(f"{plane}: building exposition crashed: {e}")
+            continue
+        findings.extend(lint_exposition(text, plane))
+    for f in findings:
+        print(f"metrics-lint: {f}")
+    if not findings:
+        print("metrics-lint: gateway + serving expositions clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

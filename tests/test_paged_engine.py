@@ -338,5 +338,5 @@ def test_serving_metrics_expose_block_gauges(paged):
     finally:
         serving_server.STATE.engine = old
     text = sink.body.decode()
-    assert f"dtx_serving_kv_blocks_total {paged.total_kv_blocks}" in text
+    assert f"dtx_serving_kv_blocks_capacity {paged.total_kv_blocks}" in text
     assert "dtx_serving_kv_blocks_free " in text

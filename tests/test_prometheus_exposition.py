@@ -152,7 +152,7 @@ def test_serving_metrics_exposition_valid(serving_url):
     assert types["dtx_serving_up"] == "gauge"
     assert samples[("dtx_serving_up", ())] == 1
     assert samples[("dtx_serving_slots_busy", ())] == 1
-    assert samples[("dtx_serving_slots_total", ())] == 4
+    assert samples[("dtx_serving_slots_capacity", ())] == 4
     assert samples[("dtx_serving_prefill_total", (("kind", "full"),))] == 2
 
 
