@@ -17,6 +17,17 @@ file left untouched):
     at the top of the body: the classic default-argument deferral.
     Module-level constants (``TABLE = jnp.ones(...)``) have no
     call-site-compatible mechanical rewrite and stay manual.
+  * DTX004 prng-key-split insertion — the canonical recipe for key
+    reuse: ``key, key_split1 = jax.random.split(key)`` is inserted
+    before the anchor consumption and the anchor call is rewritten to
+    consume the fresh subkey. For a straight double-consumption the
+    anchor is the FIRST consuming statement (splitting after it would
+    itself reuse the key); for a key consumed inside a loop but
+    assigned outside, the anchor is the flagged statement in the loop
+    body — the inserted split rebinds the carry each iteration. This
+    fixer deliberately CHANGES runtime values: that is the point (the
+    flagged code draws correlated randomness; the fix decorrelates it),
+    so unlike DTX002/DTX008 it is value-changing-by-design.
 
 The edit engine is a flat list of non-overlapping ``SpanEdit``s in
 character offsets; ``apply_edits`` refuses (raises ``OverlapError``)
@@ -27,10 +38,10 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Sequence, Set, Tuple
 
-from datatunerx_tpu.analysis.callgraph import walk_function
 from datatunerx_tpu.analysis.config import LintConfig, rule_enabled
 from datatunerx_tpu.analysis.core import (
     Finding,
@@ -40,7 +51,7 @@ from datatunerx_tpu.analysis.core import (
     suppressions,
 )
 
-FIXABLE_RULES = ("DTX002", "DTX008")
+FIXABLE_RULES = ("DTX002", "DTX004", "DTX008")
 _MAX_PASSES = 8
 _LOOPS = (ast.For, ast.AsyncFor, ast.While)
 _JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
@@ -229,7 +240,116 @@ def _fix_dtx008(ctx: ModuleContext, finding: Finding,
     return [SpanEdit(start, end, "None"), SpanEdit(ins_at, ins_at, guard)]
 
 
-_FIXERS = {"DTX002": _fix_dtx002, "DTX008": _fix_dtx008}
+# ----------------------------------------------- DTX004 key-split insertion
+
+_PRIOR_LINE_RE = re.compile(r"already consumed at line (\d+)")
+
+
+def _key_arg_node(call: ast.Call) -> Optional[ast.Name]:
+    """The Name node the call consumes as its PRNG key (first positional
+    arg or ``key=``) — the same extraction DTX004's rule does."""
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value
+    return None
+
+
+def _enclosing_stmt(ctx: ModuleContext, node: ast.AST) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur if isinstance(cur, ast.stmt) else None
+
+
+def _whole_line_stmt(ctx: ModuleContext, stmt: ast.stmt) -> bool:
+    """True when the statement owns its line(s): nothing before it on its
+    first line, nothing but a comment after it on its last (the same guard
+    DTX002's hoist applies — no `a = 1; b = f(k)` splicing)."""
+    line = ctx.lines[stmt.lineno - 1]
+    if line[:stmt.col_offset].strip():
+        return False
+    tail = ctx.lines[stmt.end_lineno - 1][stmt.end_col_offset:].strip()
+    return not tail or tail.startswith("#")
+
+
+def _fresh_name(ctx: ModuleContext, base: str) -> str:
+    used = {n.id for n in ast.walk(ctx.tree) if isinstance(n, ast.Name)}
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            used.update(p.arg for p in a.posonlyargs + a.args + a.kwonlyargs)
+    i = 1
+    while f"{base}_split{i}" in used:
+        i += 1
+    return f"{base}_split{i}"
+
+
+def _first_consumer_at(ctx: ModuleContext, line: int,
+                       name: str) -> Optional[ast.Call]:
+    """Earliest jax.random call on ``line`` consuming ``name`` as its key
+    (the prior consumption the finding message points at)."""
+    best: Optional[ast.Call] = None
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.lineno == line):
+            continue
+        resolved = ctx.resolve(node.func)
+        if not resolved or not resolved.startswith("jax.random."):
+            continue
+        key = _key_arg_node(node)
+        if key is None or key.id != name:
+            continue
+        if best is None or node.col_offset < best.col_offset:
+            best = node
+    return best
+
+
+def _fix_dtx004(ctx: ModuleContext, finding: Finding,
+                offsets: List[int]) -> Optional[List[SpanEdit]]:
+    flagged = _find_call(ctx, finding)
+    if flagged is None:
+        return None
+    key = _key_arg_node(flagged)
+    if key is None:
+        return None
+    # the split expression reuses the flagged call's own module path
+    # (`jax.random.normal(k)` → `jax.random.split`), so the insertion can
+    # never reference a name the module didn't import; a bare imported
+    # name (`from jax.random import normal`) has no such path → manual
+    if not isinstance(flagged.func, ast.Attribute):
+        return None
+    base_src = ast.get_source_segment(ctx.source, flagged.func.value)
+    if base_src is None or "\n" in base_src:
+        return None
+    m = _PRIOR_LINE_RE.search(finding.message)
+    if m:
+        # double consumption: anchor at the FIRST consuming statement —
+        # splitting before it rebinds the key, so the flagged (later)
+        # consumption draws from the new carry, not the consumed value
+        anchor_call = _first_consumer_at(ctx, int(m.group(1)), key.id)
+        if anchor_call is None:
+            return None
+    else:
+        # loop-reuse: anchor at the flagged statement inside the loop —
+        # the inserted split rebinds the carry every iteration
+        anchor_call = flagged
+    stmt = _enclosing_stmt(ctx, anchor_call)
+    if stmt is None or not _whole_line_stmt(ctx, stmt):
+        return None
+    target = _key_arg_node(anchor_call)
+    if target is None or target.id != key.id:
+        return None
+    fresh = _fresh_name(ctx, key.id)
+    indent = " " * stmt.col_offset
+    ins = (f"{indent}{key.id}, {fresh} = {base_src}.split({key.id})\n")
+    ins_at = _line_start(offsets, stmt.lineno)
+    kstart, kend = _node_span(offsets, target)
+    return [SpanEdit(ins_at, ins_at, ins), SpanEdit(kstart, kend, fresh)]
+
+
+_FIXERS = {"DTX002": _fix_dtx002, "DTX004": _fix_dtx004,
+           "DTX008": _fix_dtx008}
 
 
 def _overlaps(group: Sequence[SpanEdit],

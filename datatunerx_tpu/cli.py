@@ -17,6 +17,10 @@ Talks to the operator's REST API (operator/apiserver.py):
   dtx serve --model_path P             serve directly (no operator); with
       [--replicas N] [--gateway]       N > 1 or --gateway the inference
                                        gateway fronts the replicas
+  dtx experiment -f spec.json          run a closed-loop experiment locally
+      [--backend fake|local]           (shared slice pool, continuous
+                                       scoring, canary promotion) against
+                                       the Fake or LocalProcess backends
   dtx lint [paths...]                  JAX-aware static analysis (dtxlint):
                                        host-sync, retrace, sharding, and
                                        lock-discipline rules; exits 1 on
@@ -239,6 +243,21 @@ def cmd_serve(args):
     return serving_main(argv)
 
 
+def cmd_experiment(args):
+    """Run a closed-loop experiment (experiment/runner.py): N jobs
+    elastically scheduled on a shared slice pool, continuous scoring into
+    a live leaderboard, winner promoted through canary traffic weights."""
+    from datatunerx_tpu.experiment.runner import main as experiment_main
+
+    argv = ["-f", args.filename, "--backend", args.backend,
+            "--workdir", args.workdir,
+            "--max_ticks", str(args.max_ticks),
+            "--tick_s", str(args.tick_s)]
+    if args.status_json:
+        argv += ["--status_json", args.status_json]
+    return experiment_main(argv)
+
+
 def _lint_tail(argv):
     """The argv tail after ``lint`` when lint is the subcommand — allowing
     the one global option (``--server``) before it — else None. dtxlint's
@@ -377,6 +396,19 @@ def main(argv=None):
     vp.add_argument("--workdir", default="",
                     help="gateway replica log directory")
     vp.set_defaults(fn=cmd_serve)
+
+    ep = sub.add_parser(
+        "experiment",
+        help="run a closed-loop experiment: shared slice pool, continuous "
+             "scoring, canary promotion (experiment/)")
+    ep.add_argument("-f", "--filename", required=True,
+                    help="experiment spec JSON")
+    ep.add_argument("--backend", choices=["fake", "local"], default="fake")
+    ep.add_argument("--workdir", default="experiment-jobs")
+    ep.add_argument("--max_ticks", type=int, default=2000)
+    ep.add_argument("--tick_s", type=float, default=0.05)
+    ep.add_argument("--status_json", default="")
+    ep.set_defaults(fn=cmd_experiment)
 
     xp = sub.add_parser(
         "lint",

@@ -24,6 +24,8 @@ import urllib.error
 import urllib.request
 from typing import Dict, Iterator, List, Optional
 
+from datatunerx_tpu.obs.metrics import sample_percentile
+
 
 class ReplicaError(Exception):
     """A replica failed to serve a request (connection refused, died
@@ -103,6 +105,43 @@ class Replica:
         self.healthy = True  # last health-probe verdict
         self.inflight = 0  # gateway-side in-flight count (least-busy fallback)
         self._inflight_lock = threading.Lock()
+        # canary/traffic weight: the router's smooth-WRR share when weights
+        # in the candidate set are non-uniform (all-1.0 = policy as before);
+        # weight 0 receives no new requests (a rolled-back canary)
+        self.weight = 1.0
+        # per-replica outcome window: the promotion controller compares the
+        # canary's error rate and latency p95 against the fleet's from these
+        # (fed by the gateway per attempt, same measurements as the PR 7
+        # request histograms)
+        self.requests_total = 0
+        self.errors_total = 0
+        self._latency_ms: List[float] = []
+        self._outcome_lock = threading.Lock()
+
+    def record_outcome(self, ok: bool, latency_ms: float):
+        """One routed attempt's terminal outcome (gateway-side). Client
+        errors (4xx/ValueError) are NOT recorded — they say nothing about
+        the replica."""
+        with self._outcome_lock:
+            self.requests_total += 1
+            if not ok:
+                self.errors_total += 1
+            self._latency_ms.append(float(latency_ms))
+            if len(self._latency_ms) > 512:
+                del self._latency_ms[:256]
+
+    def outcome_stats(self, last_n: Optional[int] = None) -> dict:
+        """Rolling outcome summary. ``last_n`` limits the latency p95 to
+        the most recent n samples — the promotion guard judges a stage on
+        the traffic served DURING it, not on warm-up requests that happen
+        to still sit in the rolling window."""
+        with self._outcome_lock:
+            window = self._latency_ms[-last_n:] if last_n else \
+                list(self._latency_ms)
+            reqs, errs = self.requests_total, self.errors_total
+        return {"requests": reqs, "errors": errs,
+                "error_rate": errs / reqs if reqs else 0.0,
+                "latency_p95_ms": sample_percentile(window, 0.95)}
 
     # ------------------------------------------------------------- requests
     def chat(self, messages: List[dict], **kwargs) -> str:

@@ -10,7 +10,15 @@ Selection pipeline (every policy):
      replica that served the session before (its prefix cache holds the
      conversation's KV rows, so re-prefill becomes a suffix extension) —
      as long as that replica is still a candidate.
-  4. policy pick: ``least_busy`` (lowest slot occupancy, gateway in-flight
+  4. traffic weights: replicas carry a ``weight`` (canary promotion,
+     gateway/server.py /admin/promote). Weight 0 = no new requests (a
+     rolled-back canary). When the candidate set's weights are
+     NON-uniform, selection is smooth weighted round-robin — a
+     deterministic rotation whose long-run shares equal the weights
+     exactly (nginx's algorithm), so a 5% canary weight means 1 request
+     in 20, observably. Uniform weights (the default 1.0 everywhere)
+     fall through to the policy pick, preserving pre-weight behavior.
+  5. policy pick: ``least_busy`` (lowest slot occupancy, gateway in-flight
      count as tiebreak/fallback) or ``round_robin``.
 """
 
@@ -52,6 +60,7 @@ class Router:
         self.pool = pool
         self.policy = policy
         self._rr = 0
+        self._wrr: dict = {}  # smooth-WRR current weights, by replica name
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
         self._affinity_capacity = affinity_capacity
         self._lock = threading.Lock()
@@ -64,6 +73,12 @@ class Router:
         exclude = exclude or set()
         candidates = [r for r in self.pool.available()
                       if r.name not in exclude]
+        # weight 0 = receives no NEW requests (rolled-back canary); if
+        # every candidate is weighted out, weights are ignored — serving
+        # degraded beats serving nothing
+        weighted = [r for r in candidates
+                    if getattr(r, "weight", 1.0) > 0.0]
+        candidates = weighted or candidates
         if not candidates:
             raise NoReplicaAvailable(
                 f"no available replica (total={len(self.pool.replicas())}, "
@@ -93,6 +108,10 @@ class Router:
         return chosen
 
     def _pick(self, candidates: List[Replica]) -> Replica:
+        weights = {r.name: max(0.0, getattr(r, "weight", 1.0))
+                   for r in candidates}
+        if len(set(weights.values())) > 1:
+            return self._pick_weighted(candidates, weights)
         if self.policy == "round_robin":
             with self._lock:
                 # stable order so the rotation actually rotates
@@ -103,6 +122,24 @@ class Router:
         return min(candidates, key=lambda r: (r.busy_fraction(), r.inflight,
                                               r.name))
 
+    def _pick_weighted(self, candidates: List[Replica],
+                       weights: dict) -> Replica:
+        """Smooth weighted round-robin: each pick adds every candidate's
+        weight to its running credit, the highest credit wins and pays the
+        total back. Deterministic, and over any window the share of picks
+        converges to weight/sum(weights) — the property the canary shift
+        test asserts."""
+        with self._lock:
+            total = sum(weights.values())
+            best: Optional[Replica] = None
+            for r in sorted(candidates, key=lambda r: r.name):
+                cur = self._wrr.get(r.name, 0.0) + weights[r.name]
+                self._wrr[r.name] = cur
+                if best is None or cur > self._wrr[best.name]:
+                    best = r
+            self._wrr[best.name] -= total
+            return best
+
     def _touch(self, key: str, name: str):
         with self._lock:
             self._affinity[key] = name
@@ -112,7 +149,14 @@ class Router:
 
     def forget_replica(self, name: str):
         """Drop affinity pins to a removed/dead replica so stale sessions
-        rebalance instead of pinning to a ghost."""
+        rebalance instead of pinning to a ghost.
+
+        Deliberately does NOT clear the replica's smooth-WRR credit: this
+        is called on EVERY replica failure, and erasing the debt a just-
+        picked replica owes would hand a failing canary the next pick
+        again (over-weighting exactly the replica that is erroring). A
+        stale credit entry for a removed replica is inert — it only moves
+        when the replica is a candidate again."""
         with self._lock:
             for k in [k for k, v in self._affinity.items() if v == name]:
                 del self._affinity[k]

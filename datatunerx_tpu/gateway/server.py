@@ -90,6 +90,10 @@ class Gateway:
         # clear/set and drop per-replica series) and the shed-delta tracking
         self._scrape_lock = threading.Lock()
         self._shed_at_last_hint = 0
+        # active canary promotion (experiment/promotion.py), single-flight;
+        # started by POST /admin/promote or ExperimentRunner
+        self.promotion = None
+        self._promotion_lock = threading.Lock()
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -160,15 +164,20 @@ class Gateway:
                         self._queue_wait.observe(
                             (time.monotonic() - t0) * 1e3)
                     replica.acquire()
+                    t_attempt = time.monotonic()
                     try:
                         text = replica.chat(messages, trace_id=root.trace_id,
                                             **kwargs)
                         replica.breaker.record_success()
+                        replica.record_outcome(
+                            True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0)
                         root.set(replica=replica.name, attempts=attempt + 1)
                         self._finish_request_span(root)
                         return text
                     except ReplicaError as e:
+                        replica.record_outcome(
+                            False, (time.monotonic() - t_attempt) * 1e3)
                         self._replica_failed(replica)
                         self._failovers.inc()
                         root.event("retry", replica=replica.name,
@@ -216,6 +225,7 @@ class Gateway:
                             (time.monotonic() - t0) * 1e3)
                     replica.acquire()
                     skip = len(emitted)
+                    t_attempt = time.monotonic()
                     try:
                         for delta in replica.chat_stream(
                                 messages, trace_id=root.trace_id, **kwargs):
@@ -231,12 +241,16 @@ class Gateway:
                             emitted += delta
                             yield delta
                         replica.breaker.record_success()
+                        replica.record_outcome(
+                            True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0)
                         root.set(replica=replica.name, attempts=attempt + 1,
                                  chars=len(emitted))
                         self._finish_request_span(root)
                         return
                     except ReplicaError as e:
+                        replica.record_outcome(
+                            False, (time.monotonic() - t_attempt) * 1e3)
                         self._replica_failed(replica)
                         self._failovers.inc()
                         root.event("retry", replica=replica.name,
@@ -387,10 +401,21 @@ class Gateway:
                         "Free paged KV-cache blocks per replica — the "
                         "admission headroom gauge (0 labels absent on "
                         "dense-cache replicas).")
+        weight = g("dtx_gateway_replica_weight",
+                   "Traffic weight per replica (canary promotion: the "
+                   "router's smooth-WRR share when weights are "
+                   "non-uniform; 0 = receives no new requests).")
+        attempts = self.registry.counter(
+            "dtx_gateway_replica_attempts_total",
+            "Routed attempts per replica by outcome (ok/error) — the "
+            "promotion guard's error-rate source, restated at scrape "
+            "time from the per-replica outcome windows.")
         circuit.clear()
         up.clear()
         busy.clear()
         blocks_free.clear()
+        weight.clear()
+        attempts.clear()
         for r in self.pool.replicas():
             state = r.breaker.state
             for s in ("closed", "half_open", "open"):
@@ -407,7 +432,54 @@ class Gateway:
             if st.get("kv_blocks_total"):
                 blocks_free.set(st.get("kv_blocks_free", 0),
                                 {"replica": r.name})
+            weight.set(round(getattr(r, "weight", 1.0), 6),
+                       {"replica": r.name})
+            out = r.outcome_stats()
+            attempts.set(out["requests"] - out["errors"],
+                         {"replica": r.name, "outcome": "ok"})
+            attempts.set(out["errors"],
+                         {"replica": r.name, "outcome": "error"})
         return self.registry.expose()
+
+    # ------------------------------------------------------------ promotion
+    def set_weight(self, name: str, weight: float) -> bool:
+        """Set one replica's traffic weight (router smooth-WRR share when
+        weights are non-uniform; 0 = no new requests)."""
+        r = self.pool.get(name)
+        if r is None:
+            return False
+        r.weight = max(0.0, float(weight))
+        return True
+
+    def start_promotion(self, canary: str, config: Optional[dict] = None,
+                        metrics=None, background: bool = True):
+        """Start a canary promotion (experiment/promotion.py): weighted
+        traffic shift through the schedule with auto-rollback. Single
+        flight — an active promotion raises ValueError. Returns the
+        controller (its status() is the /admin/promote response)."""
+        from datatunerx_tpu.experiment.promotion import (
+            TERMINAL,
+            PromotionConfig,
+            PromotionController,
+        )
+
+        with self._promotion_lock:
+            if self.promotion is not None \
+                    and self.promotion.state not in TERMINAL:
+                raise ValueError(
+                    f"a promotion of {self.promotion.canary_name!r} is "
+                    "already active")
+            cfg = PromotionConfig.from_dict(config or {})
+            promo = PromotionController(self, canary, config=cfg,
+                                        metrics=metrics)
+            self.promotion = promo
+        if background:
+            threading.Thread(target=promo.run, daemon=True).start()
+        return promo
+
+    def promotion_status(self) -> Optional[dict]:
+        promo = self.promotion
+        return promo.status() if promo is not None else None
 
     def scale(self, n: int) -> int:
         if self.replica_set is None:
@@ -634,6 +706,12 @@ def make_handler(gw: Gateway):
                     {"id": self.gateway.model_name, "object": "model"}]})
             elif self.path == "/autoscale":
                 self._json(200, self.gateway.autoscale())
+            elif self.path == "/admin/promote":
+                status = self.gateway.promotion_status()
+                if status is None:
+                    self._json(404, {"error": "no promotion started"})
+                else:
+                    self._json(200, status)
             elif self.path == "/metrics":
                 body = self.gateway.metrics_text().encode()
                 self.send_response(200)
@@ -670,6 +748,8 @@ def make_handler(gw: Gateway):
                 self._scale(req, trace_id)
             elif self.path == "/admin/drain":
                 self._drain(req, trace_id)
+            elif self.path == "/admin/promote":
+                self._promote(req, trace_id)
             elif self.path == "/debug/profile":
                 self._profile(req, trace_id)
             else:
@@ -796,6 +876,25 @@ def make_handler(gw: Gateway):
                 self._json(200, {"draining": name}, trace_id)
             else:
                 self._json(404, {"error": f"no replica {name!r}"}, trace_id)
+
+        def _promote(self, req: dict, trace_id: str):
+            """Start a canary promotion: {"replica": name, "schedule":
+            [w...], "step_s": s, "min_requests": n, "max_error_rate": f,
+            "max_latency_ratio": f}. The named replica must already be in
+            the pool (spawned from the winning checkpoint). 409 while a
+            promotion is active; the 202 body (and later GETs of this
+            path) carry the shift state + trace id."""
+            name = str(req.get("replica") or "")
+            if not name:
+                self._json(400, {"error": "replica is required"}, trace_id)
+                return
+            try:
+                promo = self.gateway.start_promotion(name, config=req)
+            except ValueError as e:
+                code = 409 if "already active" in str(e) else 400
+                self._json(code, {"error": str(e)}, trace_id)
+                return
+            self._json(202, promo.status(), trace_id)
 
         def _profile(self, req: dict, trace_id: str):
             """Pass a profiling request through to a replica (serving's

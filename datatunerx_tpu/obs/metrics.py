@@ -21,6 +21,7 @@ string work) happens only at scrape time.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -34,6 +35,19 @@ LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 # to multi-second cold prefills; fixed edges so replicas aggregate.
 MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
               1000.0, 2500.0, 5000.0, 10000.0, 30000.0, float("inf"))
+
+
+def sample_percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over RAW samples (0.0 on empty) — the one
+    implementation for every rolling-window quantile (replica outcome
+    windows, the prefetch advisory). ``Histogram.percentile`` stays the
+    bucketed flavor for exported histograms; this is for in-memory sample
+    lists where exactness is free."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+    return s[idx]
 
 
 def escape_label_value(v: str) -> str:

@@ -30,7 +30,7 @@ from datatunerx_tpu.operator.api import (
 from datatunerx_tpu.operator.reconciler import Result
 from datatunerx_tpu.operator.store import AlreadyExists, NotFound, ObjectStore, set_owner
 
-POLL_S = float(os.environ.get("DTX_EXPERIMENT_POLL_S", "5.0"))
+DEFAULT_POLL_S = 5.0
 
 
 def parse_score(s) -> float:
@@ -44,6 +44,15 @@ def parse_score(s) -> float:
 
 class FinetuneExperimentController:
     kind = FinetuneExperiment
+
+    def __init__(self, poll_s: Optional[float] = None):
+        # resolved at CONSTRUCTION, not import: tests and operators can set
+        # DTX_EXPERIMENT_POLL_S (or pass poll_s) without reloading the
+        # module — the old module-level read froze the env value for the
+        # process lifetime
+        self.poll_s = (float(os.environ.get("DTX_EXPERIMENT_POLL_S", "")
+                             or DEFAULT_POLL_S)
+                       if poll_s is None else float(poll_s))
 
     def reconcile(self, store: ObjectStore, exp: FinetuneExperiment) -> Optional[Result]:
         meta = exp.metadata
@@ -101,7 +110,7 @@ class FinetuneExperimentController:
                 except AlreadyExists:
                     pass
         if created:
-            return Result(requeue_after=POLL_S)
+            return Result(requeue_after=self.poll_s)
 
         # aggregation by name (reference :154-197)
         jobs = []
@@ -120,7 +129,7 @@ class FinetuneExperimentController:
         )
         if not all_terminal:
             store.update(exp)
-            return Result(requeue_after=POLL_S)
+            return Result(requeue_after=self.poll_s)
 
         successes = [j for j in jobs if j.status.get("state") == FinetuneJob.STATE_SUCCESSFUL]
         if not successes:

@@ -82,6 +82,12 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def all_steps(self) -> list:
+        """Every saved step, ascending — the continuous-scoring watcher
+        (experiment/watcher.py) lists a job's periodic eval checkpoints
+        through this."""
+        return sorted(self._mngr.all_steps())
+
     def restore(self, state_template, step: Optional[int] = None):
         """Restore into the structure/shardings of `state_template`."""
         import orbax.checkpoint as ocp

@@ -26,7 +26,11 @@ import time
 import urllib.request
 from typing import Dict, Optional
 
-from datatunerx_tpu.obs.metrics import Registry, set_build_info
+from datatunerx_tpu.obs.metrics import (
+    Registry,
+    sample_percentile,
+    set_build_info,
+)
 
 # ------------------------------------------------------------------ protobuf
 
@@ -133,6 +137,7 @@ class MetricsLogger:
         metrics_export_address: Optional[str] = None,
         uid: Optional[str] = None,
         registry: Optional[Registry] = None,
+        prefetch_depth: Optional[int] = None,
     ):
         self.output_dir = output_dir
         self.total_steps = max(total_steps, 1)
@@ -141,6 +146,17 @@ class MetricsLogger:
         self.start = time.time()
         self.watch_dir = os.path.join(output_dir, "watch")
         os.makedirs(self.watch_dir, exist_ok=True)
+        # prefetch-depth advisory (ROADMAP "input-path stragglers", first
+        # slice): watch the logged pipe_step_wait_ms signal and, once per
+        # run, suggest a deeper --prefetch_depth when its p95 says the step
+        # loop is waiting on the input pipeline
+        self.prefetch_depth = prefetch_depth
+        self.prefetch_advisory: Optional[dict] = None
+        self._pipe_waits: list = []
+        self._advise_after = int(
+            os.environ.get("DTX_PREFETCH_ADVISE_RECORDS", "20"))
+        self._advise_ms = float(
+            os.environ.get("DTX_PREFETCH_ADVISE_MS", "5.0"))
         # Shared-registry mirror of the training plane (obs/metrics.py, PR 7):
         # every logged record re-states dtx_train_*/dtx_eval_* gauges —
         # including the pipeline-health signals pipe_step_wait_ms and
@@ -188,10 +204,54 @@ class MetricsLogger:
         with open(os.path.join(self.watch_dir, filename), "a") as f:
             f.write(json.dumps(record) + "\n")
 
+    def _maybe_advise_prefetch(self, metrics: Dict[str, float]):
+        """Once per run: when dtx_train_pipe_step_wait_ms p95 over the last
+        DTX_PREFETCH_ADVISE_RECORDS logged records exceeds
+        DTX_PREFETCH_ADVISE_MS, log a suggested --prefetch_depth (double
+        the current depth; 2 when the pipeline ran at an unknown depth)."""
+        if self.prefetch_advisory is not None:
+            return
+        wait = metrics.get("pipe_step_wait_ms")
+        if wait is None:
+            return
+        w = _f(wait)
+        if math.isnan(w):
+            return
+        self._pipe_waits.append(w)
+        if len(self._pipe_waits) < self._advise_after:
+            return
+        window = self._pipe_waits[-self._advise_after:]
+        p95 = sample_percentile(window, 0.95)
+        if p95 <= self._advise_ms:
+            self._pipe_waits = self._pipe_waits[-self._advise_after:]
+            return
+        depth = self.prefetch_depth
+        suggested = depth * 2 if depth else 2
+        self.prefetch_advisory = {
+            "pipe_step_wait_ms_p95": round(p95, 3),
+            "threshold_ms": self._advise_ms,
+            "records": len(window),
+            "prefetch_depth": depth,
+            "suggested_prefetch_depth": suggested,
+        }
+        self.registry.gauge(
+            "dtx_train_prefetch_depth_suggested",
+            "Advisory: a deeper --prefetch_depth would likely hide input "
+            "stalls (0 = no advisory fired).").set(
+            suggested, {"uid": self.uid} if self.uid else None)
+        print(
+            f"[advice] input pipeline stalls: pipe_step_wait_ms p95="
+            f"{p95:.1f}ms over the last {len(window)} records exceeds "
+            f"{self._advise_ms:g}ms — the step loop is waiting on the "
+            f"input path; try --prefetch_depth {suggested}"
+            + (f" (currently {depth})" if depth else ""),
+            flush=True)
+
     def log_train(self, step: int, metrics: Dict[str, float]):
         rec = {**self._common(step), **{k: _f(v) for k, v in metrics.items()}}
         self._write("trainer_log.jsonl", rec)
         self._mirror("dtx_train", step, metrics)
+        self._maybe_advise_prefetch(metrics)
         print(f"[train] {json.dumps(rec)}", flush=True)
         if self.address:
             push_remote_write(

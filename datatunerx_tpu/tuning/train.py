@@ -304,6 +304,10 @@ def run(args: TrainArgs) -> dict:
     logger = MetricsLogger(
         args.output_dir, total_steps,
         metrics_export_address=args.metrics_export_address, uid=args.uid,
+        # lets the once-per-run prefetch advisory suggest a concrete
+        # deeper --prefetch_depth when pipe_step_wait_ms p95 says the
+        # step loop is starved by the input path
+        prefetch_depth=args.prefetch_depth,
     )
 
     # ----- loop --------------------------------------------------------

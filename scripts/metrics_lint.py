@@ -1,8 +1,11 @@
-"""Exposition-format + naming lint for the gateway and serving /metrics.
+"""Exposition-format + naming lint for the gateway, serving and experiment
+/metrics.
 
-Builds each server's exposition IN PROCESS (the same bytes a scraper gets:
-`Gateway.metrics_text()` and `serving.server.metrics_text()` against a
-duck-typed engine), then validates:
+Builds each plane's exposition IN PROCESS (the same bytes a scraper gets:
+`Gateway.metrics_text()` — including the per-replica traffic-weight and
+attempt-outcome series the canary promotion reads — the serving server's
+`metrics_text()` against a duck-typed engine, and an `ExperimentMetrics`
+registry driven through one simulated closed-loop pass), then validates:
 
   format  — the invariants a real Prometheus server enforces: one # TYPE
             line per metric preceding all its samples, no duplicate
@@ -109,10 +112,31 @@ def serving_exposition() -> str:
         serving.STATE.engine = old_engine
 
 
+def experiment_exposition() -> str:
+    """Drive every ExperimentMetrics recording path once so each
+    dtx_experiment_* series exposes real samples."""
+    from datatunerx_tpu.experiment.metrics import ExperimentMetrics
+
+    em = ExperimentMetrics(experiment="lint")
+    em.set_job_states({"Running": 2, "Pending": 1})
+    em.set_pool(free=1, held=2)
+    em.preempted()
+    em.resumed()
+    em.early_stopped()
+    em.scored("job-a", 61.5)
+    em.set_best(61.5)
+    em.set_canary_weight(0.25)
+    em.set_promotion_phase("shifting")
+    em.promotion_finished("completed")
+    em.promotion_finished("rolled_back")
+    return em.expose()
+
+
 def main() -> int:
     findings = []
     for plane, build in (("gateway", gateway_exposition),
-                         ("serving", serving_exposition)):
+                         ("serving", serving_exposition),
+                         ("experiment", experiment_exposition)):
         try:
             text = build()
         except Exception as e:  # noqa: BLE001 — a crash IS the finding
@@ -122,7 +146,8 @@ def main() -> int:
     for f in findings:
         print(f"metrics-lint: {f}")
     if not findings:
-        print("metrics-lint: gateway + serving expositions clean")
+        print("metrics-lint: gateway + serving + experiment expositions "
+              "clean")
     return 1 if findings else 0
 
 

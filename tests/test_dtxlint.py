@@ -748,6 +748,97 @@ def test_fix_refuses_loop_dependent_jit_and_module_constants():
     assert res.applied == 0 and res.unfixable == 2
 
 
+def test_fix_dtx004_inserts_key_split_for_double_consumption():
+    src = textwrap.dedent("""
+        import jax
+
+
+        def sample(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """)
+    fixed, res = fix_source(src, "m.py")
+    assert res.changed and res.applied == 1 and res.unfixable == 0
+    assert lint_source(fixed, path="m.py", config=CFG).findings == []
+    # the split lands BEFORE the first consumption (splitting after it
+    # would itself reuse the consumed key) and rebinds the carry
+    assert "key, key_split1 = jax.random.split(key)" in fixed
+    assert fixed.index("= jax.random.split") < fixed.index("jax.random.normal")
+    assert "jax.random.normal(key_split1, (4,))" in fixed
+    assert "jax.random.uniform(key, (4,))" in fixed  # consumes the new carry
+    # idempotent: nothing left to fix
+    again, res2 = fix_source(fixed, "m.py")
+    assert again == fixed and not res2.changed and res2.applied == 0
+
+
+def test_fix_dtx004_loop_reuse_splits_per_iteration():
+    src = textwrap.dedent("""
+        import jax
+
+
+        def rollout(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.normal(key, (2,)))
+            return out
+    """)
+    fixed, res = fix_source(src, "m.py")
+    assert res.changed and res.applied == 1
+    assert lint_source(fixed, path="m.py", config=CFG).findings == []
+    # the split sits INSIDE the loop so every iteration advances the carry
+    assert fixed.index("for i in range(n):") \
+        < fixed.index("key, key_split1 = jax.random.split(key)")
+    assert "jax.random.normal(key_split1, (2,))" in fixed
+    again, res2 = fix_source(fixed, "m.py")
+    assert again == fixed and not res2.changed
+
+
+def test_fix_dtx004_respects_aliases_and_refuses_bare_imports():
+    # module alias: the inserted split reuses the call's own module path
+    src = textwrap.dedent("""
+        from jax import random as jr
+
+
+        def sample(key):
+            a = jr.normal(key, (4,))
+            b = jr.uniform(key, (4,))
+            return a + b
+    """)
+    fixed, res = fix_source(src, "m.py")
+    assert res.applied == 1
+    assert "key, key_split1 = jr.split(key)" in fixed
+    assert lint_source(fixed, path="m.py", config=CFG).findings == []
+    # bare from-import: no module path to borrow `split` from — the
+    # finding is reported unfixable and the source left untouched
+    src2 = textwrap.dedent("""
+        from jax.random import normal, uniform
+
+
+        def sample(key):
+            a = normal(key, (4,))
+            b = uniform(key, (4,))
+            return a + b
+    """)
+    fixed2, res2 = fix_source(src2, "m.py")
+    assert fixed2 == src2 and not res2.changed and res2.unfixable == 1
+
+
+def test_fix_dtx004_clean_split_idiom_untouched():
+    src = textwrap.dedent("""
+        import jax
+
+
+        def sample(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """)
+    fixed, res = fix_source(src, "m.py")
+    assert fixed == src and not res.changed and res.applied == 0
+
+
 def test_apply_edits_adjacent_ok_overlap_refused():
     assert apply_edits("abcdef", [SpanEdit(0, 2, "X"),
                                   SpanEdit(2, 4, "Y")]) == "XYef"

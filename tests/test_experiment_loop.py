@@ -1,0 +1,470 @@
+"""Closed-loop e2e: experiment → continuous scoring → canary promotion.
+
+The acceptance scenario for the experiment plane, CPU-only and model-free:
+3 fake jobs share a 2-slice fake pool, one job is preempted by a pool
+shrink and resumed FROM A REAL ORBAX CHECKPOINT (the probe reads the step
+through the trainer's CheckpointManager), the continuous-scoring watcher
+keeps a live leaderboard and early-stops the clear loser, and the winner is
+promoted through the in-process gateway: canary replica → weighted traffic
+shift whose shares are observable at the fake engines → 100% rollout.
+A companion case exercises auto-rollback when the canary regresses, and the
+HTTP surface (POST/GET /admin/promote, GET /debug/trace/<id>) is driven
+over a real loopback server.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from datatunerx_tpu.experiment.metrics import ExperimentMetrics
+from datatunerx_tpu.experiment.pool import PoolSlice, SharedSlicePool
+from datatunerx_tpu.experiment.runner import (
+    PHASE_DONE,
+    PHASE_PROMOTE,
+    ExperimentRunner,
+)
+from datatunerx_tpu.experiment.scheduler import (
+    PREEMPTED,
+    RUNNING,
+    STOPPED,
+    SliceScheduler,
+)
+from datatunerx_tpu.experiment.watcher import (
+    ContinuousScoringWatcher,
+    Leaderboard,
+)
+from datatunerx_tpu.gateway.replica_pool import InProcessReplica, ReplicaPool
+from datatunerx_tpu.gateway.server import Gateway, serve
+from datatunerx_tpu.operator.backends import (
+    FakeServingBackend,
+    FakeTrainingBackend,
+)
+
+EIGHT = {"meshShape": "dp=8"}
+
+
+class FakeEngine:
+    def __init__(self, name, reply="hello world", dead=False):
+        self.name = name
+        self.reply = reply
+        self.slots = 4
+        self._slot_req = [None] * 4
+        self.dead = dead
+        self.calls = 0
+
+    def chat(self, messages, **kw):
+        self.calls += 1
+        if self.dead:
+            raise RuntimeError(f"{self.name} is dead")
+        return self.reply
+
+
+def _metrics_lint():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "metrics_lint.py")
+    spec = importlib.util.spec_from_file_location("metrics_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _msg():
+    return [{"role": "user", "content": f"q-{uuid.uuid4().hex}"}]
+
+
+def _pump(gw, n):
+    for _ in range(n):
+        gw.chat({"messages": _msg()})
+
+
+# ---------------------------------------------------------------- the loop
+def test_closed_loop_e2e(tmp_path):
+    import numpy as np
+
+    from datatunerx_tpu.training.checkpoint import CheckpointManager
+
+    # job-a trains with REAL periodic orbax checkpoints: its preemption
+    # must record the step the orbax restore path will hand back
+    ckpt_dir = str(tmp_path / "job-a-ckpts")
+    mngr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+    mngr.maybe_save({"w": np.ones(2, np.float32)}, step=3, force=True)
+    mngr.close()
+
+    em = ExperimentMetrics(experiment="e2e")
+    backend = FakeTrainingBackend()
+    pool = SharedSlicePool([PoolSlice("s0"), PoolSlice("s1")])
+    sched = SliceScheduler(pool, backend, metrics=em)
+
+    feeds = {"job-a": {1: 80.0, 2: 85.0}, "job-b": {1: 20.0, 2: 22.0},
+             "job-c": {1: 50.0, 2: 55.0}}
+    revealed = {n: 0 for n in feeds}
+    watcher = ContinuousScoringWatcher(
+        sched,
+        lambda j: [s for s in sorted(feeds[j.name])
+                   if s <= revealed[j.name]],
+        lambda j, s: feeds[j.name][s],
+        board=Leaderboard(), metrics=em,
+        early_stop_margin=30.0, min_evals=2)
+
+    fleet = [FakeEngine("fleet-0"), FakeEngine("fleet-1")]
+    gw_pool = ReplicaPool([InProcessReplica(e.name, e) for e in fleet])
+    gw = Gateway(gw_pool, model_name="e2e")
+    serving = FakeServingBackend()
+    canary_engine = FakeEngine("canary", reply="promoted!")
+    runner = ExperimentRunner(
+        "e2e", sched, watcher, gateway=gw, serving_backend=serving,
+        canary_replica_factory=lambda job: InProcessReplica(
+            "unused", canary_engine),
+        promotion_config={"schedule": [0.25, 1.0], "min_requests": 4,
+                          "step_s": 60.0},
+        metrics=em)
+
+    sched.add_job("job-a", {"parameters": EIGHT,
+                            "checkpoint_dir": ckpt_dir})
+    sched.add_job("job-b", {"parameters": EIGHT})
+    sched.add_job("job-c", {"parameters": EIGHT})
+
+    # ---- tick 1: two slices, first two jobs run, job-c queues
+    runner.tick()
+    assert {j.name for j in sched.jobs() if j.state == RUNNING} \
+        == {"job-a", "job-b"}
+
+    # ---- first eval lands for a and b: live leaderboard
+    revealed["job-a"] = revealed["job-b"] = 1
+    runner.tick()
+    assert watcher.board.leader().job == "job-a"
+
+    # ---- pool shrinks under job-a: PREEMPTION with the orbax step
+    displaced = sched.shrink(pool.assignment("job-a").name)
+    assert displaced == "job-a"
+    job_a = sched.job("job-a")
+    assert job_a.state == PREEMPTED and job_a.resume_step == 3
+
+    # ---- next tick: the displaced LEADER evicts the trailing job-b and
+    # RESUMES from its checkpoint
+    runner.tick()
+    assert job_a.state == RUNNING and job_a.resumes == 1
+    assert backend.jobs["job-a"]["env"]["DTX_RESUME_FROM_STEP"] == "3"
+    assert sched.job("job-b").state == PREEMPTED
+
+    # ---- pool grows back: job-b resumes beside the leader
+    sched.grow(PoolSlice("s2"))
+    runner.tick()
+    assert sched.job("job-b").state == RUNNING
+
+    # ---- second evals land: job-b is a clear loser → early-stopped,
+    # freeing its slice for job-c
+    revealed["job-a"] = revealed["job-b"] = 2
+    runner.tick()
+    assert sched.job("job-b").state == STOPPED
+    runner.tick()
+    assert sched.job("job-c").state == RUNNING
+    revealed["job-c"] = 2
+    runner.tick()
+
+    # ---- training completes; the winner is the leaderboard leader
+    backend.set_state("job-a", "Succeeded")
+    backend.set_state("job-c", "Succeeded")
+    runner.tick()
+    assert runner.phase == PHASE_PROMOTE
+    assert runner.winner.job == "job-a" and runner.winner.score == 85.0
+
+    # ---- promotion: canary deploys via the serving backend, waits for
+    # HEALTHY, then the weighted shift begins
+    runner.tick()  # deploys; backend still PENDING
+    assert "e2e-canary" in serving.apps
+    assert runner.promotion is None
+    serving.set_state("e2e-canary", "HEALTHY")
+    runner.tick()  # replica in pool + promotion starts
+    assert runner.promotion is not None
+    runner.tick()  # stage 0 weights applied (canary 25%)
+    canary = gw_pool.get("e2e-canary")
+    assert canary is not None and canary.weight == pytest.approx(0.25)
+    assert all(gw_pool.get(e.name).weight == pytest.approx(0.375)
+               for e in fleet)
+
+    # ---- observable shift: smooth WRR gives the canary EXACTLY its share
+    _pump(gw, 16)
+    assert canary_engine.calls == 4  # 25% of 16
+    runner.tick()  # judge stage 0 (clean) → advance to weight 1.0
+    assert canary.weight == pytest.approx(1.0)
+    assert all(gw_pool.get(e.name).weight == 0.0 for e in fleet)
+    before = canary_engine.calls
+    _pump(gw, 6)
+    assert canary_engine.calls == before + 6  # full rollout: all traffic
+    runner.tick()  # judge final stage → COMPLETED
+    assert runner.phase == PHASE_DONE
+    assert runner.promotion.state == "completed"
+    assert runner.events[-1]["promoted"] is True
+
+    # ---- promotion phases visible as spans via GET /debug/trace/<id>
+    srv = serve(gw, port=0, host="127.0.0.1")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = (f"http://127.0.0.1:{srv.server_port}"
+               f"/debug/trace/{runner.trace_id}")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            doc = json.load(r)
+        names = [s["name"] for s in doc["spans"]]
+        assert "experiment.train" in names
+        assert "experiment.promote" in names
+        assert "promotion" in names
+        assert names.count("promotion.stage") == 2
+        stage_weights = sorted(s["attrs"]["weight"] for s in doc["spans"]
+                               if s["name"] == "promotion.stage")
+        assert stage_weights == [0.25, 1.0]
+    finally:
+        srv.shutdown()
+
+    # ---- dtx_experiment_* exposition passes the metrics lint, and the
+    # gateway exposes the per-replica weight series
+    lint = _metrics_lint()
+    assert lint.lint_exposition(em.expose(), "experiment") == []
+    text = em.expose()
+    assert "dtx_experiment_preemptions_total 2" in text  # a, then b evicted
+    assert "dtx_experiment_resumes_total 2" in text
+    assert "dtx_experiment_early_stops_total 1" in text
+    assert 'dtx_experiment_promotion_phase{phase="completed"} 1' in text
+    gw_text = gw.metrics_text()
+    assert lint.lint_exposition(gw_text, "gateway") == []
+    assert ('dtx_gateway_replica_weight{replica="e2e-canary"} 1'
+            in gw_text)
+
+
+# ---------------------------------------------------------------- rollback
+def test_promotion_rolls_back_on_canary_regression():
+    fleet = [FakeEngine("fleet-0"), FakeEngine("fleet-1")]
+    pool = ReplicaPool([InProcessReplica(e.name, e) for e in fleet])
+    gw = Gateway(pool, model_name="rb")
+    em = ExperimentMetrics(experiment="rb")
+    bad = FakeEngine("canary", dead=True)  # every canary attempt errors
+    pool.add(InProcessReplica("canary", bad))
+
+    promo = gw.start_promotion(
+        "canary", config={"schedule": [0.5, 1.0], "min_requests": 3,
+                          "step_s": 60.0},
+        metrics=em, background=False)
+    promo.tick()  # stage 0: canary at 50%
+    assert pool.get("canary").weight == pytest.approx(0.5)
+    # requests still succeed END-TO-END (failover), but the canary's
+    # outcome window fills with errors and its breaker opens
+    _pump(gw, 12)
+    assert bad.calls >= 3
+    state = promo.tick()
+    assert state == "rolled_back"
+    assert promo.reason
+    assert pool.get("canary").weight == 0.0
+    assert all(pool.get(e.name).weight == pytest.approx(1.0)
+               for e in fleet)
+    text = em.expose()
+    assert "dtx_experiment_rollbacks_total 1" in text
+    assert 'dtx_experiment_promotions_total{outcome="rolled_back"} 1' in text
+    assert 'dtx_experiment_promotion_phase{phase="rolled_back"} 1' in text
+    # a terminal promotion releases the single-flight slot
+    promo2 = gw.start_promotion("canary", config={"schedule": [1.0]},
+                                background=False)
+    assert promo2 is not promo
+    gw.close()
+
+
+# ------------------------------------------------------------- http surface
+@pytest.fixture()
+def http_gateway():
+    made = []
+
+    def start(engines, **kw):
+        pool = ReplicaPool([InProcessReplica(e.name, e) for e in engines])
+        gw = Gateway(pool, **kw)
+        srv = serve(gw, port=0, host="127.0.0.1")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        made.append((gw, srv))
+        return gw, f"http://127.0.0.1:{srv.server_port}"
+
+    yield start
+    for gw, srv in made:
+        srv.shutdown()
+        gw.close()
+
+
+def _post(url, path, payload):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _get(url, path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_admin_promote_http_contract(http_gateway):
+    fleet = [FakeEngine("fleet-0"), FakeEngine("fleet-1")]
+    gw, url = http_gateway(fleet, model_name="m")
+    canary_engine = FakeEngine("canary", reply="new model")
+    gw.pool.add(InProcessReplica("canary", canary_engine))
+
+    code, body = _get(url, "/admin/promote")
+    assert code == 404  # nothing started yet
+    code, body = _post(url, "/admin/promote", {"replica": "ghost"})
+    assert code == 400
+    code, body = _post(url, "/admin/promote",
+                       {"replica": "canary", "schedule": [0.5, 1.0],
+                        "min_requests": 2, "step_s": 30.0})
+    assert code == 202
+    trace_id = body["trace_id"]
+    assert body["canary"] == "canary" and body["schedule"] == [0.5, 1.0]
+    code, _ = _post(url, "/admin/promote", {"replica": "canary"})
+    assert code == 409  # single flight while active
+
+    # traffic over the HTTP surface drives the stages forward
+    deadline = time.monotonic() + 30
+    state = ""
+    while time.monotonic() < deadline:
+        _post(url, "/chat/completions", {"messages": _msg()})
+        code, body = _get(url, "/admin/promote")
+        state = body["state"]
+        if state in ("completed", "rolled_back"):
+            break
+        time.sleep(0.05)
+    assert state == "completed"
+    assert canary_engine.calls > 0
+
+    # the whole shift is one trace: root + one span per stage
+    code, doc = _get(url, f"/debug/trace/{trace_id}")
+    assert code == 200
+    names = [s["name"] for s in doc["spans"]]
+    assert "promotion" in names and names.count("promotion.stage") == 2
+
+    # weights survived to full rollout and are scrapeable
+    with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'dtx_gateway_replica_weight{replica="canary"} 1' in text
+    assert 'dtx_gateway_replica_weight{replica="fleet-0"} 0' in text
+    assert "dtx_gateway_replica_attempts_total" in text
+
+
+def test_promote_schedule_validation(http_gateway):
+    gw, url = http_gateway([FakeEngine("fleet-0"), FakeEngine("x")])
+    code, body = _post(url, "/admin/promote",
+                       {"replica": "x", "schedule": [0.5, 0.25]})
+    assert code == 400 and "schedule" in body["error"]
+    code, body = _post(url, "/admin/promote",
+                       {"replica": "x", "schedule": [0.5]})
+    assert code == 400  # must end at 1.0
+
+
+def test_single_transient_error_does_not_roll_back():
+    """The error-rate guard waits for min_requests of evidence — one
+    transient canary failure (breaker still closed) must not kill the
+    promotion."""
+    fleet = [FakeEngine("fleet-0"), FakeEngine("fleet-1")]
+    pool = ReplicaPool([InProcessReplica(e.name, e) for e in fleet])
+    gw = Gateway(pool, model_name="tr")
+    flaky = FakeEngine("canary")
+    pool.add(InProcessReplica("canary", flaky))
+    promo = gw.start_promotion(
+        "canary", config={"schedule": [0.5, 1.0], "min_requests": 6,
+                          "step_s": 60.0}, background=False)
+    promo.tick()  # stage 0 at 50%
+    flaky.dead = True
+    _pump(gw, 2)  # exactly one canary attempt — it fails, failover serves
+    flaky.dead = False
+    assert promo.tick() == "shifting"  # 1 error, < min_requests: no verdict
+    assert promo.stage == 0
+    # healthy traffic dilutes the transient: 1 error over 25 canary
+    # attempts = 4% < max_error_rate 5% → the stage advances, no rollback
+    _pump(gw, 48)
+    assert promo.tick() == "shifting" and promo.stage == 1
+    _pump(gw, 8)
+    assert promo.tick() == "completed"
+    gw.close()
+
+
+def test_replica_added_mid_shift_joins_the_weight_scheme():
+    """The fleet is resolved live: a replica added during the shift is
+    folded in at the next weight application and reset on completion —
+    it must not keep weight 1.0 while the canary is 'fully rolled out'."""
+    fleet = [FakeEngine("fleet-0"), FakeEngine("fleet-1")]
+    pool = ReplicaPool([InProcessReplica(e.name, e) for e in fleet])
+    gw = Gateway(pool, model_name="grow")
+    canary_engine = FakeEngine("canary")
+    pool.add(InProcessReplica("canary", canary_engine))
+    promo = gw.start_promotion(
+        "canary", config={"schedule": [0.5, 1.0], "min_requests": 2,
+                          "step_s": 60.0}, background=False)
+    promo.tick()
+    late = FakeEngine("late-joiner")
+    pool.add(InProcessReplica("late-joiner", late))  # autoscale mid-shift
+    _pump(gw, 8)
+    promo.tick()  # advance to 1.0: the late joiner must be weighted out
+    assert promo.state in ("shifting", "completed")
+    _pump(gw, 4)
+    while promo.tick() not in ("completed", "rolled_back"):
+        _pump(gw, 2)
+    assert promo.state == "completed"
+    assert pool.get("late-joiner").weight == 0.0
+    assert pool.get("canary").weight == pytest.approx(1.0)
+    gw.close()
+
+
+# ------------------------------------------------------------ dtx experiment
+def test_cli_fake_backend_runs_whole_loop(tmp_path, capsys):
+    """`dtx experiment -f examples/experiment.json --backend fake` drives
+    the entire closed loop in-process: simulated training, leaderboard,
+    early stop, canary shift to 100%."""
+    from datatunerx_tpu.cli import main as dtx_main
+
+    spec = os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                        "experiment.json")
+    status_path = str(tmp_path / "status.json")
+    rc = dtx_main(["experiment", "-f", spec, "--backend", "fake",
+                   "--tick_s", "0", "--status_json", status_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"event": "early_stop"' in out
+    assert '"event": "promotion_started"' in out
+    status = json.load(open(status_path))
+    assert status["phase"] == "done"
+    assert status["winner"] == "job-a"
+    assert status["promotion"]["state"] == "completed"
+    assert status["promotion"]["weight"] == 1.0
+    board = {e["job"]: e for e in status["leaderboard"]["standings"]}
+    assert board["job-b"]["evals"] >= 2  # loser was continuously scored
+
+
+# ----------------------------------------------------------- weighted WRR
+def test_weighted_routing_shares_are_exact():
+    engines = [FakeEngine("a"), FakeEngine("b"), FakeEngine("c")]
+    pool = ReplicaPool([InProcessReplica(e.name, e) for e in engines])
+    gw = Gateway(pool, model_name="w")
+    gw.set_weight("a", 0.375)
+    gw.set_weight("b", 0.375)
+    gw.set_weight("c", 0.25)
+    _pump(gw, 16)
+    assert {e.name: e.calls for e in engines} == {"a": 6, "b": 6, "c": 4}
+    # weight 0 receives nothing
+    gw.set_weight("c", 0.0)
+    for e in engines:
+        e.calls = 0
+    _pump(gw, 8)
+    assert engines[2].calls == 0 and sum(e.calls for e in engines) == 8
+    # uniform weights restore the pre-weight least-busy behavior (no WRR)
+    gw.set_weight("a", 1.0)
+    gw.set_weight("b", 1.0)
+    gw.set_weight("c", 1.0)
+    _pump(gw, 4)
+    assert sum(e.calls for e in engines) == 12

@@ -1,0 +1,355 @@
+"""Experiment plane units: shared slice pool (mesh gang-fit), elastic
+scheduler (preempt/resume via the orbax restore path, score-aware
+priorities), continuous-scoring watcher (leaderboard, early stop) and the
+scoring-controller bridge.
+
+CPU-only: FakeTrainingBackend drives job transitions; the one orbax test
+saves/restores a tiny pytree to prove preemption records the real
+checkpoint step a resumed job would restore from.
+"""
+
+import json
+import threading
+
+import pytest
+
+from datatunerx_tpu.experiment.metrics import ExperimentMetrics
+from datatunerx_tpu.experiment.pool import PoolSlice, SharedSlicePool, mesh_fits
+from datatunerx_tpu.experiment.scheduler import (
+    FAILED,
+    PENDING,
+    PREEMPTED,
+    RUNNING,
+    STOPPED,
+    SUCCEEDED,
+    SliceScheduler,
+)
+from datatunerx_tpu.experiment.watcher import (
+    ContinuousScoringWatcher,
+    Leaderboard,
+    scoring_cr_score,
+)
+from datatunerx_tpu.operator.backends import FakeTrainingBackend
+
+EIGHT = {"meshShape": "dp=8"}  # needs all 8 chips of a 2x4 slice
+ANY = {}  # absorbs into whatever slice it gets
+
+
+def make_sched(slices=("s0", "s1"), chips=8, metrics=None, probe=None):
+    pool = SharedSlicePool([PoolSlice(n, chips=chips) for n in slices])
+    backend = FakeTrainingBackend()
+    kw = {}
+    if probe is not None:
+        kw["checkpoint_probe"] = probe
+    sched = SliceScheduler(pool, backend, metrics=metrics, **kw)
+    return sched, backend, pool
+
+
+# ------------------------------------------------------------------- pool
+def test_mesh_gang_fit_uses_trainer_mesh_parser():
+    assert mesh_fits(EIGHT, 8)
+    assert not mesh_fits(EIGHT, 4)  # dp=8 cannot tile 4 chips
+    assert mesh_fits({"meshShape": "dp=2,tp=2"}, 4)
+    assert not mesh_fits({"meshShape": "dp=3"}, 8)  # 3 doesn't tile 8
+    assert mesh_fits(ANY, 8)  # absent meshShape absorbs
+
+
+def test_pool_acquires_smallest_fitting_slice_and_releases():
+    pool = SharedSlicePool([PoolSlice("big", chips=16),
+                            PoolSlice("small", chips=8)])
+    assert pool.acquire("flex", ANY).name == "small"  # smallest fit wins
+    pool.release("flex")
+    s = pool.acquire("job-a", EIGHT)
+    assert s.name == "small"  # gang-fit is EXACT tiling: dp=8 ∉ 16 chips
+    assert pool.acquire("job-a", EIGHT).name == "small"  # idempotent
+    assert pool.acquire("job-b", EIGHT) is None  # big can't tile dp=8
+    s2 = pool.acquire("job-b", {"meshShape": "dp=8,fsdp=2"})
+    assert s2.name == "big"
+    pool.release("job-a")
+    assert pool.acquire("job-c", EIGHT).name == "small"
+
+
+def test_pool_remove_slice_reports_displaced_holder():
+    pool = SharedSlicePool([PoolSlice("s0"), PoolSlice("s1")])
+    pool.acquire("job-a", ANY)
+    assert pool.remove_slice("missing") is None
+    held = pool.assignment("job-a").name
+    other = "s1" if held == "s0" else "s0"
+    assert pool.remove_slice(other) is None  # free slice: nobody displaced
+    assert pool.remove_slice(held) == "job-a"
+    assert pool.size() == 0
+
+
+# -------------------------------------------------------------- scheduler
+def test_scheduler_admits_up_to_pool_capacity():
+    sched, backend, _ = make_sched()
+    for n in ("job-a", "job-b", "job-c"):
+        sched.add_job(n, {"parameters": EIGHT})
+    events = sched.tick()
+    assert [e["event"] for e in events] == ["started", "started"]
+    states = {j.name: j.state for j in sched.jobs()}
+    assert sorted(s for s in states.values()) == [PENDING, RUNNING, RUNNING]
+    assert set(backend.jobs) == {e["job"] for e in events}
+    # a job finishing frees its slice for the pending one
+    running = [n for n, s in states.items() if s == RUNNING]
+    backend.set_state(running[0], "Succeeded")
+    events = sched.tick()
+    kinds = {e["event"] for e in events}
+    assert kinds == {"succeeded", "started"}
+    assert sched.job(running[0]).state == SUCCEEDED
+    assert all(j.state in (RUNNING, SUCCEEDED) for j in sched.jobs())
+
+
+def test_scheduler_failure_is_terminal_and_frees_slice():
+    sched, backend, pool = make_sched(slices=("s0",))
+    sched.add_job("job-a", {"parameters": EIGHT})
+    sched.add_job("job-b", {"parameters": EIGHT})
+    sched.tick()
+    backend.set_state("job-a", "Failed")
+    sched.tick()
+    assert sched.job("job-a").state == FAILED
+    assert sched.job("job-b").state == RUNNING
+    assert pool.holder_of("s0") == "job-b"
+
+
+def test_preempt_and_resume_via_orbax_restore_path(tmp_path):
+    """Preemption records the job's latest ORBAX step — probed through the
+    trainer's CheckpointManager — and the resumed submission carries it;
+    the saved state actually restores through the same manager (the path a
+    resumed trainer takes)."""
+    import numpy as np
+
+    from datatunerx_tpu.training.checkpoint import CheckpointManager
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mngr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+    assert mngr.maybe_save(state, step=2, force=True)
+    mngr.close()
+
+    sched, backend, pool = make_sched(slices=("s0",))
+    sched.add_job("job-a", {"parameters": EIGHT, "checkpoint_dir": ckpt_dir})
+    sched.tick()
+    assert sched.job("job-a").state == RUNNING
+
+    step = sched.preempt("job-a")
+    assert step == 2
+    job = sched.job("job-a")
+    assert job.state == PREEMPTED and job.preemptions == 1
+    assert "job-a" in backend.deleted
+    assert pool.holder_of("s0") is None
+
+    events = sched.tick()  # slice is free again: the job resumes
+    assert events[0]["event"] == "resumed"
+    assert events[0]["resume_step"] == 2
+    assert job.state == RUNNING and job.resumes == 1
+    assert backend.jobs["job-a"]["env"]["DTX_RESUME_FROM_STEP"] == "2"
+
+    # the restore path the resumed trainer takes hands the state back
+    mngr = CheckpointManager(ckpt_dir)
+    restored, got_step = mngr.restore(state)
+    mngr.close()
+    assert got_step == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), state["w"])
+
+
+def test_shrink_preempts_holder_and_leader_evicts_lower_priority():
+    em = ExperimentMetrics()
+    sched, backend, pool = make_sched(metrics=em, probe=lambda j: None)
+    sched.add_job("leader", {"parameters": EIGHT})
+    sched.add_job("loser", {"parameters": EIGHT})
+    sched.tick()
+    sched.set_score("leader", 90.0)
+    sched.set_score("loser", 10.0)
+    doomed = pool.assignment("leader").name
+    displaced = sched.shrink(doomed)
+    assert displaced == "leader"
+    assert sched.job("leader").state == PREEMPTED
+    assert pool.size() == 1
+    # next tick: the displaced leader outranks the running loser and takes
+    # its slice back (score-aware eviction)
+    events = sched.tick()
+    kinds = [e["event"] for e in events]
+    assert "evicted" in kinds and "resumed" in kinds
+    assert sched.job("loser").state == PREEMPTED
+    assert sched.job("leader").state == RUNNING
+    assert em.registry.counter("dtx_experiment_preemptions_total").get() == 2
+
+
+def test_eviction_requires_victims_slice_to_fit_contender():
+    """A displaced leader must not evict a job whose slice its mesh can't
+    tile — that would burn the victim's checkpoint interval for nothing
+    and thrash it every tick."""
+    pool = SharedSlicePool([PoolSlice("small", chips=4)])
+    backend = FakeTrainingBackend()
+    sched = SliceScheduler(pool, backend, checkpoint_probe=lambda j: None)
+    sched.add_job("loser", {"parameters": {"meshShape": "dp=4"}})
+    sched.tick()
+    sched.set_score("loser", 10.0)
+    # leader needs 8 chips; the only running job holds a 4-chip slice
+    sched.add_job("leader", {"parameters": EIGHT})
+    sched.set_score("leader", 90.0)
+    for _ in range(3):
+        events = sched.tick()
+        assert all(e["event"] != "evicted" for e in events)
+    assert sched.job("loser").state == RUNNING
+    assert sched.job("loser").preemptions == 0
+    assert sched.job("leader").state == PENDING
+
+
+def test_resume_marker_never_leaks_into_later_submissions():
+    """The env copy handed to the backend must not alias job.spec: a
+    resume step recorded once must not reappear on a later submission the
+    scheduler didn't decide (probe came back None)."""
+    steps = iter([7, None])
+    sched, backend, _ = make_sched(slices=("s0",),
+                                   probe=lambda j: next(steps))
+    original_env = {"KEEP": "1"}
+    sched.add_job("job-a", {"parameters": EIGHT, "env": original_env})
+    sched.tick()
+    sched.preempt("job-a")  # probe -> 7
+    sched.tick()
+    assert backend.jobs["job-a"]["env"]["DTX_RESUME_FROM_STEP"] == "7"
+    assert "DTX_RESUME_FROM_STEP" not in original_env  # spec not mutated
+    sched.preempt("job-a")  # probe -> None: no step this time
+    sched.tick()
+    assert "DTX_RESUME_FROM_STEP" not in backend.jobs["job-a"]["env"]
+    assert backend.jobs["job-a"]["env"]["KEEP"] == "1"
+
+
+def test_unscored_job_never_evicts_a_runner():
+    sched, backend, pool = make_sched(slices=("s0",), probe=lambda j: None)
+    sched.add_job("runner", {"parameters": EIGHT})
+    sched.tick()
+    sched.set_score("runner", 5.0)
+    sched.add_job("newcomer", {"parameters": EIGHT})
+    events = sched.tick()
+    assert all(e["event"] != "evicted" for e in events)
+    assert sched.job("newcomer").state == PENDING
+
+
+# ---------------------------------------------------------------- watcher
+def drive_watcher(feeds, margin=None, min_evals=2):
+    """feeds: {job: {step: score}} revealed one step per tick."""
+    em = ExperimentMetrics()
+    sched, backend, _ = make_sched(slices=("s0", "s1", "s2"), metrics=em)
+    for name in feeds:
+        sched.add_job(name, {"parameters": ANY})
+    sched.tick()
+    revealed = {n: 0 for n in feeds}
+
+    def checkpoints(job):
+        return [s for s in sorted(feeds[job.name]) if s <= revealed[job.name]]
+
+    def score(job, step):
+        return feeds[job.name][step]
+
+    w = ContinuousScoringWatcher(sched, checkpoints, score,
+                                 board=Leaderboard(), metrics=em,
+                                 early_stop_margin=margin,
+                                 min_evals=min_evals)
+    return sched, w, em, revealed
+
+
+def test_watcher_scores_new_checkpoints_into_leaderboard():
+    sched, w, em, revealed = drive_watcher(
+        {"a": {1: 50.0, 2: 60.0}, "b": {1: 40.0, 2: 45.0}})
+    assert w.tick() == []  # nothing revealed yet
+    revealed["a"] = revealed["b"] = 1
+    events = w.tick()
+    assert {(e["job"], e["step"]) for e in events} == {("a", 1), ("b", 1)}
+    assert w.tick() == []  # already scored: no re-scoring
+    revealed["a"] = revealed["b"] = 2
+    w.tick()
+    board = w.board
+    assert board.leader().job == "a" and board.leader().score == 60.0
+    assert board.entry("b").history == [(1, 40.0), (2, 45.0)]
+    assert sched.job("a").score == 60.0  # priorities fed
+    assert em.registry.gauge("dtx_experiment_best_score").get() == 60.0
+    assert em.registry.counter("dtx_experiment_evals_total").get() == 4
+
+
+def test_watcher_early_stops_clear_loser_and_frees_slice():
+    sched, w, em, revealed = drive_watcher(
+        {"a": {1: 80.0, 2: 85.0}, "b": {1: 20.0, 2: 22.0}},
+        margin=30.0, min_evals=2)
+    revealed["a"] = revealed["b"] = 1
+    assert all(e["event"] != "early_stop" for e in w.tick())  # 1 eval < min
+    revealed["a"] = revealed["b"] = 2
+    events = w.tick()
+    stops = [e for e in events if e["event"] == "early_stop"]
+    assert [e["job"] for e in stops] == ["b"]
+    assert sched.job("b").state == STOPPED
+    assert sched.job("b").stop_reason == "early_stop"
+    assert sched.pool.assignment("b") is None
+    assert em.registry.counter("dtx_experiment_early_stops_total").get() == 1
+    # the leader is never early-stopped, scores notwithstanding
+    assert sched.job("a").state == RUNNING
+
+
+def test_watcher_retries_unready_endpoint_next_tick():
+    calls = []
+
+    sched, backend, _ = make_sched(slices=("s0",))
+    sched.add_job("a", {"parameters": ANY})
+    sched.tick()
+
+    def score(job, step):
+        calls.append(step)
+        return None if len(calls) == 1 else 42.0
+
+    w = ContinuousScoringWatcher(sched, lambda j: [1], score)
+    assert w.tick() == []  # endpoint not ready: skipped, NOT marked scored
+    events = w.tick()
+    assert events[0]["score"] == 42.0
+    assert calls == [1, 1]
+
+
+# ------------------------------------------------- scoring-controller bridge
+def test_scoring_cr_bridge_drives_existing_controller():
+    """scoring_cr_score creates a Scoring CR and reconciles it through the
+    EXISTING ScoringController against a live /chat/completions endpoint —
+    the generative-eval path the continuous watcher uses in production."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from datatunerx_tpu.operator.store import ObjectStore
+    from datatunerx_tpu.scoring.controller import ScoringController
+
+    class Chat(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.dumps({"choices": [{"message": {
+                "role": "assistant", "content": "Paris"}}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Chat)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/chat/completions"
+        store = ObjectStore()
+        score = scoring_cr_score(
+            store, ScoringController(timeout=5.0), "exp-a-step1", url,
+            probes=[{"prompt": "Capital of France?", "reference": "Paris"}])
+        assert score == 100.0
+    finally:
+        srv.shutdown()
+
+
+def test_poll_interval_resolved_at_construction(monkeypatch):
+    """DTX_EXPERIMENT_POLL_S is read when the controller is BUILT, not at
+    import — operators/tests override it without a module reload."""
+    from datatunerx_tpu.operator.finetuneexperiment_controller import (
+        FinetuneExperimentController,
+    )
+
+    monkeypatch.setenv("DTX_EXPERIMENT_POLL_S", "0.321")
+    assert FinetuneExperimentController().poll_s == pytest.approx(0.321)
+    monkeypatch.delenv("DTX_EXPERIMENT_POLL_S")
+    assert FinetuneExperimentController().poll_s == 5.0
+    assert FinetuneExperimentController(poll_s=1.5).poll_s == 1.5

@@ -145,6 +145,45 @@ def test_push_remote_write_unreachable_never_raises():
     assert push_remote_write("http://127.0.0.1:1", {"m": 1.0}, {}, timeout=0.2) is False
 
 
+def test_prefetch_advisory_fires_once_on_sustained_stalls(tmp_path, capsys,
+                                                          monkeypatch):
+    """ROADMAP "input-path stragglers" first slice: sustained
+    pipe_step_wait_ms p95 over the threshold logs ONE suggested
+    --prefetch_depth (double the current) and states it in the registry."""
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_RECORDS", "5")
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_MS", "5.0")
+    lg = MetricsLogger(str(tmp_path), total_steps=100, prefetch_depth=2)
+    for step in range(1, 5):
+        lg.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 50.0})
+    assert lg.prefetch_advisory is None  # not enough evidence yet
+    lg.log_train(5, {"loss": 1.0, "pipe_step_wait_ms": 50.0})
+    adv = lg.prefetch_advisory
+    assert adv is not None
+    assert adv["suggested_prefetch_depth"] == 4 and adv["prefetch_depth"] == 2
+    assert adv["pipe_step_wait_ms_p95"] == 50.0
+    out = capsys.readouterr().out
+    assert out.count("[advice]") == 1
+    assert "--prefetch_depth 4" in out
+    # once per run: more stalled records never re-advise
+    for step in range(6, 12):
+        lg.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 80.0})
+    assert capsys.readouterr().out.count("[advice]") == 0
+    assert lg.registry.gauge("dtx_train_prefetch_depth_suggested").get() == 4
+
+
+def test_prefetch_advisory_quiet_on_healthy_pipeline(tmp_path, capsys,
+                                                     monkeypatch):
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_RECORDS", "5")
+    lg = MetricsLogger(str(tmp_path), total_steps=100, prefetch_depth=2)
+    for step in range(1, 20):
+        lg.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 0.2})
+    # synchronous runs (no pipeline) never see the signal at all
+    lg2 = MetricsLogger(str(tmp_path), total_steps=100)
+    lg2.log_train(1, {"loss": 1.0})
+    assert lg.prefetch_advisory is None and lg2.prefetch_advisory is None
+    assert "[advice]" not in capsys.readouterr().out
+
+
 def test_metrics_logger_jsonl(tmp_path):
     lg = MetricsLogger(str(tmp_path), total_steps=10)
     lg.log_train(5, {"loss": 2.0, "lr": 1e-4})
