@@ -196,10 +196,31 @@ def child_serve(preflight=None):
     paged = os.environ.get("DTX_BENCH_SERVE_PAGED", "1") != "0"
     block = int(os.environ.get("DTX_BENCH_BLOCK_SIZE", "16"))
     budget = int(os.environ.get("DTX_BENCH_PREFILL_BUDGET", "256"))
+    # adapter-churn mode: M synthetic tenant adapters rotate through a
+    # P-slot pool with M > P, so the run exercises load-on-miss + LRU
+    # eviction under mixed traffic and reports adapter hit rate + load
+    # latency next to tokens/s (the capacity story of the dynamic plane)
+    n_adapters = int(os.environ.get("DTX_BENCH_SERVE_ADAPTERS", "0"))
+    adapter_pool = int(os.environ.get(
+        "DTX_BENCH_ADAPTER_POOL", str(max(1, n_adapters // 2))))
+    adapter_names = []
+    adapter_ckpts = {}
+    tmpdir = None
+    if n_adapters > 0:
+        import tempfile
+
+        from datatunerx_tpu.serving.adapters import make_adapter_sweep
+
+        tmpdir = tempfile.mkdtemp(prefix="dtx-bench-adapters-")
+        adapter_ckpts = make_adapter_sweep(tmpdir, f"preset:{model}",
+                                           n_adapters)
+        adapter_names = sorted(adapter_ckpts)
     eng = BatchedEngine(
         f"preset:{model}", template="vanilla", max_seq_len=max_seq,
         slots=slots, decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK",
                                                      "8")),
+        adapters=adapter_ckpts or None,
+        adapter_pool=adapter_pool if n_adapters else 0,
         kv_block_size=block if paged else 0,
         prefill_token_budget=budget if paged else 0,
     )
@@ -234,9 +255,13 @@ def child_serve(preflight=None):
                 workload.append((short_ids, short_new)); si += 1
             if si % 2 == 0 and li < n_long:
                 workload.append((long_ids, long_new)); li += 1
-        for ids, max_new in workload:
+        for i, (ids, max_new) in enumerate(workload):
             t0 = time.perf_counter()
-            req = eng.submit(ids, max_new_tokens=max_new)
+            # churn mode: requests cycle the adapter population (every 4th
+            # stays on base) so residency is constantly contested
+            adapter = (adapter_names[i % len(adapter_names)]
+                       if adapter_names and i % 4 else "")
+            req = eng.submit(ids, max_new_tokens=max_new, adapter=adapter)
             th = threading.Thread(target=consume, args=(req, t0), daemon=True)
             th.start()
             threads.append(th)
@@ -255,7 +280,9 @@ def child_serve(preflight=None):
     pct = lambda xs, q: (xs[min(len(xs) - 1, int(q * len(xs)))]
                          if xs else 0.0)
     tag = (f"{model},slots{slots}," +
-           (f"paged,bs{block},budget{budget}" if paged else "dense"))
+           (f"paged,bs{block},budget{budget}" if paged else "dense") +
+           (f",adapters{n_adapters}/pool{adapter_pool}"
+            if n_adapters else ""))
     line = {
         "metric": f"serve_tokens_per_sec[{tag}]",
         "value": round(total_tokens / wall, 1) if wall > 0 else 0.0,
@@ -278,6 +305,19 @@ def child_serve(preflight=None):
             "prefill_stats": dict(eng.prefill_stats),
         },
     }
+    occ = eng.adapter_occupancy() if n_adapters else None
+    if occ is not None:
+        lookups = occ["hits"] + occ["misses"]
+        load_ms = sorted(occ.get("load_ms") or [])
+        line["serve"]["adapters"] = {
+            "count": n_adapters,
+            "pool_slots": occ["slots"],
+            "hit_rate": round(occ["hits"] / lookups, 3) if lookups else None,
+            "loads": occ["loads"],
+            "evictions": occ["evictions"],
+            "load_ms_p50": round(pct(load_ms, 0.5), 1),
+            "load_ms_p95": round(pct(load_ms, 0.95), 1),
+        }
     if preflight is not None:
         line["preflight"] = preflight
     print(json.dumps(line), flush=True)

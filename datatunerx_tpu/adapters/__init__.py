@@ -1,0 +1,33 @@
+"""Dynamic multi-adapter plane: pooled HBM adapter store + host registry.
+
+Adapters as DATA, not engine config (S-LoRA / Punica): the store owns a
+fixed-geometry device pool the decode program indexes per batch row, the
+registry loads/evicts/refcounts adapters at runtime — one compiled program
+serves any resident set with zero recompiles on load/unload. See
+``serving/batched_engine.py`` (adapter_pool mode), the serving server's
+``/admin/adapters`` plane, and the gateway's residency-aware routing.
+"""
+
+from datatunerx_tpu.adapters.registry import (
+    AdapterPinnedError,
+    AdapterRegistry,
+)
+from datatunerx_tpu.adapters.store import (
+    AdapterRankError,
+    AdapterStore,
+    AdapterTargetError,
+    adapter_rank,
+    hbm_bytes,
+    validate_adapter,
+)
+
+__all__ = [
+    "AdapterPinnedError",
+    "AdapterRankError",
+    "AdapterRegistry",
+    "AdapterStore",
+    "AdapterTargetError",
+    "adapter_rank",
+    "hbm_bytes",
+    "validate_adapter",
+]

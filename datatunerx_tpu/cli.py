@@ -214,6 +214,8 @@ def cmd_serve(args):
             "--quantization", args.quantization,
             "--slots", str(args.slots),
             "--adapters", args.adapters,
+            "--adapter_pool", str(args.adapter_pool),
+            "--adapter_rank_max", str(args.adapter_rank_max),
             "--kv_block_size", str(args.kv_block_size),
             "--kv_blocks", str(args.kv_blocks),
             "--prefill_token_budget", str(args.prefill_token_budget),
@@ -236,6 +238,8 @@ def cmd_serve(args):
         "--quantization", args.quantization,
         "--slots", str(args.slots),
         "--adapters", args.adapters,
+        "--adapter_pool", str(args.adapter_pool),
+        "--adapter_rank_max", str(args.adapter_rank_max),
         "--kv_block_size", str(args.kv_block_size),
         "--kv_blocks", str(args.kv_blocks),
         "--prefill_token_budget", str(args.prefill_token_budget),
@@ -377,6 +381,14 @@ def main(argv=None):
     vp.add_argument("--slots", type=int, default=4)
     vp.add_argument("--adapters", default="",
                     help="named LoRA adapters: name=ckpt[,name=ckpt…]")
+    vp.add_argument("--adapter_pool", type=int, default=0,
+                    help="dynamic multi-adapter pool: N HBM slots adapters "
+                         "load into at runtime (load-on-miss + LRU evict "
+                         "via POST/DELETE /admin/adapters; 0 = static "
+                         "--adapters stack)")
+    vp.add_argument("--adapter_rank_max", type=int, default=8,
+                    help="pool rank ceiling; lower ranks are zero-padded, "
+                         "higher ranks rejected")
     vp.add_argument("--kv_block_size", type=int, default=0,
                     help="paged KV cache block size in tokens (0 = dense)")
     vp.add_argument("--kv_blocks", type=int, default=0,

@@ -58,6 +58,10 @@ class PromotionConfig:
     max_error_rate: float = 0.05
     max_latency_ratio: float = 2.0  # canary p95 vs fleet p95
     min_fleet_requests: int = 5     # below this the latency guard abstains
+    # below this fleet p95 the latency guard also abstains: a RATIO of
+    # sub-millisecond p95s is scheduling noise, not a regression signal
+    # (in-process test fleets measure tens of µs — 2x jitter is routine)
+    min_fleet_p95_ms: float = 1.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "PromotionConfig":
@@ -73,7 +77,8 @@ class PromotionConfig:
         for k, attr in (("step_s", "step_s"),
                         ("min_requests", "min_requests"),
                         ("max_error_rate", "max_error_rate"),
-                        ("max_latency_ratio", "max_latency_ratio")):
+                        ("max_latency_ratio", "max_latency_ratio"),
+                        ("min_fleet_p95_ms", "min_fleet_p95_ms")):
             if d.get(k) is not None:
                 kw[attr] = type(getattr(cls, attr, 0.0))(d[k]) \
                     if not isinstance(d[k], bool) else d[k]
@@ -196,7 +201,8 @@ class PromotionController:
                     f"{self.config.max_error_rate:.2%} over "
                     f"{stats['requests']} requests")
         fleet_p95, fleet_reqs = self._fleet_p95()
-        if (fleet_reqs >= self.config.min_fleet_requests and fleet_p95 > 0
+        if (fleet_reqs >= self.config.min_fleet_requests
+                and fleet_p95 >= self.config.min_fleet_p95_ms
                 and stats["latency_p95_ms"]
                 > self.config.max_latency_ratio * fleet_p95):
             return (f"canary latency p95 {stats['latency_p95_ms']:.1f}ms > "

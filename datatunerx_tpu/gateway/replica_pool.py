@@ -40,6 +40,41 @@ class ReplicaError(Exception):
         self.status = status
 
 
+def _adapter_label(line: str, prefix: str) -> Optional[str]:
+    """Extract the adapter label value from a ``<prefix>adapter="x"} 1``
+    exposition line with value 1 (0 = series cleared, not a member).
+    Walks to the first UNESCAPED quote, undoing the exposition escapes
+    (obs.metrics.escape_label_value: \\\\, \\n, \\") as it goes — a tenant
+    name containing a quote must not truncate to the wrong name."""
+    if not line.startswith(prefix):
+        return None
+    rest = line[len(prefix):]
+    if not rest.startswith('adapter="'):
+        return None
+    s = rest[len('adapter="'):]
+    out: list = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+            continue
+        if c == '"':
+            break
+        out.append(c)
+        i += 1
+    else:
+        return None  # unterminated label value
+    try:
+        if float(s[i + 1:].rsplit(None, 1)[-1]) != 1:
+            return None
+    except (ValueError, IndexError):
+        return None
+    return "".join(out)
+
+
 def _client_error_message(e: BaseException) -> str:
     # KeyError.__str__ reprs its argument — unwrap so the 400 body reads
     # "unknown adapter 'x'", not "\"unknown adapter 'x'\""
@@ -156,10 +191,14 @@ class Replica:
 
     def stats(self) -> dict:
         """{"slots_busy": int, "slots_total": int, "kv_blocks_free": int,
-        "kv_blocks_total": int, "adapters": set|None}.
+        "kv_blocks_total": int, "adapters": set|None,
+        "resident_adapters": set|None}.
         kv_blocks_total 0 means the replica runs a dense cache (no block
         signal); adapters=None means unknown — the router treats it as
-        capable of anything (load-on-demand fallback)."""
+        capable of anything (load-on-demand fallback). resident_adapters
+        is the subset already materialised in the replica's pool (static
+        stacks: everything it knows) — the router's cache-locality
+        preference; None = no residency signal."""
         raise NotImplementedError
 
     def stats_snapshot(self) -> dict:
@@ -319,12 +358,21 @@ class InProcessReplica(Replica):
         busy = (sum(1 for r in slot_req if r is not None)
                 if slot_req is not None else 0)
         adapter_ids = getattr(self.engine, "adapter_ids", None)
+        # residency: dynamic pools report their live resident set; static
+        # stacks ARE resident (weights baked at startup), so everything the
+        # engine knows counts — the router's preference degrades gracefully
+        resident = getattr(self.engine, "resident_adapters", None)
+        if resident is not None:
+            resident = set(resident)
+        elif adapter_ids is not None:
+            resident = set(adapter_ids)
         return {
             "slots_busy": busy,
             "slots_total": getattr(self.engine, "slots", 0),
             "kv_blocks_free": getattr(self.engine, "free_kv_blocks", None) or 0,
             "kv_blocks_total": getattr(self.engine, "total_kv_blocks", None) or 0,
             "adapters": set(adapter_ids) if adapter_ids is not None else None,
+            "resident_adapters": resident,
         }
 
     def close(self):
@@ -473,7 +521,8 @@ class HTTPReplica(Replica):
                 and now - self._stats_at < self.stats_ttl_s):
             return self._stats_cache
         out = {"slots_busy": 0, "slots_total": 0,
-               "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None}
+               "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
+               "resident_adapters": None}
         try:
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
@@ -490,6 +539,19 @@ class HTTPReplica(Replica):
                     elif line.startswith(("dtx_serving_kv_blocks_capacity ",
                                           "dtx_serving_kv_blocks_total ")):
                         out["kv_blocks_total"] = int(float(line.split()[-1]))
+                    else:
+                        # residency/capability sets from the labeled gauges
+                        # (absent series = no signal, stays None)
+                        for prefix, key in (
+                                ("dtx_serving_adapter_resident{",
+                                 "resident_adapters"),
+                                ("dtx_serving_adapter_registered{",
+                                 "adapters")):
+                            name = _adapter_label(line, prefix)
+                            if name is not None:
+                                if out[key] is None:
+                                    out[key] = set()
+                                out[key].add(name)
         except Exception:  # noqa: BLE001 — stats are advisory
             pass
         self._stats_cache = out
@@ -502,7 +564,8 @@ class HTTPReplica(Replica):
         if self._stats_cache is not None:
             return self._stats_cache
         return {"slots_busy": 0, "slots_total": 0,
-                "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None}
+                "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
+                "resident_adapters": None}
 
 
 class ReplicaPool:

@@ -3,9 +3,14 @@
 Selection pipeline (every policy):
 
   1. candidates = healthy ∩ not-draining ∩ circuit-allows
-  2. adapter awareness: replicas that report the requested LoRA adapter
-     loaded win; if none report it (or stats are unknown), fall back to all
-     candidates — the engine loads on demand / 400s an unknown name.
+  2. adapter RESIDENCY preference (cache-locality, not a hard filter):
+     replicas whose adapter pool already holds the requested LoRA adapter
+     win (no load latency); otherwise any replica that KNOWS the adapter
+     (static stack or registered-for-load-on-miss) — routing there makes
+     the engine load it at admission, and the replica becomes the
+     preferred target for the adapter's next requests; if nothing reports
+     the adapter (or stats are unknown), fall back to all candidates —
+     the engine loads on demand / 400s an unknown name.
   3. session affinity: a request carrying a session key sticks to the
      replica that served the session before (its prefix cache holds the
      conversation's KV rows, so re-prefill becomes a suffix extension) —
@@ -64,12 +69,25 @@ class Router:
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
         self._affinity_capacity = affinity_capacity
         self._lock = threading.Lock()
+        # adapter-routing outcomes (gateway /metrics): how often the
+        # residency preference paid off vs forced a load-on-miss
+        self.adapter_routes = {"resident": 0, "load_miss": 0, "blind": 0}
+        # adapter -> routed count. Only adapters some replica actually
+        # reports (non-blind) are counted, and the key set is capped:
+        # the 'model' field is client-controlled, and every key becomes a
+        # Prometheus series — unvalidated names must not grow either
+        # without bound.
+        self.adapter_requests: dict = {}
+        self._adapter_requests_cap = 1024
 
     def route(self, messages: Optional[List[dict]] = None,
               adapter: str = "", session_id: Optional[str] = None,
-              exclude: Optional[set] = None) -> Replica:
+              exclude: Optional[set] = None, on_event=None) -> Replica:
         """Pick a replica. ``exclude`` names replicas already tried for this
-        request (failover must not retry the replica that just died)."""
+        request (failover must not retry the replica that just died).
+        ``on_event(name, **detail)`` receives routing decisions — the
+        gateway wires it to the request's trace span so adapter
+        residency/load-miss outcomes land in GET /debug/trace/<id>."""
         exclude = exclude or set()
         candidates = [r for r in self.pool.available()
                       if r.name not in exclude]
@@ -85,12 +103,8 @@ class Router:
                 f"excluded={sorted(exclude)})")
 
         if adapter:
-            with_adapter = []
-            for r in candidates:
-                adapters = r.stats().get("adapters")
-                if adapters is None or adapter in adapters:
-                    with_adapter.append(r)
-            candidates = with_adapter or candidates
+            candidates = self._adapter_candidates(adapter, candidates,
+                                                  on_event)
 
         key = session_key(messages or [], session_id)
         if key:
@@ -106,6 +120,50 @@ class Router:
         if key:
             self._touch(key, chosen.name)
         return chosen
+
+    def _adapter_candidates(self, adapter: str,
+                            candidates: List[Replica], on_event) -> list:
+        """Narrow candidates by adapter CACHE LOCALITY: resident replicas
+        first (the request decodes immediately), else replicas that can
+        load-on-miss (static stack or registered in their pool — routing
+        there warms the adapter for its next requests), else everyone (no
+        signal; the engine answers authoritatively). Never a hard filter:
+        an adapter nowhere resident still gets served."""
+        resident_set: List[Replica] = []
+        capable: List[Replica] = []
+        no_signal: List[Replica] = []
+        for r in candidates:
+            st = r.stats()
+            res = st.get("resident_adapters")
+            known = st.get("adapters")
+            if res is not None and adapter in res:
+                resident_set.append(r)
+            if known is None:
+                # stats unknown (scrape failed / pre-first-fetch): not
+                # evidence the replica must load — counting it as a
+                # load_miss would report missing stats as cold adapters
+                no_signal.append(r)
+            elif adapter in known:
+                capable.append(r)
+        if resident_set:
+            outcome, picked = "resident", resident_set
+        elif capable:
+            outcome, picked = "load_miss", capable
+        else:
+            outcome, picked = "blind", no_signal or candidates
+        with self._lock:
+            self.adapter_routes[outcome] += 1
+            if outcome != "blind" and (
+                    adapter in self.adapter_requests
+                    or len(self.adapter_requests)
+                    < self._adapter_requests_cap):
+                self.adapter_requests[adapter] = \
+                    self.adapter_requests.get(adapter, 0) + 1
+        if on_event is not None:
+            on_event("adapter_route", adapter=adapter, outcome=outcome,
+                     resident=[r.name for r in resident_set],
+                     candidates=len(picked))
+        return picked
 
     def _pick(self, candidates: List[Replica]) -> Replica:
         weights = {r.name: max(0.0, getattr(r, "weight", 1.0))

@@ -109,9 +109,11 @@ class Gateway:
             return ""
         return adapter
 
-    def _route(self, messages, adapter, session_id, tried) -> Replica:
+    def _route(self, messages, adapter, session_id, tried,
+               on_event=None) -> Replica:
         return self.router.route(messages=messages, adapter=adapter,
-                                 session_id=session_id, exclude=tried)
+                                 session_id=session_id, exclude=tried,
+                                 on_event=on_event)
 
     def _replica_failed(self, replica: Replica):
         replica.breaker.record_failure()
@@ -156,7 +158,7 @@ class Gateway:
                 last: Optional[Exception] = None
                 for attempt in range(self.max_attempts):
                     replica = self._route(messages, adapter, session_id,
-                                          tried)
+                                          tried, on_event=root.event)
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -216,7 +218,7 @@ class Gateway:
                 tried: set = set()
                 for attempt in range(self.max_attempts):
                     replica = self._route(messages, adapter, session_id,
-                                          tried)
+                                          tried, on_event=root.event)
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -410,12 +412,36 @@ class Gateway:
             "Routed attempts per replica by outcome (ok/error) — the "
             "promotion guard's error-rate source, restated at scrape "
             "time from the per-replica outcome windows.")
+        # adapter plane: residency-preference routing outcomes + per-adapter
+        # demand (restated from the router's counters at scrape time)
+        a_routes = self.registry.counter(
+            "dtx_gateway_adapter_routes_total",
+            "Adapter-request routing outcomes: resident = cache-locality "
+            "hit, load_miss = routed to a replica that must load-on-miss, "
+            "blind = no replica reported the adapter.")
+        a_reqs = self.registry.counter(
+            "dtx_gateway_adapter_requests_total",
+            "Requests routed per adapter name.")
+        a_resident = g("dtx_gateway_adapter_resident_replicas",
+                       "Replicas whose pool currently holds each adapter "
+                       "(from replica stats snapshots).")
         circuit.clear()
         up.clear()
         busy.clear()
         blocks_free.clear()
         weight.clear()
         attempts.clear()
+        a_routes.clear()
+        a_reqs.clear()
+        a_resident.clear()
+        with self.router._lock:
+            routes = dict(self.router.adapter_routes)
+            per_adapter = dict(self.router.adapter_requests)
+        for outcome, n in sorted(routes.items()):
+            a_routes.set(n, {"outcome": outcome})
+        for name, n in sorted(per_adapter.items()):
+            a_reqs.set(n, {"adapter": name})
+        residency: dict = {}
         for r in self.pool.replicas():
             state = r.breaker.state
             for s in ("closed", "half_open", "open"):
@@ -432,6 +458,9 @@ class Gateway:
             if st.get("kv_blocks_total"):
                 blocks_free.set(st.get("kv_blocks_free", 0),
                                 {"replica": r.name})
+            for a in st.get("resident_adapters") or ():
+                if a:
+                    residency[a] = residency.get(a, 0) + 1
             weight.set(round(getattr(r, "weight", 1.0), 6),
                        {"replica": r.name})
             out = r.outcome_stats()
@@ -439,6 +468,8 @@ class Gateway:
                          {"replica": r.name, "outcome": "ok"})
             attempts.set(out["errors"],
                          {"replica": r.name, "outcome": "error"})
+        for a, n in sorted(residency.items()):
+            a_resident.set(n, {"adapter": a})
         return self.registry.expose()
 
     # ------------------------------------------------------------ promotion
@@ -678,6 +709,10 @@ def make_handler(gw: Gateway):
 
         def _json(self, code: int, payload: dict, trace_id: str = "",
                   extra_headers: Optional[dict] = None):
+            # count BEFORE the body goes out: a client that scrapes
+            # /metrics the instant its response arrives must see its own
+            # request counted (the code is already terminal here)
+            self.gateway.record_request(code)
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -688,7 +723,6 @@ def make_handler(gw: Gateway):
                 self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
-            self.gateway.record_request(code)
 
         # -------------------------------------------------------------- GET
         def do_GET(self):
@@ -972,6 +1006,9 @@ def main(argv=None):
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--decode_chunk", type=int, default=8)
     p.add_argument("--adapters", default="")
+    p.add_argument("--adapter_pool", type=int, default=0)
+    p.add_argument("--adapter_rank_max", type=int, default=8)
+    p.add_argument("--adapter_targets", default="")
     p.add_argument("--kv_quant", default="")
     p.add_argument("--prefix_cache", type=int, default=0)
     p.add_argument("--kv_block_size", type=int, default=0)
@@ -1019,6 +1056,9 @@ def main(argv=None):
                        "--slots", str(args.slots),
                        "--decode_chunk", str(args.decode_chunk),
                        "--adapters", args.adapters,
+                       "--adapter_pool", str(args.adapter_pool),
+                       "--adapter_rank_max", str(args.adapter_rank_max),
+                       "--adapter_targets", args.adapter_targets,
                        "--kv_quant", args.kv_quant,
                        "--prefix_cache", str(args.prefix_cache),
                        "--kv_block_size", str(args.kv_block_size),

@@ -229,6 +229,19 @@ def serving_latency_histograms(
     )
 
 
+def adapter_load_histogram(registry: Registry) -> Histogram:
+    """The adapter plane's load-latency histogram (checkpoint read +
+    pad + pool insert on a load-on-miss), declared once here for the same
+    reason as ``serving_latency_histograms``: the engine's registry
+    observer and the serving server's scrape-time pre-declaration must
+    share one object."""
+    return registry.histogram(
+        "dtx_serving_adapter_load_ms",
+        "Wall time to materialise an adapter into a pool slot "
+        "(checkpoint load + rank-pad + device insert) on a load-on-miss.",
+        buckets=MS_BUCKETS)
+
+
 # ------------------------------------------------------------ process plumbing
 
 _PROCESS_START = time.monotonic()

@@ -83,6 +83,11 @@ SPECS = {
             "quantization": {"type": "string",
                              "enum": ["", "int8", "int4", "nf4"]},
             "slots": INT,
+            # dynamic multi-adapter plane (serving --adapter_pool /
+            # --adapter_rank_max): N HBM pool slots tenant adapters load
+            # into at runtime via /admin/adapters, rank-padded to the max
+            "adapterPool": INT,
+            "adapterRankMax": INT,
             # gateway tier (gateway/server.py): N replicas behind one
             # endpoint with routing/admission/failover; min/max bound the
             # autoscale hint the controller applies

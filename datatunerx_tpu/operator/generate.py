@@ -228,6 +228,10 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         # continuous-batching slot count (serving/server.py --slots; 1 =
         # single-request engine); TPU addition to ServeConfig
         "slots": serve_cfg.get("slots"),
+        # dynamic multi-adapter pool (serving --adapter_pool /
+        # --adapter_rank_max + /admin/adapters): adapters as runtime data
+        "adapter_pool": serve_cfg.get("adapterPool"),
+        "adapter_rank_max": serve_cfg.get("adapterRankMax"),
         # multi-replica serving behind the inference gateway
         # (gateway/server.py, replaces the reference's Ray Serve tier):
         # replicas > 1 or gateway=true puts the gateway in front

@@ -122,11 +122,16 @@ def validate_finetunejob(obj: CustomResource):
 
 def _validate_serve_config(cfg: dict):
     _require(isinstance(cfg, dict), "serveConfig must be an object")
-    for key in ("replicas", "minReplicas", "maxReplicas", "slots"):
+    for key in ("replicas", "minReplicas", "maxReplicas", "slots",
+                "adapterPool", "adapterRankMax"):
         if cfg.get(key) is not None:
             v = _num(cfg[key], f"serveConfig.{key}")
             _require(v >= 1 and float(v).is_integer(),
                      f"serveConfig.{key} must be a positive integer")
+    if cfg.get("adapterRankMax") is not None:
+        _require(cfg.get("adapterPool") is not None,
+                 "serveConfig.adapterRankMax requires adapterPool (the "
+                 "rank ceiling only shapes a dynamic pool)")
     lo = int(float(cfg.get("minReplicas", 1) or 1))
     hi = cfg.get("maxReplicas")
     if hi is not None:

@@ -39,3 +39,23 @@ def make_adapter_checkpoint(path: str, model: str, seed: int,
     mngr.maybe_save({"lora": {"layers": layers}}, step=1, force=True)
     mngr.close()
     return path
+
+
+def make_adapter_sweep(base_path: str, model: str, count: int,
+                       ranks=(2, 4, 8), targets=("q_proj", "v_proj"),
+                       seed: int = 0) -> dict:
+    """``count`` synthetic adapters cycling through ``ranks`` — the
+    mixed-rank tenant population the pooled AdapterStore rank-pads (tests)
+    and the adapter-churn serve bench rotates through. Returns
+    {name: checkpoint_path}; names are ``ad<i>-r<rank>`` so a failure
+    message states the rank that produced it."""
+    import os
+
+    out = {}
+    for i in range(count):
+        rank = ranks[i % len(ranks)]
+        name = f"ad{i}-r{rank}"
+        out[name] = make_adapter_checkpoint(
+            os.path.join(base_path, name), model, seed=seed + i,
+            rank=rank, targets=targets)
+    return out

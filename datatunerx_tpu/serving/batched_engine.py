@@ -45,7 +45,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from datatunerx_tpu.data.templates import Template, get_template
-from datatunerx_tpu.obs.metrics import Registry, serving_latency_histograms
+from datatunerx_tpu.obs.metrics import (
+    Registry,
+    adapter_load_histogram,
+    serving_latency_histograms,
+)
 from datatunerx_tpu.obs.trace import TraceStore, build_request_span
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.models.lora import LORA_TARGETS, lora_scaling
@@ -90,48 +94,68 @@ class _PrefixCache:
         # adapter -> trie root; node = [children {tok: node}, terminal key]
         self._roots: Dict[int, list] = {}
         self.evictions = 0
+        # the scheduler thread is the lookup/insert path, but the dynamic
+        # adapter plane invalidates from admin HTTP threads (drop_adapter
+        # on unload/rebind) — the lock keeps the dict+trie consistent;
+        # host-side dict work, negligible next to any device call
+        self._lock = threading.Lock()
 
     def __len__(self):
-        return len(self._d)
+        with self._lock:
+            return len(self._d)
 
     def get(self, key):
-        ent = self._d.get(key)
-        if ent is not None:
-            self._d.move_to_end(key)
-        return ent
+        with self._lock:
+            ent = self._d.get(key)
+            if ent is not None:
+                self._d.move_to_end(key)
+            return ent
 
     def longest_prefix(self, tokens: tuple, adapter: int):
         """Longest stored strict prefix of ``tokens`` for this adapter —
         one trie descent, deepest terminal wins."""
-        node = self._roots.get(adapter)
-        if node is None:
-            return None, None
-        best_key = None
-        for i in range(len(tokens) - 1):  # strict: depth < len(tokens)
-            node = node[0].get(tokens[i])
+        with self._lock:
+            node = self._roots.get(adapter)
             if node is None:
-                break
-            if node[1] is not None:
-                best_key = node[1]
-        if best_key is None:
-            return None, None
-        self._d.move_to_end(best_key)
-        return best_key, self._d[best_key]
+                return None, None
+            best_key = None
+            for i in range(len(tokens) - 1):  # strict: depth < len(tokens)
+                node = node[0].get(tokens[i])
+                if node is None:
+                    break
+                if node[1] is not None:
+                    best_key = node[1]
+            if best_key is None:
+                return None, None
+            self._d.move_to_end(best_key)
+            return best_key, self._d[best_key]
 
     def put(self, key, ent):
-        is_new = key not in self._d
-        self._d[key] = ent
-        self._d.move_to_end(key)
-        if is_new:
-            ptoks, adapter = key
-            node = self._roots.setdefault(adapter, [{}, None])
-            for t in ptoks:
-                node = node[0].setdefault(t, [{}, None])
-            node[1] = key
-        while len(self._d) > self.capacity:
-            old_key, _ = self._d.popitem(last=False)
-            self._trie_remove(old_key)
-            self.evictions += 1
+        with self._lock:
+            is_new = key not in self._d
+            self._d[key] = ent
+            self._d.move_to_end(key)
+            if is_new:
+                ptoks, adapter = key
+                node = self._roots.setdefault(adapter, [{}, None])
+                for t in ptoks:
+                    node = node[0].setdefault(t, [{}, None])
+                node[1] = key
+            while len(self._d) > self.capacity:
+                old_key, _ = self._d.popitem(last=False)
+                self._trie_remove(old_key)
+                self.evictions += 1
+
+    def drop_adapter(self, adapter):
+        """Invalidate every entry cached under one adapter identity —
+        required when an adapter NAME is rebound to different weights
+        (unload / re-register): cached KV rows were computed with the old
+        weights and would silently poison the new binding. Called from
+        admin threads; the lock covers the scheduler's concurrent use."""
+        with self._lock:
+            for key in [k for k in self._d if k[1] == adapter]:
+                del self._d[key]
+                self._trie_remove(key)
 
     def _trie_remove(self, key):
         ptoks, adapter = key
@@ -160,14 +184,24 @@ class Request:
     def __init__(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
                  stop_ids: Sequence[int], adapter: int,
-                 trace_id: str = ""):
+                 adapter_name: str = "", trace_id: str = ""):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_p = top_p
         self.seed = seed
         self.stop_ids = list(stop_ids)[:MAX_STOP]
+        # device pool/stack index; in dynamic mode -1 until admission
+        # resolves (and pins) the NAME to a pool slot via the registry
         self.adapter = adapter
+        self.adapter_name = adapter_name
+        # residency at FIRST admission attempt (None until then) — the
+        # trace's loaded flag must reflect whether this request paid the
+        # load, not the state after its own load completed
+        self.adapter_was_resident: Optional[bool] = None
+        # hit/miss stats latch: a readmission retry (pin released on
+        # KV-block exhaustion) must not re-count this request's lookup
+        self.adapter_stats_counted = False
         self.tokens: List[int] = []
         self.stream: "queue.Queue[Optional[int]]" = queue.Queue()
         self.done = threading.Event()
@@ -238,15 +272,16 @@ _PROGRAM_MEMO: "collections.OrderedDict" = collections.OrderedDict()
 _PROGRAM_MEMO_MAX = 8
 
 
-def _program_memo_key(cfg, max_seq_len: int, kv_quant, named_adapters):
+def _program_memo_key(cfg, max_seq_len: int, kv_quant):
     """Hashable identity of the engine's traced programs, or None when it
     can't be established (exotic values → compile fresh). The dataclass repr
-    covers every model-config field deterministically; the adapter mapping is
-    order-sensitive because load order fixes the name→stack-index binding the
-    closure constants encode."""
+    covers every model-config field deterministically. Adapters are NOT part
+    of the key: LoRA weights (a stacked tree or the dynamic pool) enter the
+    programs as ARGUMENTS, so jax's own executable cache keys on their
+    shapes — any adapter set with the same geometry shares one compiled
+    program, and loading/unloading a pool adapter recompiles nothing."""
     try:
-        return (repr(cfg), int(max_seq_len), kv_quant,
-                tuple(named_adapters.items()))
+        return (repr(cfg), int(max_seq_len), kv_quant)
     except Exception:  # noqa: BLE001 — memoization is best-effort
         return None
 
@@ -254,17 +289,22 @@ def _program_memo_key(cfg, max_seq_len: int, kv_quant, named_adapters):
 class _Programs:
     """The engine's jitted device programs, factored OFF the engine so the
     process-wide memo pins only what tracing actually reads — the model
-    config, two cache-geometry scalars, and the (small) LoRA adapter stack —
-    never a donor engine's full params or live KV pool. Everything else
-    (params, cache, per-slot decode state) arrives as an argument, which is
-    what makes the programs shareable across engines in the first place."""
+    config and two cache-geometry scalars — never a donor engine's full
+    params, live KV pool, or adapter weights. Everything else (params,
+    cache, the LoRA stack/pool, per-slot decode state) arrives as an
+    argument, which is what makes the programs shareable across engines in
+    the first place.
 
-    def __init__(self, cfg, max_seq_len: int, kv_quant,
-                 lora_stack: Optional[tuple]):
+    ``lora`` is ``None`` (base-only engine) or ``(tree, scales)`` with
+    stacked ``[L, E, …]`` leaves; None-vs-tuple is pytree STRUCTURE, so jax
+    compiles the two cases separately and, within the adapter case, per
+    leaf shape — mutating pool contents in place (same shapes) hits the
+    same executable."""
+
+    def __init__(self, cfg, max_seq_len: int, kv_quant):
         self.cfg = cfg
         self.max_seq_len = max_seq_len
         self.kv_quant = kv_quant
-        self.lora_stack = lora_stack
         self.prefill = jax.jit(self._prefill_impl,
                                static_argnames=("prompt_len",))
         self.extend = jax.jit(self._extend_impl,
@@ -277,36 +317,30 @@ class _Programs:
         self.extract = jax.jit(paged_extract_row)
         self.decode = jax.jit(self._decode_impl, static_argnames=("K",))
 
-    def _lora_args(self):
-        if self.lora_stack is None:
-            return {"lora": None}
-        tree, scales = self.lora_stack
-        return {"lora": (tree, scales)}
-
-    def _prefill_impl(self, params, tokens, mask, positions, adapter_idx, *,
-                      prompt_len: int):
+    def _prefill_impl(self, params, lora, tokens, mask, positions,
+                      adapter_idx, *, prompt_len: int):
         cache = init_cache(self.cfg, 1, self.max_seq_len, dtype=jnp.bfloat16,
                            quantize=self.kv_quant)
         logits, cache = forward(
             params, tokens, self.cfg, positions=positions,
-            attention_mask=mask, cache=cache,
+            attention_mask=mask, cache=cache, lora=lora,
             lora_adapter_idx=(adapter_idx[None]
-                              if self.lora_stack is not None else None),
-            compute_dtype=jnp.bfloat16, **self._lora_args(),
+                              if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
         )
         return logits[0, prompt_len - 1], cache
 
-    def _extend_impl(self, params, row_cache, tokens, mask, positions,
+    def _extend_impl(self, params, lora, row_cache, tokens, mask, positions,
                      adapter_idx, *, suffix_len: int):
         """Append a (left-pad-bucketed) prompt suffix onto a cached prefix
         row: pads get sentinel rope positions so only the real tokens exist
         for attention, exactly as in full prefill."""
         logits, cache = forward(
             params, tokens, self.cfg, positions=positions,
-            attention_mask=mask, cache=row_cache,
+            attention_mask=mask, cache=row_cache, lora=lora,
             lora_adapter_idx=(adapter_idx[None]
-                              if self.lora_stack is not None else None),
-            compute_dtype=jnp.bfloat16, **self._lora_args(),
+                              if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
         )
         return logits[0, suffix_len - 1], cache
 
@@ -382,7 +416,7 @@ class _Programs:
             rng.at[slot].set(jax.random.PRNGKey(seed)),
         )
 
-    def _prefill_chunk_impl(self, params, cache, slot, tokens, mask,
+    def _prefill_chunk_impl(self, params, lora, cache, slot, tokens, mask,
                             positions, adapter_idx, *, chunk_len: int):
         """One ``chunk_len``-token prefill program writing straight into one
         slot's blocks of the SHARED pool — the chunk-bounded generalisation of
@@ -395,10 +429,10 @@ class _Programs:
             cache["block_tables"], (slot, 0), (1, nbps))
         logits, new = forward(
             params, tokens, self.cfg, positions=positions,
-            attention_mask=mask, cache=view,
+            attention_mask=mask, cache=view, lora=lora,
             lora_adapter_idx=(adapter_idx[None]
-                              if self.lora_stack is not None else None),
-            compute_dtype=jnp.bfloat16, **self._lora_args(),
+                              if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
         )
         out = dict(cache)
         for key in ("k", "v", "k_scale", "v_scale"):
@@ -409,10 +443,9 @@ class _Programs:
             cache["len"], new["len"], (slot,))
         return logits[0, chunk_len - 1], out
 
-    def _decode_impl(self, params, cache, logits, pos, remaining, active, rng,
-                     temps, top_ps, stops, adapter_idx, *, K: int):
-        lora_kw = self._lora_args()
-
+    def _decode_impl(self, params, lora, cache, logits, pos, remaining,
+                     active, rng, temps, top_ps, stops, adapter_idx, *,
+                     K: int):
         def step(carry, _):
             logits, cache, pos, remaining, active, rng = carry
             split = jax.vmap(jax.random.split)(rng)
@@ -429,9 +462,10 @@ class _Programs:
             logits2, cache = forward(
                 params, tok, self.cfg, positions=pos[:, None],
                 attention_mask=emit[:, None].astype(jnp.int32), cache=cache,
+                lora=lora,
                 lora_adapter_idx=(adapter_idx
-                                  if self.lora_stack is not None else None),
-                compute_dtype=jnp.bfloat16, **lora_kw,
+                                  if lora is not None else None),
+                compute_dtype=jnp.bfloat16,
             )
             # forward advances every cursor; only emitting slots really moved
             cache = dict(cache)
@@ -451,6 +485,9 @@ class BatchedEngine:
         model_path: str,
         checkpoint_path: Optional[str] = None,
         adapters: Optional[Dict[str, str]] = None,  # name -> checkpoint path
+        adapter_pool: int = 0,  # >0: dynamic pooled-adapter mode (P slots)
+        adapter_rank_max: int = 8,  # pool rank ceiling (ranks < are padded)
+        adapter_targets: Optional[Sequence[str]] = None,  # pool target set
         template: str = "llama2",
         max_seq_len: int = 1024,
         slots: int = 4,
@@ -489,10 +526,42 @@ class BatchedEngine:
                 named.setdefault("default", checkpoint_path)
             elif state.get("params"):
                 self.params = jax.device_put(state["params"])
-        self.adapter_ids: Dict[str, int] = {"": 0}  # 0 = base (zero adapter)
+        self._static_adapter_ids: Dict[str, int] = {"": 0}  # 0 = base
         self.lora_stack: Optional[tuple] = None
-        if named:
+        # dynamic pooled mode (adapter_pool > 0): adapters are DATA — a
+        # fixed-geometry device pool + host registry with load-on-miss /
+        # LRU eviction / refcount pinning (datatunerx_tpu/adapters/).
+        # Constructor adapters are registered lazily; the first request (or
+        # an /admin/adapters preload) materialises them into pool slots.
+        self.adapter_registry = None
+        self.adapter_store = None
+        if adapter_pool > 0:
+            from datatunerx_tpu.adapters import AdapterRegistry, AdapterStore
+            from datatunerx_tpu.models.lora import DEFAULT_TARGETS
+
+            self.adapter_store = AdapterStore(
+                self.cfg, pool_slots=int(adapter_pool),
+                rank_max=int(adapter_rank_max) or 8,
+                targets=tuple(adapter_targets or DEFAULT_TARGETS))
+            self.adapter_registry = AdapterRegistry(
+                self.adapter_store,
+                # lazy closures: both attributes exist before any load runs
+                load_observer=lambda ms: self._h_adapter_load.observe(ms),
+                # an async load resolving wakes the scheduler so the
+                # FIFO-head admits immediately instead of on the next poll
+                on_load_done=lambda: self._wake.set())
+            for aname, path in named.items():
+                self.adapter_registry.register(aname, path)
+        elif named:
             self._build_adapter_stack(named)
+        # per-adapter request counters (dtx_serving_adapter_requests_total).
+        # Capped, and pruned on unload: every key becomes a Prometheus
+        # series, and tenant churn over weeks must not grow the exposition
+        # without bound (names here passed submit's membership check, but
+        # the registered population itself churns unboundedly).
+        self._adapter_req_lock = threading.Lock()
+        self.adapter_requests: Dict[str, int] = {}
+        self._adapter_requests_cap = 1024
 
         self.kv_quant = kv_quant or None
         self.paged = kv_block_size > 0
@@ -543,12 +612,21 @@ class BatchedEngine:
 
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        # dynamic mode: the adapter NAME each slot pins (released with the
+        # slot, so LRU eviction can never pull weights out from under an
+        # in-flight decode)
+        self._slot_adapter: List[Optional[str]] = [None] * slots
         self._decode_ready: List[bool] = [False] * slots
         # slot → in-progress chunked-prefill state, in admission order
         self._pending: "collections.OrderedDict[int, dict]" = \
             collections.OrderedDict()
         self._waiting: "queue.Queue[Request]" = queue.Queue()
-        self._waiting_head: Optional[Request] = None  # block-starved FIFO head
+        # requests that must admit BEFORE anything in _waiting (FIFO order
+        # preserved): the block-starved head, and adapter-loading requests
+        # parked while their checkpoint reads run on loader threads
+        self._waiting_front: "collections.deque[Request]" = collections.deque()
+        self._last_adapter_wait: Optional[str] = None  # wait-trace dedupe
+        self._admit_wait_reason = ""  # why the last _admit returned False
         self._wake = threading.Event()
         self._shutdown = threading.Event()
         # scheduler-tick trace, for tests and TTFT/TPOT forensics:
@@ -559,25 +637,23 @@ class BatchedEngine:
 
         # Process-wide program memo (the Trainer step-memo pattern,
         # training/train_lib.py): engines built from an equal (model config,
-        # max_seq_len, kv_quant, adapter mapping) trace identical programs —
-        # everything else the jitted fns touch arrives as an argument, and
-        # dense/paged/slot-count variation lives in argument shapes jax
-        # already keys on — so they share one _Programs holder and with it
-        # jax's in-memory executable cache. Side-by-side paged/dense engines
-        # (parity tests, the serve bench's paged-vs-dense runs, blue/green
-        # replica swaps in one process) compile each program once instead of
-        # once per engine; doubly important on jax 0.4.x where the
-        # persistent compile cache is unusable (tests/conftest.py).
-        # Adapter engines share only on an identical ordered name→checkpoint
-        # mapping: adapter weights enter the trace as closure constants, so
-        # the mapping IS the program identity (checkpoint contents are
-        # assumed stable within a process; the ORDER fixes name→index).
-        key = _program_memo_key(self.cfg, self.max_seq_len, self.kv_quant,
-                                named)
+        # max_seq_len, kv_quant) trace identical programs — everything else
+        # the jitted fns touch arrives as an argument, and dense/paged/
+        # slot-count/ADAPTER variation lives in argument shapes/structure
+        # jax already keys on — so they share one _Programs holder and with
+        # it jax's in-memory executable cache. Side-by-side paged/dense
+        # engines (parity tests, the serve bench's paged-vs-dense runs,
+        # blue/green replica swaps in one process) compile each program once
+        # instead of once per engine; doubly important on jax 0.4.x where
+        # the persistent compile cache is unusable (tests/conftest.py).
+        # Adapters no longer enter the key at all: the stacked tree / pool
+        # is a program ARGUMENT, so engines with any adapter mapping share
+        # programs, and the dynamic pool serves load/unload with ZERO
+        # recompiles (the geometry fixes every leaf shape up front).
+        key = _program_memo_key(self.cfg, self.max_seq_len, self.kv_quant)
         progs = None if key is None else _PROGRAM_MEMO.get(key)
         if progs is None:
-            progs = _Programs(self.cfg, self.max_seq_len, self.kv_quant,
-                              self.lora_stack)
+            progs = _Programs(self.cfg, self.max_seq_len, self.kv_quant)
             if key is not None:
                 _PROGRAM_MEMO[key] = progs
                 while len(_PROGRAM_MEMO) > _PROGRAM_MEMO_MAX:
@@ -603,6 +679,7 @@ class BatchedEngine:
         self.registry = registry or Registry()
         (self._h_ttft, self._h_tpot,
          self._h_prefill_chunk) = serving_latency_histograms(self.registry)
+        self._h_adapter_load = adapter_load_histogram(self.registry)
         # Per-request span timelines (the PR 5 sched_trace deque, promoted):
         # completed requests land in a bounded trace ring keyed by trace id,
         # served by GET /debug/trace/<id> on the serving server and merged
@@ -669,23 +746,116 @@ class BatchedEngine:
         scales = jnp.asarray([0.0] + [s for _, _, s in loaded], jnp.float32)
         self.lora_stack = ({"layers": stack}, scales)
         for e, (name, _, _) in enumerate(loaded, start=1):
-            self.adapter_ids[name] = e
+            self._static_adapter_ids[name] = e
 
-    def _lora_args(self):
-        if self.lora_stack is None:
-            return {"lora": None}
-        tree, scales = self.lora_stack
-        return {"lora": (tree, scales)}
+    @property
+    def adapter_ids(self) -> Dict[str, int]:
+        """Known adapter names → device index. Static mode: the fixed
+        stack's name→index binding. Dynamic mode: every REGISTERED name
+        (resident → its pool slot, loadable-on-miss → -1) — membership is
+        what the serving server and gateway check."""
+        if self.adapter_registry is not None:
+            return self.adapter_registry.id_map()
+        return self._static_adapter_ids
+
+    def _lora_arg(self):
+        """The programs' ``lora`` argument: None (base-only), the static
+        stacked tree, or the dynamic pool's atomically-republished
+        snapshot (one attribute read — no lock on the decode hot path)."""
+        if self.adapter_store is not None:
+            return self.adapter_store.tree
+        return self.lora_stack
+
+    # ---- dynamic pool control plane (serving /admin/adapters backs these)
+    def load_adapter(self, name: str, checkpoint_path: str,
+                     preload: bool = True) -> dict:
+        """Register (and by default warm) an adapter at runtime. Raises
+        NotImplementedError when the engine runs a static stack,
+        ValueError / AdapterRankError for a checkpoint the pool geometry
+        rejects, RuntimeError on transient pool exhaustion, and
+        AdapterPinnedError when re-registering a live name."""
+        if self.adapter_registry is None:
+            raise NotImplementedError(
+                "engine runs a static adapter stack; restart with "
+                "--adapter_pool to load adapters at runtime")
+        existed = name in self.adapter_registry.names()
+        if existed:
+            rebound = (self.adapter_registry.describe(name)["checkpoint"]
+                       != checkpoint_path)
+        self.adapter_registry.register(name, checkpoint_path)
+        if existed and rebound and self._prefix is not None:
+            # same name, different weights: cached rows are stale
+            self._prefix.drop_adapter(name)
+        if preload:
+            try:
+                self.adapter_registry.preload(name)
+            except (ValueError, FileNotFoundError):
+                # a bad CHECKPOINT must not stay registered (every later
+                # request would hit the same error at admission) — but
+                # only roll back a registration THIS call created;
+                # transient failures (pool exhausted) never unregister
+                if not existed:
+                    self.adapter_registry.unregister(name)
+                raise
+        return self.adapter_registry.describe(name)
+
+    def unload_adapter(self, name: str) -> bool:
+        """Evict + unregister. AdapterPinnedError while in-flight requests
+        still decode with it (the admin plane answers 409)."""
+        if self.adapter_registry is None:
+            raise NotImplementedError("engine runs a static adapter stack")
+        gone = self.adapter_registry.unregister(name)
+        if gone:
+            if self._prefix is not None:
+                # the name may be re-registered with different weights
+                # later — rows cached under it must not survive the
+                # unbinding
+                self._prefix.drop_adapter(name)
+            with self._adapter_req_lock:
+                # the tenant is gone; its counter series goes with it
+                self.adapter_requests.pop(name, None)
+        return gone
+
+    def adapter_occupancy(self) -> Optional[dict]:
+        """Pool occupancy + registry stats for stats()//metrics; None on
+        static/base engines (no pool to report)."""
+        if self.adapter_registry is None:
+            return None
+        occ = self.adapter_registry.occupancy()
+        occ["resident_adapters"] = sorted(self.adapter_registry.resident())
+        occ["registered_adapters"] = self.adapter_registry.names()
+        occ["load_ms"] = list(self.adapter_registry.load_ms)
+        with self._adapter_req_lock:
+            occ["requests"] = dict(self.adapter_requests)
+        return occ
+
+    @property
+    def resident_adapters(self) -> Optional[Dict[str, int]]:
+        if self.adapter_registry is None:
+            return None
+        return self.adapter_registry.resident()
 
     # ------------------------------------------------------------ scheduler
-    def _prefix_key(self, ids, plen, n_prompt, adapter):
-        return (tuple(ids[plen - n_prompt:]), adapter)
+    def _prefix_key(self, ids, plen, n_prompt, akey):
+        return (tuple(ids[plen - n_prompt:]), akey)
 
-    def _prefill_row_cached(self, ids, plen, n_prompt, adapter,
+    def _adapter_cache_key(self, req: Request):
+        """Prefix-cache adapter identity. Dynamic mode keys by NAME: a pool
+        slot index is recycled across evict/reload (same name can land on a
+        different slot, different name on the same slot), but cached KV rows
+        depend only on the adapter's weights — the name is the stable
+        identity. Static mode keeps the stack index (bijective with the
+        name for the engine's lifetime)."""
+        if self.adapter_registry is not None:
+            return req.adapter_name
+        return req.adapter
+
+    def _prefill_row_cached(self, ids, plen, n_prompt, adapter, akey,
                             budget_needed: int):
         """Prefix-cache paths only: (logits, dense row, cursor) on an exact
         hit (no compute) or a strict-prefix hit (suffix-only extension);
-        None on miss or when the cache is disabled.
+        None on miss or when the cache is disabled. ``adapter`` is the
+        device pool/stack index, ``akey`` the cache-key identity.
 
         Reuse must never change the response: a cached row whose cursor sits
         deeper than this request's own plen (extension padding accumulates)
@@ -694,7 +864,7 @@ class BatchedEngine:
         server exactly."""
         if self._prefix is None:
             return None
-        used, _ = key = self._prefix_key(ids, plen, n_prompt, adapter)
+        used, _ = key = self._prefix_key(ids, plen, n_prompt, akey)
         # the decode room the cold path would provide; reuse may not shrink
         # the effective budget below min(requested, cold)
         need = min(budget_needed, self.max_seq_len - plen)
@@ -702,7 +872,7 @@ class BatchedEngine:
         if ent is not None and self.max_seq_len - ent["cursor"] >= need:
             self.prefill_stats["reuse"] += 1
             return ent["logits"], ent["cache"], ent["cursor"]
-        pkey, pent = self._prefix.longest_prefix(used, adapter)
+        pkey, pent = self._prefix.longest_prefix(used, akey)
         if pent is not None:
             n_pref = len(pkey[0])
             suffix = list(used[n_pref:])
@@ -713,7 +883,7 @@ class BatchedEngine:
             cursor = pent["cursor"] + len(stoks)
             if self.max_seq_len - cursor >= need:
                 row_logits, row_cache = self._extend(
-                    self.params, pent["cache"],
+                    self.params, self._lora_arg(), pent["cache"],
                     jnp.asarray([stoks], jnp.int32),
                     jnp.asarray([smask], jnp.int32),
                     jnp.asarray([spos], jnp.int32),
@@ -728,22 +898,22 @@ class BatchedEngine:
         return None
 
     def _prefill_row(self, ids, mask, positions, plen, n_prompt, adapter,
-                     budget_needed: int = 1):
+                     akey, budget_needed: int = 1):
         """Produce (last-token logits, row cache, cache cursor) for a prompt,
         going through the prefix cache when enabled: exact hit = no compute,
         prefix hit = suffix-only extension, miss = full prefill (+ store)."""
-        hit = self._prefill_row_cached(ids, plen, n_prompt, adapter,
+        hit = self._prefill_row_cached(ids, plen, n_prompt, adapter, akey,
                                        budget_needed)
         if hit is not None:
             return hit
         row_logits, row_cache = self._prefill(
-            self.params, jnp.asarray([ids], jnp.int32),
+            self.params, self._lora_arg(), jnp.asarray([ids], jnp.int32),
             jnp.asarray([mask], jnp.int32), jnp.asarray([positions], jnp.int32),
             jnp.asarray(adapter, jnp.int32), prompt_len=plen,
         )
         self.prefill_stats["full"] += 1
         if self._prefix is not None:
-            self._prefix.put(self._prefix_key(ids, plen, n_prompt, adapter),
+            self._prefix.put(self._prefix_key(ids, plen, n_prompt, akey),
                              {"cache": row_cache, "logits": row_logits,
                               "cursor": plen})
         return row_logits, row_cache, plen
@@ -767,6 +937,59 @@ class BatchedEngine:
         )
 
     def _admit(self, req: Request, slot: int) -> bool:
+        """Occupy ``slot`` with ``req``, resolving (and PINNING) its
+        adapter first in dynamic mode — load-on-miss runs here, and a
+        fully-pinned pool FIFO-waits exactly like KV-block exhaustion.
+        False = some pool (adapter slots or KV blocks) is exhausted; the
+        request stays queued with nothing held."""
+        pinned = False
+        self._admit_wait_reason = "blocks"
+        if self.adapter_registry is not None and req.adapter_name:
+            if req.adapter_was_resident is None:
+                req.adapter_was_resident = (
+                    req.adapter_name in self.adapter_registry.resident())
+            # non-blocking: a miss kicks an ASYNC load and returns None —
+            # decode keeps ticking while the checkpoint reads; the request
+            # parks at its FIFO position until the load resolves
+            idx = self.adapter_registry.acquire(
+                req.adapter_name, count_hit=not req.adapter_stats_counted)
+            if idx is not None:
+                req.adapter_stats_counted = True
+            if idx is None:
+                loading = self.adapter_registry.describe(
+                    req.adapter_name).get("loading", False)
+                # mid-load → "adapter": younger requests may bypass (the
+                # head's pool slot is already reserved). Pool fully pinned
+                # → strict FIFO like blocks: bypassers could re-pin
+                # residents forever and starve the head's eviction.
+                self._admit_wait_reason = ("adapter" if loading
+                                           else "adapter_pool")
+                if self._last_adapter_wait != req.adapter_name:
+                    # dedupe: one trace entry per wait episode, not one
+                    # per scheduler retry tick (would flood the ring)
+                    self._trace("adapter_wait", req.adapter_name)
+                    self._last_adapter_wait = req.adapter_name
+                return False
+            self._last_adapter_wait = None
+            pinned = True
+            req.adapter = idx
+            if self.tracing:
+                req.mark("adapter", name=req.adapter_name, slot=idx,
+                         loaded=not req.adapter_was_resident)
+        try:
+            ok = self._admit_slot(req, slot)
+        except Exception:
+            if pinned:
+                self.adapter_registry.release(req.adapter_name)
+            raise
+        if ok:
+            if pinned:
+                self._slot_adapter[slot] = req.adapter_name
+        elif pinned:
+            self.adapter_registry.release(req.adapter_name)
+        return ok
+
+    def _admit_slot(self, req: Request, slot: int) -> bool:
         """Occupy ``slot`` with ``req``. Dense mode prefills monolithically
         and arms the slot at once. Paged mode reserves blocks first (False =
         pool exhausted; the request stays queued), serves prefix-cache hits
@@ -778,9 +1001,10 @@ class BatchedEngine:
             req.prompt_ids, self.tokenizer.eos_token_id,
             self.max_seq_len, req.max_new_tokens,
         )
+        akey = self._adapter_cache_key(req)
         if not self.paged:
             row_logits, row_cache, cursor = self._prefill_row(
-                ids, mask, positions, plen, n_prompt, req.adapter,
+                ids, mask, positions, plen, n_prompt, req.adapter, akey,
                 budget_needed=max_new)
             max_new = max(1, min(max_new, self.max_seq_len - cursor))
             (self._cache, self._logits, self._pos, self._remaining,
@@ -803,7 +1027,7 @@ class BatchedEngine:
             return True
 
         hit = self._prefill_row_cached(ids, plen, n_prompt, req.adapter,
-                                       budget_needed=max_new)
+                                       akey, budget_needed=max_new)
         if hit is not None:
             row_logits, row_cache, cursor = hit
             max_new = max(1, min(max_new, self.max_seq_len - cursor))
@@ -854,7 +1078,7 @@ class BatchedEngine:
             "req": req, "ids": ids, "mask": mask, "positions": positions,
             "plen": plen, "n_prompt": n_prompt, "max_new": max_new,
             "adapter": req.adapter, "done": 0,
-            "key": self._prefix_key(ids, plen, n_prompt, req.adapter),
+            "key": self._prefix_key(ids, plen, n_prompt, akey),
         }
         self._trace("admit", slot, plen, "chunked")
         if self.tracing:
@@ -887,35 +1111,56 @@ class BatchedEngine:
                 req.trace_id, req.t_submit, req.timeline,
                 req.first_token_ts, req.last_token_ts, n,
                 req.wall_submit_ms, error=error,
-                attrs={"adapter": req.adapter, "prompt_len": len(req.prompt_ids)},
+                attrs={"adapter": req.adapter_name or req.adapter,
+                       "prompt_len": len(req.prompt_ids)},
             )
             self.trace_store.add(span)
         req.finish(error=error)
 
     def _take_waiting(self) -> Optional[Request]:
-        if self._waiting_head is not None:
-            req, self._waiting_head = self._waiting_head, None
-            return req
+        if self._waiting_front:
+            return self._waiting_front.popleft()
         try:
             return self._waiting.get_nowait()
         except queue.Empty:
             return None
 
+    def _requeue_front(self, reqs: List[Request]):
+        """Restore requests to the FRONT of the wait order, preserving
+        their relative (older-first) order."""
+        for req in reversed(reqs):
+            self._waiting_front.appendleft(req)
+
     def _admit_waiting(self):
+        # requests whose adapter is mid-load this pass: parked aside so
+        # YOUNGER requests can fill other slots while the checkpoint reads
+        # (their pool slot is already reserved by the load, so bypass
+        # cannot starve them — they re-admit at their FIFO position)
+        parked: List[Request] = []
         for slot in range(self.slots):
             if self._slot_req[slot] is not None:
                 continue
-            req = self._take_waiting()
-            if req is None:
-                break
-            try:
-                if not self._admit(req, slot):
-                    # pool exhausted: the FIFO head waits for freed blocks
-                    # (younger requests must not starve it by sneaking in)
-                    self._waiting_head = req
+            while True:
+                req = self._take_waiting()
+                if req is None:
+                    self._requeue_front(parked)
+                    return
+                try:
+                    ok = self._admit(req, slot)
+                except Exception as e:  # noqa: BLE001 — fail request, not loop
+                    self._complete(req, error=str(e))
+                    continue  # try the next request for this slot
+                if ok:
                     break
-            except Exception as e:  # noqa: BLE001 — fail the request, not the loop
-                self._complete(req, error=str(e))
+                if self._admit_wait_reason == "adapter":
+                    parked.append(req)
+                    continue
+                # KV blocks exhausted: the FIFO head waits for freed blocks
+                # (younger requests must not starve it by sneaking in —
+                # they'd consume the very blocks it needs)
+                self._requeue_front(parked + [req])
+                return
+        self._requeue_front(parked)
 
     def _prefill_tick(self):
         """Spend AT MOST ``prefill_token_budget`` prompt tokens on pending
@@ -940,7 +1185,7 @@ class BatchedEngine:
                 try:
                     with jax.profiler.TraceAnnotation("dtx_engine_prefill_chunk"):
                         logits, self._cache = self._prefill_chunk_fn(
-                            self.params, self._cache,
+                            self.params, self._lora_arg(), self._cache,
                             jnp.asarray(slot, jnp.int32),
                             jnp.asarray([st["ids"][lo:lo + c]], jnp.int32),
                             jnp.asarray([st["mask"][lo:lo + c]], jnp.int32),
@@ -999,6 +1244,9 @@ class BatchedEngine:
         self._slot_req[slot] = None
         self._pending.pop(slot, None)
         self._decode_ready[slot] = False
+        name, self._slot_adapter[slot] = self._slot_adapter[slot], None
+        if name is not None and self.adapter_registry is not None:
+            self.adapter_registry.release(name)
         blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
         if blocks:
             # clear the table FIRST: a masked decode write from this slot
@@ -1023,7 +1271,8 @@ class BatchedEngine:
                 with jax.profiler.TraceAnnotation("dtx_engine_decode"):
                     (emitted, self._logits, self._cache, self._pos,
                      self._remaining, self._active, self._rng) = self._decode(
-                        self.params, self._cache, self._logits, self._pos,
+                        self.params, self._lora_arg(), self._cache,
+                        self._logits, self._pos,
                         self._remaining, self._active, self._rng, self._temps,
                         self._top_ps, self._stops, self._adapter_idx,
                         K=self.chunk,
@@ -1071,11 +1320,22 @@ class BatchedEngine:
         adapter: str = "",
         trace_id: str = "",
     ) -> Request:
-        if adapter not in self.adapter_ids:
+        known = self.adapter_ids
+        if adapter not in known:
             raise KeyError(
                 f"unknown adapter {adapter!r}; loaded: "
-                f"{sorted(n for n in self.adapter_ids if n)}"
+                f"{sorted(n for n in known if n)}"
             )
+        # device index: fixed at submit for the static stack; dynamic-mode
+        # names resolve (and pin) at ADMISSION — a resident slot seen here
+        # could be evicted before the request reaches a cache slot
+        idx = known[adapter] if self.adapter_registry is None else 0
+        with self._adapter_req_lock:
+            if (adapter in self.adapter_requests
+                    or len(self.adapter_requests)
+                    < self._adapter_requests_cap):
+                self.adapter_requests[adapter] = \
+                    self.adapter_requests.get(adapter, 0) + 1
         stops = {int(s) for s in (stop_ids or set())}
         stops.add(int(self.tokenizer.eos_token_id))
         # every request gets a trace id (callers without one — bench, bare
@@ -1083,7 +1343,7 @@ class BatchedEngine:
         # X-DTX-Trace-Id arrives here via serving/server.py or
         # InProcessReplica so one id follows the request end to end
         req = Request(prompt_ids, max_new_tokens, temperature, top_p, seed,
-                      sorted(stops), self.adapter_ids[adapter],
+                      sorted(stops), idx, adapter_name=adapter,
                       trace_id=trace_id or f"dtx-{uuid.uuid4().hex[:16]}")
         self._waiting.put(req)
         self._wake.set()
@@ -1115,12 +1375,11 @@ class BatchedEngine:
         if adapter not in self.adapter_ids:
             raise KeyError(f"unknown adapter {adapter!r}")
         if not hasattr(self, "_nll"):
-            def impl(params, tokens, mask, aidx):
+            def impl(params, lora, tokens, mask, aidx):
                 return nll_impl(
-                    params, self.cfg, tokens, mask,
-                    lora_adapter_idx=(aidx[None] if self.lora_stack is not None
+                    params, self.cfg, tokens, mask, lora=lora,
+                    lora_adapter_idx=(aidx[None] if lora is not None
                                       else None),
-                    **self._lora_args(),
                 )
 
             self._nll = jax.jit(impl)
@@ -1128,11 +1387,30 @@ class BatchedEngine:
             list(prompt_ids), list(completion_ids),
             self.tokenizer.eos_token_id, self.max_seq_len,
         )
-        nll_sum, n_tok = self._nll(
-            self.params, tokens, mask,
-            jnp.asarray(self.adapter_ids[adapter], jnp.int32),
-        )
-        return nll_result(float(nll_sum), int(n_tok))
+        # dynamic mode: pin the adapter across the scoring forward so LRU
+        # eviction can't swap its weights out mid-read (load-on-miss runs
+        # here too — scoring a cold adapter warms it for serving)
+        pinned = False
+        if self.adapter_registry is not None and adapter:
+            # blocking acquire: scoring runs on a caller thread, so it can
+            # afford to wait out a load-on-miss (which also warms the
+            # adapter for serving)
+            idx = self.adapter_registry.acquire(adapter, wait=True)
+            if idx is None:
+                raise RuntimeError(
+                    "adapter pool exhausted (all slots pinned); retry")
+            pinned = True
+        else:
+            idx = self.adapter_ids[adapter]
+        try:
+            nll_sum, n_tok = self._nll(
+                self.params, self._lora_arg(), tokens, mask,
+                jnp.asarray(idx, jnp.int32),
+            )
+            return nll_result(float(nll_sum), int(n_tok))
+        finally:
+            if pinned:
+                self.adapter_registry.release(adapter)
 
     def chat(self, messages: List[dict], max_new_tokens: int = 128,
              temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,

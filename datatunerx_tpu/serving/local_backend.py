@@ -71,10 +71,12 @@ class LocalServingBackend:
                 ]
             if spec.get("slots"):
                 argv += ["--slots", str(spec["slots"])]
-            # paged-cache tuning flows through the serveConfig untouched
-            # (serving.server and gateway.server both accept these)
+            # paged-cache + adapter-pool tuning flows through the
+            # serveConfig untouched (serving.server and gateway.server
+            # both accept these)
             for key in ("kv_block_size", "kv_blocks", "prefill_chunk",
-                        "prefill_token_budget"):
+                        "prefill_token_budget", "adapter_pool",
+                        "adapter_rank_max"):
                 if spec.get(key):
                     argv += [f"--{key}", str(spec[key])]
             from datatunerx_tpu.operator.backends import _pkg_root

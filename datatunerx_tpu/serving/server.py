@@ -22,6 +22,7 @@ from typing import Optional
 
 from datatunerx_tpu.obs.metrics import (
     Registry,
+    adapter_load_histogram,
     serving_latency_histograms,
     set_build_info,
     set_uptime,
@@ -123,11 +124,72 @@ def _metrics_text_locked() -> str:
     if getattr(eng, "total_kv_blocks", None):
         blocks_free.set(eng.free_kv_blocks)
         blocks_total.set(eng.total_kv_blocks)
+    # dynamic adapter pool (datatunerx_tpu/adapters/): occupancy, the
+    # residency set the gateway's cache-locality routing scrapes, and
+    # per-adapter traffic. Declared/cleared on every scrape so a swapped
+    # engine or an unloaded adapter can't leave stale series behind.
+    adapter_load_histogram(reg)  # stable series even pre-engine-load
+    pool_cap = reg.gauge("dtx_serving_adapter_pool_slots_capacity",
+                         "Adapter pool slots (loadable adapters resident "
+                         "at once; the base model is not a slot).")
+    pool_free = reg.gauge("dtx_serving_adapter_pool_slots_free",
+                          "Adapter pool slots holding no adapter.")
+    resident_g = reg.gauge("dtx_serving_adapter_resident",
+                           "1 per adapter resident in the pool "
+                           "(load-on-miss already paid).")
+    registered_g = reg.gauge("dtx_serving_adapter_registered",
+                             "1 per adapter this replica can serve "
+                             "(resident or loadable on miss).")
+    a_loads = reg.counter("dtx_serving_adapter_loads_total",
+                          "Adapters materialised into pool slots "
+                          "(checkpoint load + device insert).")
+    a_evict = reg.counter("dtx_serving_adapter_evictions_total",
+                          "Unpinned residents LRU-evicted to make room.")
+    a_hits = reg.counter("dtx_serving_adapter_hits_total",
+                         "Admissions whose adapter was already resident.")
+    a_miss = reg.counter("dtx_serving_adapter_misses_total",
+                         "Admissions that had to load their adapter.")
+    a_reqs = reg.counter("dtx_serving_adapter_requests_total",
+                         "Requests per adapter name ('' = base model).")
+    for m in (pool_cap, pool_free, resident_g, registered_g, a_loads,
+              a_evict, a_hits, a_miss, a_reqs):
+        m.clear()
+    occ_fn = getattr(eng, "adapter_occupancy", None)
+    occ = occ_fn() if callable(occ_fn) else None
+    if occ:
+        pool_cap.set(occ.get("slots", 0))
+        pool_free.set(occ.get("free", 0))
+        for name in occ.get("resident_adapters") or []:
+            resident_g.set(1, {"adapter": name})
+        for name in occ.get("registered_adapters") or []:
+            registered_g.set(1, {"adapter": name})
+        a_loads.set(occ.get("loads", 0))
+        a_evict.set(occ.get("evictions", 0))
+        a_hits.set(occ.get("hits", 0))
+        a_miss.set(occ.get("misses", 0))
+    # per-adapter demand: prefer the occupancy doc's LOCK-GUARDED copy
+    # (dynamic engines); static engines snapshot under the engine's own
+    # lock — copying the live dict bare would race a concurrent submit
+    reqs = (occ or {}).get("requests")
+    if reqs is None:
+        raw = getattr(eng, "adapter_requests", None)
+        if raw:
+            lock = getattr(eng, "_adapter_req_lock", None)
+            if lock is not None:
+                with lock:
+                    reqs = dict(raw)
+            else:
+                reqs = dict(raw)
+    for name, n in sorted((reqs or {}).items()):
+        a_reqs.set(n, {"adapter": name})
     return reg.expose()
 
 
 class Handler(BaseHTTPRequestHandler):
     def _json(self, code: int, payload: dict):
+        # count BEFORE the body goes out so a scrape racing the response
+        # can't miss its own request (gateway/server.py does the same)
+        self._record(code)
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -139,7 +201,6 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("X-DTX-Trace-Id", trace)
         self.end_headers()
         self.wfile.write(body)
-        self._record(code)
 
     def _record(self, code: int):
         STATE.registry.counter(
@@ -160,8 +221,104 @@ class Handler(BaseHTTPRequestHandler):
                 {"id": STATE.model_path, "object": "model"}]})
         elif self.path == "/metrics":
             self._metrics()
+        elif self.path == "/admin/adapters":
+            self._adapters_get()
         elif self.path.startswith("/debug/trace/"):
             self._debug_trace(self.path[len("/debug/trace/"):])
+        else:
+            self._json(404, {"error": "not found"})
+
+    # ------------------------------------------------- dynamic adapter plane
+    def _adapters_get(self):
+        """The replica's adapter inventory: registered names, resident set,
+        pool occupancy + load/evict/hit/miss stats. 501 on engines without
+        a dynamic pool (static --adapters stacks still report their fixed
+        names)."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        occ_fn = getattr(eng, "adapter_occupancy", None)
+        occ = occ_fn() if callable(occ_fn) else None
+        if occ is None:
+            ids = getattr(eng, "adapter_ids", None)
+            self._json(200, {
+                "dynamic": False,
+                "registered": sorted(n for n in (ids or {}) if n),
+                "resident": sorted(n for n in (ids or {}) if n),
+            })
+            return
+        self._json(200, {
+            "dynamic": True,
+            "registered": occ.pop("registered_adapters", []),
+            "resident": occ.pop("resident_adapters", []),
+            "pool": occ,
+        })
+
+    def _adapters_post(self, req: dict):
+        """POST /admin/adapters {"name": n, "checkpoint": path[, "load":
+        bool]} — register a tenant adapter at runtime; by default the
+        weights are warmed into a pool slot immediately so the first
+        request is a residency hit. 400 on geometry violations (rank >
+        rank_max, foreign targets), 409 on a live-name conflict, 501 on
+        static-stack engines."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        name = str(req.get("name") or "")
+        ckpt = str(req.get("checkpoint") or "")
+        if not name or not ckpt:
+            self._json(400, {"error": "name and checkpoint are required"})
+            return
+        load = req.get("load", True)
+        loader = getattr(eng, "load_adapter", None)
+        if not callable(loader):
+            self._json(501, {"error": "engine has no dynamic adapter pool"})
+            return
+        from datatunerx_tpu.adapters import AdapterPinnedError
+
+        try:
+            self._json(200, loader(name, ckpt, preload=bool(load)))
+        except NotImplementedError as e:  # static stack: can never succeed
+            self._json(501, {"error": str(e)})
+        except AdapterPinnedError as e:
+            self._json(409, {"error": str(e)})
+        except RuntimeError as e:  # pool exhausted: retryable
+            self._json(409, {"error": str(e)})
+        except (ValueError, FileNotFoundError) as e:
+            self._json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — serving must answer
+            self._json(500, {"error": str(e)})
+
+    def _adapters_delete(self, name: str):
+        """DELETE /admin/adapters/<name> — evict + unregister. 409 while
+        in-flight requests pin the adapter; retry after they drain."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        unloader = getattr(eng, "unload_adapter", None)
+        if not callable(unloader):
+            self._json(501, {"error": "engine has no dynamic adapter pool"})
+            return
+        from datatunerx_tpu.adapters import AdapterPinnedError
+
+        try:
+            if unloader(name):
+                self._json(200, {"unloaded": name})
+            else:
+                self._json(404, {"error": f"no adapter {name!r}"})
+        except NotImplementedError as e:
+            self._json(501, {"error": str(e)})
+        except AdapterPinnedError as e:
+            self._json(409, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            self._json(500, {"error": str(e)})
+
+    def do_DELETE(self):
+        if self.path.startswith("/admin/adapters/"):
+            self._adapters_delete(self.path[len("/admin/adapters/"):])
         else:
             self._json(404, {"error": "not found"})
 
@@ -219,6 +376,15 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/perplexity":
             self._perplexity()
+            return
+        if self.path == "/admin/adapters":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"invalid JSON body: {e}"})
+                return
+            self._adapters_post(req)
             return
         if self.path == "/debug/profile":
             try:
@@ -371,7 +537,8 @@ class Handler(BaseHTTPRequestHandler):
 
 def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
-                      adapters=None, kv_quant=None, prefix_cache=0,
+                      adapters=None, adapter_pool=0, adapter_rank_max=8,
+                      adapter_targets=None, kv_quant=None, prefix_cache=0,
                       kv_block_size=0, kv_blocks=0, prefill_chunk=256,
                       prefill_token_budget=0, trace_ring=256,
                       trace_log_path=None):
@@ -383,6 +550,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
             # adapter name / running a full-size cache the operator budgeted
             # HBM against
             for flag, val in (("--adapters", adapters),
+                              ("--adapter_pool", adapter_pool),
                               ("--prefix_cache", prefix_cache),
                               ("--kv_quant", kv_quant),
                               ("--kv_block_size", kv_block_size)):
@@ -396,6 +564,9 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
 
                 STATE.engine = BatchedEngine(
                     model_path, checkpoint_path or None, adapters=adapters,
+                    adapter_pool=adapter_pool,
+                    adapter_rank_max=adapter_rank_max,
+                    adapter_targets=adapter_targets or None,
                     template=template, max_seq_len=max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
                     kv_quant=kv_quant or None, prefix_cache=prefix_cache,
@@ -455,6 +626,18 @@ def main(argv=None):
     p.add_argument("--adapters", default="",
                    help="named LoRA adapters: name=ckpt[,name=ckpt…]; "
                         "requests select one via the 'model' field")
+    p.add_argument("--adapter_pool", type=int, default=0,
+                   help="dynamic multi-adapter pool: N HBM slots adapters "
+                        "load into at runtime (load-on-miss, LRU evict, "
+                        "POST/DELETE /admin/adapters); 0 = static "
+                        "--adapters stack baked at startup")
+    p.add_argument("--adapter_rank_max", type=int, default=8,
+                   help="pool rank ceiling; lower ranks are zero-padded "
+                        "(numerically invisible), higher ranks rejected")
+    p.add_argument("--adapter_targets", default="",
+                   help="pool LoRA target set, comma-separated (default "
+                        "q_proj,v_proj); adapters training other targets "
+                        "are rejected")
     p.add_argument("--kv_quant", default="", choices=["", "int8"],
                    help="int8-quantized KV cache: half the cache HBM, double "
                         "the slots×context budget (batched engine only)")
@@ -493,6 +676,11 @@ def main(argv=None):
                       args.max_seq_len, quantization=args.quantization,
                       slots=args.slots, decode_chunk=args.decode_chunk,
                       adapters=parse_adapters(args.adapters),
+                      adapter_pool=args.adapter_pool,
+                      adapter_rank_max=args.adapter_rank_max,
+                      adapter_targets=[t.strip() for t in
+                                       args.adapter_targets.split(",")
+                                       if t.strip()] or None,
                       kv_quant=args.kv_quant, prefix_cache=args.prefix_cache,
                       kv_block_size=args.kv_block_size,
                       kv_blocks=args.kv_blocks,

@@ -719,9 +719,10 @@ def extra_artifacts(cert: Certifier, dev):
             tok = jax.ShapeDtypeStruct((1, plen), jnp.int32, sharding=sh)
             aidx = jax.ShapeDtypeStruct((), jnp.int32, sharding=sh)
             # eng._prefill is the memoized _Programs.prefill jit (the impl
-            # lives on the shared program holder, not the engine)
+            # lives on the shared program holder, not the engine); lora is
+            # an argument now (None = base-only engine)
             compiled = eng._prefill.lower(
-                to_sds(eng.params), tok, tok, tok, aidx,
+                to_sds(eng.params), None, tok, tok, tok, aidx,
                 prompt_len=plen).compile()
             return {"cost": _cost(compiled), "memory": _memory(compiled)}
         finally:

@@ -60,6 +60,17 @@ def main() -> int:
                     f"p95={sv.get('tpot_ms_p95')}ms · "
                     f"requests={sv.get('requests')} "
                     f"errors={sv.get('errors')}")
+            # adapter-churn mode: residency hit rate + load latency are the
+            # dynamic multi-adapter plane's own north-stars
+            ad = sv.get("adapters")
+            if isinstance(ad, dict):
+                row += ("\n  - adapters: "
+                        f"{ad.get('count')} over {ad.get('pool_slots')} "
+                        f"pool slots · hit_rate={ad.get('hit_rate')} · "
+                        f"loads={ad.get('loads')} "
+                        f"evictions={ad.get('evictions')} · "
+                        f"load p50={ad.get('load_ms_p50')}ms "
+                        f"p95={ad.get('load_ms_p95')}ms")
     summary = os.environ.get("GITHUB_STEP_SUMMARY")
     if summary:
         with open(summary, "a", encoding="utf-8") as f:

@@ -71,11 +71,26 @@ def lint_exposition(text: str, plane: str):
 
 
 class _StatsEngine:
-    """Duck-typed engine exposing what serving.metrics_text reads."""
+    """Duck-typed engine exposing what serving.metrics_text reads —
+    including the dynamic adapter plane (pool occupancy, residency sets,
+    per-adapter request counters) so every dtx_serving_adapter_* series
+    is built and linted."""
 
     slots = 4
     _slot_req = [object(), None, None, None]
     prefill_stats = {"full": 2, "reuse": 1, "extend": 0}
+    adapter_ids = {"": 0, "tenant-a": 1, "tenant-b": -1}
+    resident_adapters = {"tenant-a": 1}
+    adapter_requests = {"": 3, "tenant-a": 2, "tenant-b": 1}
+
+    def adapter_occupancy(self):
+        return {"slots": 4, "free": 3, "resident": 1, "pinned": 0,
+                "rank_max": 8, "targets": ["q_proj", "v_proj"],
+                "registered": 2, "hbm_bytes": 1 << 20,
+                "loads": 2, "evictions": 1, "hits": 1, "misses": 2,
+                "resident_adapters": ["tenant-a"],
+                "registered_adapters": ["tenant-a", "tenant-b"],
+                "load_ms": [12.5], "requests": dict(self.adapter_requests)}
 
     def chat(self, messages, **kw):
         return "ok"
@@ -92,9 +107,13 @@ def gateway_exposition() -> str:
     gw = Gateway(pool, model_name="preset:lint")
     try:
         # drive one request so the labeled counters and the queue-wait
-        # histogram expose real series, not just TYPE lines
+        # histogram expose real series, not just TYPE lines — and one
+        # ADAPTER request so the residency-routing outcome counters and
+        # per-adapter demand series are built and linted too
         gw.chat({"messages": [{"role": "user", "content": "hi"}]},
                 trace_id="lint-trace")
+        gw.chat({"messages": [{"role": "user", "content": "hi"}],
+                 "model": "tenant-a"}, trace_id="lint-trace-adapter")
         gw.record_request(200)
         return gw.metrics_text()
     finally:

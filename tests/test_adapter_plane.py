@@ -1,0 +1,563 @@
+"""Dynamic multi-adapter plane (datatunerx_tpu/adapters/ + serving
+/admin/adapters + gateway residency routing): the pool is a cache — load
+on miss, pin while decoding, LRU-evict when full — and the whole fleet
+becomes an adapter cache the gateway routes by residency. Engine-level
+token parity lives in test_paged_engine.py; this file covers the store/
+registry mechanics, the admission FIFO-wait, the admin HTTP contract, and
+the gateway's load-on-miss → prefer-resident end-to-end path."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from datatunerx_tpu.adapters import (
+    AdapterPinnedError,
+    AdapterRankError,
+    AdapterRegistry,
+    AdapterStore,
+    AdapterTargetError,
+    hbm_bytes,
+)
+from datatunerx_tpu.models import get_config
+from datatunerx_tpu.models.lora import target_dims
+
+MODEL = "preset:debug"
+
+
+# ---------------------------------------------------------------- store unit
+
+def _cfg():
+    return get_config("debug")
+
+
+def _layers(cfg, rank, targets=("q_proj", "v_proj"), fill=0.5):
+    out = {}
+    for t in targets:
+        d_in, d_out = target_dims(cfg, t)
+        out[t] = {"a": np.full((cfg.num_layers, d_in, rank), fill,
+                               np.float32),
+                  "b": np.full((cfg.num_layers, rank, d_out), fill,
+                               np.float32)}
+    return out
+
+
+def test_store_insert_pads_rank_and_clear_zeroes():
+    cfg = _cfg()
+    store = AdapterStore(cfg, pool_slots=2, rank_max=8)
+    rank = store.insert(1, _layers(cfg, rank=4), scaling=2.0, name="t")
+    assert rank == 4
+    tree, scales = store.tree
+    a = np.asarray(tree["layers"]["q_proj"]["a"])
+    assert a.shape[1] == 3  # base slot 0 + 2 pool slots
+    assert (a[:, 1, :, :4] == 0.5).all()
+    assert (a[:, 1, :, 4:] == 0.0).all()  # rank padding
+    assert (a[:, 0] == 0.0).all() and (a[:, 2] == 0.0).all()
+    assert float(scales[1]) == 2.0 and float(scales[0]) == 0.0
+    store.clear(1)
+    tree, scales = store.tree
+    assert (np.asarray(tree["layers"]["q_proj"]["a"]) == 0.0).all()
+    assert float(scales[1]) == 0.0
+
+
+def test_store_rejects_bad_geometry():
+    cfg = _cfg()
+    store = AdapterStore(cfg, pool_slots=1, rank_max=4)
+    with pytest.raises(AdapterRankError, match="rank 8 exceeds"):
+        store.insert(1, _layers(cfg, rank=8), scaling=1.0, name="big")
+    with pytest.raises(AdapterTargetError, match="o_proj"):
+        store.insert(1, _layers(cfg, rank=2, targets=("o_proj",)),
+                     scaling=1.0, name="wide")
+    with pytest.raises(ValueError, match="slot 0"):
+        store.insert(0, _layers(cfg, rank=2), scaling=1.0)
+    assert hbm_bytes(cfg, 8, 8) == AdapterStore(
+        cfg, pool_slots=8, rank_max=8).nbytes()
+
+
+# ------------------------------------------------------------- registry unit
+
+def _registry(pool_slots=2, rank_max=8, ranks=None):
+    """Registry over a fake loader (no orbax): checkpoint path 'ck:<name>'
+    loads constant-filled layers at the configured rank."""
+    cfg = _cfg()
+    store = AdapterStore(cfg, pool_slots=pool_slots, rank_max=rank_max)
+    ranks = ranks or {}
+    loads = []
+
+    def loader(path):
+        name = path.split(":", 1)[1]
+        loads.append(name)
+        return {"lora": {"layers": _layers(cfg, ranks.get(name, 2))},
+                "_scaling": 4.0}
+
+    reg = AdapterRegistry(store, loader=loader)
+    return reg, loads
+
+
+def test_registry_load_on_miss_hit_and_lru_eviction():
+    reg, loads = _registry(pool_slots=2)
+    for n in ("a", "b", "c"):
+        reg.register(n, f"ck:{n}")
+    # wait=True: block on the async load and return the pinned slot
+    assert reg.acquire("a", wait=True) == 1
+    assert reg.acquire("b", wait=True) == 2
+    reg.release("a")
+    reg.release("b")
+    assert reg.acquire("a", wait=True) == 1  # hit: no reload
+    reg.release("a")
+    assert loads == ["a", "b"]
+    assert reg.stats == {"loads": 2, "evictions": 0, "hits": 1, "misses": 2}
+    # pool full → the COLDEST unpinned resident (b) is evicted for c
+    assert reg.acquire("c", wait=True) == 2
+    reg.release("c")
+    assert reg.resident() == {"a": 1, "c": 2}
+    assert reg.stats["evictions"] == 1 and loads == ["a", "b", "c"]
+    # b reloads on demand into the next evictable slot
+    assert reg.acquire("b", wait=True) is not None
+    reg.release("b")
+
+
+def test_registry_acquire_is_nonblocking_and_resolves():
+    """The scheduler's contract: a miss returns None immediately (the
+    load runs on a loader thread) and a later retry succeeds — decode is
+    never held hostage by a checkpoint read. Retries while loading or
+    exhausted must not inflate the miss counter."""
+    import threading as _threading
+    import time as _time
+
+    cfg = _cfg()
+    store = AdapterStore(cfg, pool_slots=1, rank_max=8)
+    release = _threading.Event()
+
+    def slow_loader(path):
+        release.wait(10)
+        return {"lora": {"layers": _layers(cfg, 2)}, "_scaling": 4.0}
+
+    reg = AdapterRegistry(store, loader=slow_loader)
+    reg.register("a", "ck:a")
+    assert reg.acquire("a") is None  # load kicked, NOT blocked on it
+    assert reg.acquire("a") is None  # still loading: no second load
+    assert reg.stats["misses"] == 1  # retries are not phantom misses
+    with pytest.raises(AdapterPinnedError):  # mid-load: not removable
+        reg.unregister("a")
+    release.set()
+    deadline = _time.time() + 10
+    idx = None
+    while idx is None and _time.time() < deadline:
+        idx = reg.acquire("a")
+        if idx is None:
+            _time.sleep(0.005)
+    assert idx == 1 and reg.stats["loads"] == 1
+    assert reg.stats["misses"] == 1 and reg.stats["hits"] == 0
+    reg.release("a")
+
+
+def test_registry_pinning_blocks_eviction_and_unload():
+    reg, _ = _registry(pool_slots=1)
+    reg.register("a", "ck:a")
+    reg.register("b", "ck:b")
+    assert reg.acquire("a", wait=True) == 1
+    # a is pinned: nothing evictable → exhausted, caller FIFO-waits
+    assert reg.acquire("b") is None
+    with pytest.raises(AdapterPinnedError):
+        reg.unregister("a")
+    reg.release("a")
+    assert reg.acquire("b", wait=True) == 1  # a (unpinned LRU) evicted
+    reg.release("b")
+    assert reg.unregister("b") and reg.names() == ["a"]
+
+
+def test_registry_reregister_contract():
+    reg, _ = _registry()
+    reg.register("a", "ck:a")
+    reg.register("a", "ck:a")  # idempotent
+    reg.acquire("a", wait=True)
+    with pytest.raises(AdapterPinnedError):  # live name, other weights
+        reg.register("a", "ck:other")
+    reg.release("a")
+    with pytest.raises(AdapterPinnedError):  # still resident
+        reg.register("a", "ck:other")
+    reg.unregister("a")
+    reg.register("a", "ck:other")  # gone → new binding allowed
+
+
+def test_registry_rank_over_max_rejected_and_not_inserted():
+    reg, _ = _registry(rank_max=4, ranks={"big": 16})
+    reg.register("big", "ck:big")
+    with pytest.raises(AdapterRankError, match="rank 16 exceeds"):
+        reg.acquire("big", wait=True)
+    occ = reg.occupancy()
+    assert occ["resident"] == 0 and occ["free"] == 2
+    with pytest.raises(KeyError):
+        reg.acquire("never-registered")
+
+
+# --------------------------------------------------- engine admission wait
+
+def test_engine_fifo_waits_on_adapter_pool_exhaustion(tmp_path):
+    """A 1-slot pool under 2-adapter traffic: the second request waits for
+    the first to release its pin (like KV-block exhaustion), then loads —
+    nobody errors, nobody deadlocks."""
+    from datatunerx_tpu.serving.adapters import make_adapter_sweep
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    cks = make_adapter_sweep(str(tmp_path), MODEL, 2, ranks=(2,))
+    eng = BatchedEngine(MODEL, adapters=cks, adapter_pool=1,
+                        adapter_rank_max=8, template="vanilla",
+                        max_seq_len=256, slots=2, decode_chunk=4,
+                        kv_block_size=16)
+    try:
+        names = sorted(cks)
+        prompt = eng.tokenizer.encode("contention probe")
+        reqs = [eng.submit(prompt, max_new_tokens=8, adapter=n)
+                for n in names]
+        for n, r in zip(names, reqs):
+            assert r.done.wait(300), f"{n} stalled under pool exhaustion"
+            assert r.error is None, (n, r.error)
+        assert ("adapter_wait", names[1]) in list(eng.sched_trace)
+        occ = eng.adapter_occupancy()
+        assert occ["pinned"] == 0 and occ["evictions"] >= 1
+    finally:
+        eng.close()
+
+
+def test_rebind_invalidates_prefix_cache(tmp_path):
+    """Re-registering a NAME with different weights must drop the prefix
+    cache's rows for it — a cached KV row from the old binding would
+    silently poison the new adapter's output."""
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    ck1 = make_adapter_checkpoint(str(tmp_path / "v1"), MODEL, seed=3, rank=4)
+    ck2 = make_adapter_checkpoint(str(tmp_path / "v2"), MODEL, seed=8, rank=4)
+    eng = BatchedEngine(MODEL, adapter_pool=2, adapter_rank_max=8,
+                        template="vanilla", max_seq_len=256, slots=2,
+                        decode_chunk=4, kv_block_size=16, prefix_cache=8)
+    try:
+        prompt = eng.tokenizer.encode("system preamble for the tenant")
+        eng.load_adapter("t", ck1)
+        eng.load_adapter("ref", ck2)  # ck2's truth, under an unused name
+        want_v2 = eng.generate(prompt, max_new_tokens=8, adapter="ref")
+        out_v1 = eng.generate(prompt, max_new_tokens=8, adapter="t")
+        assert eng.generate(prompt, max_new_tokens=8,
+                            adapter="t") == out_v1  # prefix-cache hit path
+        eng.unload_adapter("t")
+        eng.load_adapter("t", ck2)  # same name, NEW weights
+        got = eng.generate(prompt, max_new_tokens=8, adapter="t")
+        assert got == want_v2, (got, want_v2)
+        assert got != out_v1
+    finally:
+        eng.close()
+
+
+def test_warm_failure_keeps_existing_registration(tmp_path):
+    """A preload that fails on TRANSIENT pool exhaustion must not
+    unregister a tenant that was already registered — warming a busy pool
+    must never turn a working adapter off. A bad checkpoint registered by
+    the same call still rolls back."""
+    import time
+
+    from datatunerx_tpu.serving.adapters import make_adapter_sweep
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    cks = make_adapter_sweep(str(tmp_path), MODEL, 2, ranks=(2,))
+    a, b = sorted(cks)
+    eng = BatchedEngine(MODEL, adapters=cks, adapter_pool=1,
+                        adapter_rank_max=8, template="vanilla",
+                        max_seq_len=256, slots=2, decode_chunk=4,
+                        kv_block_size=16)
+    try:
+        prompt = eng.tokenizer.encode("hold the pool slot")
+        req = eng.submit(prompt, max_new_tokens=160, adapter=a)
+        deadline = time.time() + 300
+        while not req.tokens and time.time() < deadline:
+            time.sleep(0.002)
+        assert req.tokens, "pin-holder never started decoding"
+        # every slot pinned → warming b fails transiently…
+        with pytest.raises(RuntimeError, match="exhausted"):
+            eng.load_adapter(b, cks[b])
+        # …but b (registered at construction) must survive
+        assert b in eng.adapter_ids
+        assert req.done.wait(300) and req.error is None
+        assert eng.generate(prompt, max_new_tokens=4, adapter=b)
+    finally:
+        eng.close()
+
+
+def test_decode_continues_during_adapter_load(tmp_path):
+    """The async-load QoS contract: a cold adapter's checkpoint read must
+    not stall decode — a base request submitted AFTER the cold-adapter
+    request runs to completion while the load is still gated, and the
+    cold request completes once the load lands."""
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    ck = make_adapter_checkpoint(str(tmp_path / "cold"), MODEL, seed=4,
+                                 rank=4)
+    eng = BatchedEngine(MODEL, adapters={"cold": ck}, adapter_pool=1,
+                        adapter_rank_max=8, template="vanilla",
+                        max_seq_len=256, slots=2, decode_chunk=4,
+                        kv_block_size=16)
+    gate = threading.Event()
+    orig_loader = eng.adapter_registry._loader
+
+    def gated_loader(path):
+        assert gate.wait(60), "test gate never opened"
+        return orig_loader(path)
+
+    eng.adapter_registry._loader = gated_loader
+    try:
+        prompt = eng.tokenizer.encode("latency isolation probe")
+        cold = eng.submit(prompt, max_new_tokens=6, adapter="cold")
+        base = eng.submit(prompt, max_new_tokens=6)
+        # the base request finishes while the cold load is still gated
+        assert base.done.wait(300) and base.error is None
+        assert not cold.done.is_set()
+        gate.set()
+        assert cold.done.wait(300) and cold.error is None, cold.error
+        assert "cold" in eng.resident_adapters
+    finally:
+        gate.set()
+        eng.close()
+
+
+# ---------------------------------------------------- admin HTTP contract
+
+@pytest.fixture()
+def pooled_server(tmp_path):
+    """A real serving HTTP server over a real dynamic-pool engine."""
+    from datatunerx_tpu.serving import server as serving
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    eng = BatchedEngine(MODEL, adapter_pool=2, adapter_rank_max=8,
+                        template="vanilla", max_seq_len=256, slots=2,
+                        decode_chunk=4, kv_block_size=16)
+    old_engine, old_model = serving.STATE.engine, serving.STATE.model_path
+    serving.STATE.engine, serving.STATE.model_path = eng, MODEL
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), serving.Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", eng
+    finally:
+        srv.shutdown()
+        serving.STATE.engine, serving.STATE.model_path = old_engine, old_model
+        eng.close()
+
+
+def _req(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_admin_adapters_http_contract(pooled_server, tmp_path):
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+
+    url, eng = pooled_server
+    code, doc = _req(url + "/admin/adapters")
+    assert code == 200 and doc["dynamic"] and doc["registered"] == []
+
+    # register + warm a tenant at runtime
+    ck = make_adapter_checkpoint(str(tmp_path / "t1"), MODEL, seed=5, rank=4)
+    code, doc = _req(url + "/admin/adapters", "POST",
+                     {"name": "t1", "checkpoint": ck})
+    assert code == 200 and doc["resident"] and doc["rank"] == 4
+    code, doc = _req(url + "/admin/adapters")
+    assert doc["registered"] == ["t1"] and doc["resident"] == ["t1"]
+    assert doc["pool"]["slots"] == 2 and doc["pool"]["free"] == 1
+
+    # the freshly-registered name serves chat immediately
+    code, doc = _req(url + "/chat/completions", "POST",
+                     {"messages": [{"role": "user", "content": "hi"}],
+                      "model": "t1", "max_tokens": 4})
+    assert code == 200, doc
+
+    # /metrics carries residency + pool occupancy for the gateway scrape
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert 'dtx_serving_adapter_resident{adapter="t1"} 1' in text
+    assert "dtx_serving_adapter_pool_slots_capacity 2" in text
+    assert 'dtx_serving_adapter_requests_total{adapter="t1"}' in text
+
+    # geometry violations answer 400 with the actionable message
+    big = make_adapter_checkpoint(str(tmp_path / "big"), MODEL, seed=6,
+                                  rank=16)
+    code, doc = _req(url + "/admin/adapters", "POST",
+                     {"name": "big", "checkpoint": big})
+    assert code == 400 and "rank 16 exceeds" in doc["error"]
+    code, _ = _req(url + "/admin/adapters", "POST", {"name": "x"})
+    assert code == 400
+
+    # DELETE evicts + unregisters; unknown names 404
+    code, doc = _req(url + "/admin/adapters/t1", "DELETE")
+    assert code == 200 and doc == {"unloaded": "t1"}
+    code, _ = _req(url + "/admin/adapters/t1", "DELETE")
+    assert code == 404
+    code, doc = _req(url + "/admin/adapters")
+    assert doc["registered"] == [] and doc["pool"]["free"] == 2
+
+
+def test_admin_adapters_static_engine_501():
+    from datatunerx_tpu.serving import server as serving
+
+    class _Static:
+        adapter_ids = {"": 0, "s": 1}
+
+    old = serving.STATE.engine
+    serving.STATE.engine = _Static()
+    try:
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), serving.Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        code, doc = _req(url + "/admin/adapters")
+        assert code == 200 and doc == {"dynamic": False,
+                                       "registered": ["s"],
+                                       "resident": ["s"]}
+        code, _ = _req(url + "/admin/adapters", "POST",
+                       {"name": "n", "checkpoint": "p"})
+        assert code == 501
+        code, _ = _req(url + "/admin/adapters/s", "DELETE")
+        assert code == 501
+        srv.shutdown()
+    finally:
+        serving.STATE.engine = old
+
+
+def test_adapter_label_parse_handles_escapes():
+    """The gateway's /metrics scrape parser must round-trip exposition
+    label escaping (obs.metrics.escape_label_value) — a tenant name with
+    a quote/backslash must not register residency under a wrong name."""
+    from datatunerx_tpu.gateway.replica_pool import _adapter_label
+    from datatunerx_tpu.obs.metrics import format_sample
+
+    p = "dtx_serving_adapter_resident{"
+    for name in ("plain", 'a"b', "a\\b", "a\nb", 'tricky\\"x'):
+        line = format_sample("dtx_serving_adapter_resident",
+                             {"adapter": name}, 1)
+        assert _adapter_label(line, p) == name, (name, line)
+    assert _adapter_label(
+        'dtx_serving_adapter_resident{adapter="gone"} 0', p) is None
+    assert _adapter_label('dtx_other{adapter="x"} 1', p) is None
+    assert _adapter_label(
+        'dtx_serving_adapter_resident{adapter="unterminated', p) is None
+
+
+# --------------------------------------------------- gateway e2e routing
+
+def test_gateway_load_on_miss_then_prefers_resident(tmp_path):
+    """The acceptance-criterion e2e: a request for a NON-resident adapter
+    succeeds (routed to the replica that can load it, which loads on
+    admission), and subsequent requests prefer the now-resident replica —
+    with the outcome counters, metrics series, and the adapter_route trace
+    event to prove each step."""
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    ck = make_adapter_checkpoint(str(tmp_path / "t"), MODEL, seed=7, rank=4)
+    e0 = BatchedEngine(MODEL, adapter_pool=1, template="vanilla",
+                       max_seq_len=256, slots=2, decode_chunk=4,
+                       kv_block_size=16)
+    e1 = BatchedEngine(MODEL, adapters={"tenant": ck}, adapter_pool=1,
+                       template="vanilla", max_seq_len=256, slots=2,
+                       decode_chunk=4, kv_block_size=16)
+    pool = ReplicaPool([InProcessReplica("r0", e0),
+                        InProcessReplica("r1", e1)])
+    gw = Gateway(pool, model_name=MODEL)
+    try:
+        req = {"messages": [{"role": "user", "content": "hello tenant"}],
+               "model": "tenant", "max_tokens": 4}
+        # 1st request: tenant resident nowhere → routed to r1 (the only
+        # replica that KNOWS it) → load-on-miss at admission succeeds
+        assert gw.chat(dict(req), trace_id="dtx-adp-1") is not None
+        assert gw.router.adapter_routes["load_miss"] == 1
+        assert "tenant" in e1.resident_adapters
+        # 2nd request: r1 is now RESIDENT → preferred even though r0 is
+        # equally idle (cache locality beats least-busy)
+        assert gw.chat(dict(req), trace_id="dtx-adp-2") is not None
+        assert gw.router.adapter_routes["resident"] == 1
+        assert gw.router.adapter_requests["tenant"] == 2
+        assert e0.adapter_requests == {}  # r0 never saw tenant traffic
+
+        # the routing decision is IN the request trace
+        doc = gw.trace("dtx-adp-1")
+        events = [e for sp in doc["spans"]
+                  for e in (sp.get("events") or [])
+                  if e.get("name") == "adapter_route"]
+        assert events and events[0]["outcome"] == "load_miss"
+        doc2 = gw.trace("dtx-adp-2")
+        events2 = [e for sp in doc2["spans"]
+                   for e in (sp.get("events") or [])
+                   if e.get("name") == "adapter_route"]
+        assert events2 and events2[0]["outcome"] == "resident"
+        assert events2[0]["resident"] == ["r1"]
+
+        # gateway /metrics: outcomes + per-adapter demand + residency map
+        text = gw.metrics_text()
+        assert ('dtx_gateway_adapter_routes_total{outcome="load_miss"} 1'
+                in text)
+        assert ('dtx_gateway_adapter_routes_total{outcome="resident"} 1'
+                in text)
+        assert 'dtx_gateway_adapter_requests_total{adapter="tenant"} 2' in text
+        assert ('dtx_gateway_adapter_resident_replicas{adapter="tenant"} 1'
+                in text)
+        # base traffic is untouched by the preference
+        assert gw.chat({"messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4}) is not None
+    finally:
+        gw.close()
+
+
+# ------------------------------------------------------- operator wiring
+
+def test_serveconfig_adapter_fields_flow_to_flags(tmp_path):
+    """serveConfig.adapterPool/adapterRankMax → generate_serving_spec →
+    LocalServingBackend argv (the operator path an admin actually uses)."""
+    from datatunerx_tpu.operator.api import FinetuneJob
+    from datatunerx_tpu.operator.generate import generate_serving_spec
+    from datatunerx_tpu.operator.webhooks import (
+        AdmissionError,
+        _validate_serve_config,
+    )
+
+    job = FinetuneJob(
+        spec={"finetune": {"finetuneSpec": {"llm": "m", "dataset": "d"}},
+              "serveConfig": {"adapterPool": 16, "adapterRankMax": 32,
+                              "slots": 4}})
+    job.metadata.name = "j"
+    spec = generate_serving_spec(job, {"llmPath": str(tmp_path)})
+    assert spec["adapter_pool"] == 16 and spec["adapter_rank_max"] == 32
+
+    _validate_serve_config({"adapterPool": 8})
+    _validate_serve_config({"adapterPool": 8, "adapterRankMax": 16})
+    with pytest.raises(AdmissionError):
+        _validate_serve_config({"adapterPool": 0})
+    with pytest.raises(AdmissionError, match="requires adapterPool"):
+        _validate_serve_config({"adapterRankMax": 8})
+
+    import subprocess
+    from unittest import mock
+
+    from datatunerx_tpu.serving.local_backend import LocalServingBackend
+
+    backend = LocalServingBackend(str(tmp_path / "wd"))
+    with mock.patch.object(subprocess, "Popen") as popen:
+        popen.return_value = mock.Mock(poll=lambda: None)
+        backend.deploy("svc", {"model_path": "preset:debug",
+                               "adapter_pool": 16, "adapter_rank_max": 32})
+    argv = popen.call_args[0][0]
+    assert "--adapter_pool" in argv and "16" in argv
+    assert "--adapter_rank_max" in argv and "32" in argv
