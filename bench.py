@@ -299,9 +299,11 @@ def child_serve(preflight=None):
             "ttft_ms_mean": round(mean(ttfts) * 1e3, 1),
             "ttft_ms_p50": round(pct(ttfts, 0.5) * 1e3, 1),
             "ttft_ms_p95": round(pct(ttfts, 0.95) * 1e3, 1),
+            "ttft_ms_p99": round(pct(ttfts, 0.99) * 1e3, 1),
             "tpot_ms_mean": round(mean(tpots) * 1e3, 2),
             "tpot_ms_p50": round(pct(tpots, 0.5) * 1e3, 2),
             "tpot_ms_p95": round(pct(tpots, 0.95) * 1e3, 2),
+            "tpot_ms_p99": round(pct(tpots, 0.99) * 1e3, 2),
             "prefill_stats": dict(eng.prefill_stats),
         },
     }
@@ -318,6 +320,97 @@ def child_serve(preflight=None):
             "load_ms_p50": round(pct(load_ms, 0.5), 1),
             "load_ms_p95": round(pct(load_ms, 0.95), 1),
         }
+    if preflight is not None:
+        line["preflight"] = preflight
+    print(json.dumps(line), flush=True)
+
+
+def child_replay(preflight=None):
+    """DTX_BENCH_REPLAY=1: the trace-driven load-replay + chaos harness
+    (datatunerx_tpu/loadgen/) against a 2-replica in-process fleet of REAL
+    BatchedEngines behind a real Gateway — one /admin/drain injected
+    mid-run — judged by the SLO epilogue. The line carries client-side
+    TTFT/latency percentiles and the SLO verdict with any violated
+    objective NAMED, which scripts/bench_job_summary.py lifts into the GH
+    job summary. CPU numbers are smoke-only, like the serve bench."""
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.loadgen.chaos import ChaosInjector
+    from datatunerx_tpu.loadgen.replay import (
+        LocalClient,
+        ReplayRunner,
+        slo_epilogue,
+    )
+    from datatunerx_tpu.loadgen.workload import WorkloadModel, summarize
+    from datatunerx_tpu.obs.slo import SLOEvaluator, default_slos
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = "tinyllama-1.1b" if on_tpu else "debug"
+    max_seq = 1024 if on_tpu else 256
+    n_requests = int(os.environ.get("DTX_BENCH_REPLAY_REQUESTS",
+                                    "24" if on_tpu else "12"))
+    rps = float(os.environ.get("DTX_BENCH_REPLAY_RPS", "8"))
+    engines = [
+        BatchedEngine(f"preset:{model}", template="vanilla",
+                      max_seq_len=max_seq, slots=2, decode_chunk=4)
+        for _ in range(2)  # shared program memo: second engine is cheap
+    ]
+    pool = ReplicaPool([InProcessReplica(f"replica-{i}", e)
+                        for i, e in enumerate(engines)])
+    gw = Gateway(pool, model_name=f"preset:{model}")
+    try:
+        # tiny prompts: the replay measures the HARNESS + scheduler under
+        # churn, not model quality; compile once before the clock starts
+        engines[0].generate(engines[0].tokenizer.encode("warm up"),
+                            max_new_tokens=2)
+        wl = WorkloadModel(requests=n_requests, sessions=3, rps=rps,
+                           seed=7, prompt_chars=40, prompt_cap_chars=200,
+                           output_tokens=6, output_cap_tokens=12)
+        events = wl.generate()
+        mid = events[-1]["t"] * 0.5
+        chaos = ChaosInjector(
+            [{"t": round(mid, 3), "op": "drain", "replica": "replica-1"}],
+            {"drain": lambda op: {"drained": gw.drain(op["replica"])}})
+        runner = ReplayRunner(LocalClient(gw), max_inflight=8)
+        evaluator = SLOEvaluator(runner.registry, default_slos("loadgen"))
+        t0 = time.perf_counter()
+        report = runner.run(events, chaos=chaos)
+        wall = time.perf_counter() - t0
+        verdict = slo_epilogue(evaluator, since_t=0.0,
+                               out=lambda s: print(s, file=sys.stderr))
+    finally:
+        gw.close()
+
+    line = {
+        "metric": f"replay_requests_per_sec[{model},2replicas,drain]",
+        "value": round(report["requests"] / wall, 2) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "vs_baseline": None,
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
+        "replay": {
+            "workload": summarize(events),
+            "requests": report["requests"],
+            "errors": report["errors"],
+            "codes": report["codes"],
+            "ttft_ms_p50": report["ttft_ms_p50"],
+            "ttft_ms_p95": report["ttft_ms_p95"],
+            "ttft_ms_p99": report["ttft_ms_p99"],
+            "latency_ms_p99": report["latency_ms_p99"],
+            "chaos": report.get("chaos", []),
+            "slo_pass": verdict["pass"],
+            "slo_violations": verdict["violations"],
+        },
+    }
     if preflight is not None:
         line["preflight"] = preflight
     print(json.dumps(line), flush=True)
@@ -560,7 +653,11 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("DTX_BENCH_SERVE"):
+    if os.environ.get("DTX_BENCH_REPLAY"):
+        # replay mode: loadgen harness against an in-process fleet, with
+        # the same per-phase pre-flight diagnosis on its line
+        child_replay(preflight=_preflight_probe())
+    elif os.environ.get("DTX_BENCH_SERVE"):
         # serve mode is its own entry (no orchestrator): probe first so the
         # serve line carries the same per-phase pre-flight diagnosis
         child_serve(preflight=_preflight_probe())

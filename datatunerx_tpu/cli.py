@@ -25,6 +25,12 @@ Talks to the operator's REST API (operator/apiserver.py):
                                        host-sync, retrace, sharding, and
                                        lock-discipline rules; exits 1 on
                                        findings (the tier-1 CI gate)
+  dtx replay [--url U | --selftest]    trace-driven load replay + chaos
+                                       harness (loadgen/): heavy-tail
+                                       multi-turn adapter-churning traffic,
+                                       fault injection over the admin
+                                       surfaces, SLO epilogue that exits
+                                       nonzero naming violated objectives
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.
@@ -262,13 +268,13 @@ def cmd_experiment(args):
     return experiment_main(argv)
 
 
-def _lint_tail(argv):
-    """The argv tail after ``lint`` when lint is the subcommand — allowing
-    the one global option (``--server``) before it — else None. dtxlint's
-    flags must bypass argparse entirely: a REMAINDER positional drops
-    leading optionals like ``--format``, so `dtx lint` dispatches before
-    parsing and every `dtx [--server X] lint …` form behaves exactly like
-    the `dtxlint` console script."""
+def _passthrough_tail(argv, cmd):
+    """The argv tail after ``cmd`` when it is the subcommand — allowing
+    the one global option (``--server``) before it — else None. Both
+    ``lint`` (dtxlint) and ``replay`` (loadgen) own their full flag
+    surface, so they must bypass dtx's argparse entirely: a REMAINDER
+    positional drops leading optionals like ``--format``/``--url``, so
+    these subcommands dispatch before parsing."""
     i = 0
     while i < len(argv):
         tok = argv[i]
@@ -278,7 +284,7 @@ def _lint_tail(argv):
         if tok.startswith("--server="):
             i += 1
             continue
-        return argv[i + 1:] if tok == "lint" else None
+        return argv[i + 1:] if tok == cmd else None
     return None
 
 
@@ -288,6 +294,13 @@ def cmd_lint(args):
     from datatunerx_tpu.analysis.cli import main as lint_main
 
     return lint_main([])
+
+
+def cmd_replay(args):
+    # unreachable like cmd_lint — main() dispatches replay before argparse
+    from datatunerx_tpu.loadgen.replay import main as replay_main
+
+    return replay_main([])
 
 
 def cmd_install(args):
@@ -330,11 +343,16 @@ def cmd_install(args):
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
-    lint_tail = _lint_tail(argv)
+    lint_tail = _passthrough_tail(argv, "lint")
     if lint_tail is not None:
         from datatunerx_tpu.analysis.cli import main as lint_main
 
         return lint_main(lint_tail)
+    replay_tail = _passthrough_tail(argv, "replay")
+    if replay_tail is not None:
+        from datatunerx_tpu.loadgen.replay import main as replay_main
+
+        return replay_main(replay_tail)
     p = argparse.ArgumentParser(prog="dtx")
     p.add_argument("--server", default=os.environ.get("DTX_SERVER",
                                                       "http://127.0.0.1:8080"))
@@ -427,6 +445,13 @@ def main(argv=None):
         help="JAX-aware static analysis (dtxlint); args pass through",
         add_help=False)
     xp.set_defaults(fn=cmd_lint)
+
+    rp = sub.add_parser(
+        "replay",
+        help="trace-driven load replay + chaos harness with SLO verdict "
+             "(loadgen/); args pass through",
+        add_help=False)
+    rp.set_defaults(fn=cmd_replay)
 
     ip = sub.add_parser(
         "install",

@@ -117,6 +117,22 @@ class HostPrefetcher:
                                         daemon=True)
         self._thread.start()
 
+    def resize(self, depth: int) -> int:
+        """Grow (or shrink) the bounded queue LIVE — the in-run half of the
+        prefetch advisory: when pipe_step_wait_ms says the step loop starves,
+        the running prefetcher deepens without restarting the epoch. Queue
+        mutation under the queue's own mutex; a worker blocked on put() is
+        woken by not_full so new headroom is used immediately. Shrinking
+        never drops batches — the queue just stops refilling until it
+        drains below the new bound."""
+        depth = int(depth)
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        with self._q.mutex:
+            self._q.maxsize = depth
+            self._q.not_full.notify_all()
+        return depth
+
     # ------------------------------------------------------------- worker
     def _put(self, item) -> bool:
         """Queue-put that stays responsive to close(); False = shutting down."""

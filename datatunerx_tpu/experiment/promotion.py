@@ -62,6 +62,15 @@ class PromotionConfig:
     # sub-millisecond p95s is scheduling noise, not a regression signal
     # (in-process test fleets measure tens of µs — 2x jitter is routine)
     min_fleet_p95_ms: float = 1.0
+    # SLO-verdict mode (obs/slo.py): spec dicts evaluated over the
+    # GATEWAY's registry with each stage as the window — the same evaluator
+    # /debug/slo serves, so canary judgment and fleet SLOs are one code
+    # path. Additive to the error-rate/latency guards above.
+    slos: tuple = ()
+    # evidence floor for the SLO guard, mirroring min_requests: a stage
+    # window whose SLI saw fewer total events than this abstains — one
+    # transient fleet 5xx in a thin window must not kill a promotion
+    slo_min_events: int = 10
 
     @classmethod
     def from_dict(cls, d: dict) -> "PromotionConfig":
@@ -78,10 +87,16 @@ class PromotionConfig:
                         ("min_requests", "min_requests"),
                         ("max_error_rate", "max_error_rate"),
                         ("max_latency_ratio", "max_latency_ratio"),
-                        ("min_fleet_p95_ms", "min_fleet_p95_ms")):
+                        ("min_fleet_p95_ms", "min_fleet_p95_ms"),
+                        ("slo_min_events", "slo_min_events")):
             if d.get(k) is not None:
                 kw[attr] = type(getattr(cls, attr, 0.0))(d[k]) \
                     if not isinstance(d[k], bool) else d[k]
+        if d.get("slos"):
+            from datatunerx_tpu.obs.slo import parse_slos
+
+            parse_slos(list(d["slos"]))  # fail loud on bad specs, HERE
+            kw["slos"] = tuple(d["slos"])
         return cls(**kw)
 
 
@@ -115,6 +130,15 @@ class PromotionController:
         self.stage = -1            # index into config.schedule
         self.reason = ""
         self._window = _StageWindow()
+        # SLO-verdict mode: one evaluator over the gateway's registry for
+        # the whole promotion; each stage begins with a sample() so the
+        # guard judges exactly the stage's own traffic
+        self.slo_eval = None
+        if self.config.slos:
+            from datatunerx_tpu.obs.slo import SLOEvaluator, parse_slos
+
+            self.slo_eval = SLOEvaluator(
+                gateway.registry, parse_slos(list(self.config.slos)))
         self._lock = threading.Lock()
         self._root = gateway.tracer.start(
             "promotion", trace_id=self.trace_id,
@@ -156,6 +180,8 @@ class PromotionController:
         self.stage = idx
         self.state = SHIFTING
         self._apply_weights(w)
+        if self.slo_eval is not None:
+            self.slo_eval.sample()  # the stage IS the SLO window
         canary_stats = self.canary.outcome_stats()
         self._window = _StageWindow(
             started_at=time.monotonic(),
@@ -194,8 +220,20 @@ class PromotionController:
         return (max(windows) if windows else 0.0, total)
 
     def _regressed(self, stats: dict) -> Optional[str]:
+        # SLO verdicts first, BEFORE the canary-traffic gate: the SLOs
+        # judge the gateway's whole registry over the stage window, so a
+        # fleet-wide breach must roll back even a stage that routed zero
+        # requests to the canary
+        if self.slo_eval is not None:
+            from datatunerx_tpu.obs.slo import violations
+
+            judgeable = [v for v in self.slo_eval.verdicts()
+                         if v.get("total", 0) >= self.config.slo_min_events]
+            broken = violations(judgeable)
+            if broken:
+                return broken[0]  # rollback reason NAMES the objective
         if stats["requests"] == 0:
-            return None  # nothing to judge
+            return None  # nothing else to judge
         if stats["error_rate"] > self.config.max_error_rate:
             return (f"canary error rate {stats['error_rate']:.2%} > "
                     f"{self.config.max_error_rate:.2%} over "
@@ -286,7 +324,7 @@ class PromotionController:
 
     # ------------------------------------------------------------- reports
     def status(self) -> dict:
-        return {
+        out = {
             "canary": self.canary_name,
             "state": self.state,
             "stage": self.stage,
@@ -295,3 +333,9 @@ class PromotionController:
             "reason": self.reason,
             "trace_id": self.trace_id,
         }
+        if self.slo_eval is not None:
+            out["slos"] = [
+                {"name": v["name"], "compliant": v["compliant"],
+                 "compliance": v["compliance"]}
+                for v in self.slo_eval.verdicts()]
+        return out

@@ -24,7 +24,10 @@ import urllib.error
 import urllib.request
 from typing import Dict, Iterator, List, Optional
 
-from datatunerx_tpu.obs.metrics import sample_percentile
+from datatunerx_tpu.obs.metrics import (
+    annotation_start,
+    sample_percentile,
+)
 
 
 class ReplicaError(Exception):
@@ -38,6 +41,16 @@ class ReplicaError(Exception):
     def __init__(self, message: str, status: Optional[int] = None):
         super().__init__(message)
         self.status = status
+
+
+def _strip_annotation(line: str) -> str:
+    """Drop an OpenMetrics-style trailing annotation (`` # {…} v ts`` —
+    exemplars — or any future `` # …`` tail) from an exposition line, so a
+    new replica's exemplar-bearing /metrics can't break an older gateway's
+    stats scrape (and vice versa in a mixed-version fleet). Quote-aware
+    via the shared obs.metrics.annotation_start scanner."""
+    pos = annotation_start(line)
+    return line if pos < 0 else line[:pos].rstrip()
 
 
 def _adapter_label(line: str, prefix: str) -> Optional[str]:
@@ -527,6 +540,9 @@ class HTTPReplica(Replica):
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
                 for line in r.read().decode().splitlines():
+                    # exemplars / unknown trailing annotations are stripped
+                    # first: a mixed-version fleet must never break scraping
+                    line = _strip_annotation(line)
                     # *_capacity is the PR 7 name; *_total accepted so a new
                     # gateway can front not-yet-restarted older replicas
                     if line.startswith("dtx_serving_slots_busy "):

@@ -45,7 +45,12 @@ from datatunerx_tpu.gateway.replica_pool import (
     ReplicaPool,
 )
 from datatunerx_tpu.gateway.router import Router
-from datatunerx_tpu.obs.metrics import set_build_info, set_uptime
+from datatunerx_tpu.obs.metrics import (
+    exemplars_requested,
+    set_build_info,
+    set_uptime,
+)
+from datatunerx_tpu.obs.slo import SLOEvaluator, default_slos, load_slos
 from datatunerx_tpu.obs.trace import Span, Tracer, TraceStore
 from datatunerx_tpu.serving.local_backend import _free_port
 
@@ -58,7 +63,8 @@ class Gateway:
                  admission: Optional[AdmissionController] = None,
                  max_attempts: int = 3, model_name: str = "",
                  trace_ring: int = 256,
-                 trace_log_path: Optional[str] = None):
+                 trace_log_path: Optional[str] = None,
+                 slos=None):
         self.pool = pool
         self.router = Router(pool, policy=policy)
         self.admission = admission or AdmissionController()
@@ -94,6 +100,11 @@ class Gateway:
         # started by POST /admin/promote or ExperimentRunner
         self.promotion = None
         self._promotion_lock = threading.Lock()
+        # SLO plane (obs/slo.py): objectives over this registry's own
+        # request histograms/counters, judged at GET /debug/slo and restated
+        # as dtx_slo_* gauges on every /metrics scrape — the same evaluator
+        # class the promotion guard and the replay epilogue run
+        self.slo = SLOEvaluator(self.registry, slos or default_slos("gateway"))
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -164,7 +175,8 @@ class Gateway:
                                attempt=attempt)
                     if attempt == 0:
                         self._queue_wait.observe(
-                            (time.monotonic() - t0) * 1e3)
+                            (time.monotonic() - t0) * 1e3,
+                            trace_id=root.trace_id)
                     replica.acquire()
                     t_attempt = time.monotonic()
                     try:
@@ -173,7 +185,8 @@ class Gateway:
                         replica.breaker.record_success()
                         replica.record_outcome(
                             True, (time.monotonic() - t_attempt) * 1e3)
-                        self._latency.observe(time.monotonic() - t0)
+                        self._latency.observe(time.monotonic() - t0,
+                                              trace_id=root.trace_id)
                         root.set(replica=replica.name, attempts=attempt + 1)
                         self._finish_request_span(root)
                         return text
@@ -224,7 +237,8 @@ class Gateway:
                                attempt=attempt)
                     if attempt == 0:
                         self._queue_wait.observe(
-                            (time.monotonic() - t0) * 1e3)
+                            (time.monotonic() - t0) * 1e3,
+                            trace_id=root.trace_id)
                     replica.acquire()
                     skip = len(emitted)
                     t_attempt = time.monotonic()
@@ -245,7 +259,8 @@ class Gateway:
                         replica.breaker.record_success()
                         replica.record_outcome(
                             True, (time.monotonic() - t_attempt) * 1e3)
-                        self._latency.observe(time.monotonic() - t0)
+                        self._latency.observe(time.monotonic() - t0,
+                                              trace_id=root.trace_id)
                         root.set(replica=replica.name, attempts=attempt + 1,
                                  chars=len(emitted))
                         self._finish_request_span(root)
@@ -345,6 +360,13 @@ class Gateway:
                 f"replica {replica.name!r} does not support profiling")
         return out
 
+    def slo_report(self) -> dict:
+        """The /debug/slo body: every declared objective judged over its
+        burn-rate windows, from the same registry the request paths record
+        into (one evaluator — obs/slo.py — shared with the promotion guard
+        and the replay epilogue)."""
+        return self.slo.report(plane="gateway")
+
     # -------------------------------------------------------------- reports
     def healthy(self) -> bool:
         return len(self.pool.available()) > 0
@@ -367,14 +389,19 @@ class Gateway:
     def record_request(self, code: int):
         self._requests.inc({"code": str(code)})
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, with_exemplars: bool = True) -> str:
         with self._scrape_lock:
-            return self._metrics_text_locked()
+            return self._metrics_text_locked(with_exemplars)
 
-    def _metrics_text_locked(self) -> str:
+    def _metrics_text_locked(self, with_exemplars: bool = True) -> str:
         # re-state snapshot gauges at scrape time
         set_build_info(self.registry, "gateway")
         set_uptime(self.registry, "gateway", self.started_at)
+        # dtx_slo_* verdict gauges: SAMPLE first so window baselines keep
+        # advancing even when nothing polls /debug/slo and no background
+        # sampler runs — a scrape-only deployment still gets honest windows
+        self.slo.sample()
+        self.slo.restate_gauges(self.slo.evaluate())
         g = self.registry.gauge
         g("dtx_gateway_trace_open_spans",
           "Spans opened and not yet finished (a growing value means "
@@ -470,7 +497,7 @@ class Gateway:
                          {"replica": r.name, "outcome": "error"})
         for a, n in sorted(residency.items()):
             a_resident.set(n, {"adapter": a})
-        return self.registry.expose()
+        return self.registry.expose(with_exemplars=with_exemplars)
 
     # ------------------------------------------------------------ promotion
     def set_weight(self, name: str, weight: float) -> bool:
@@ -530,6 +557,7 @@ class Gateway:
         return False
 
     def close(self):
+        self.slo.stop()
         if self.replica_set is not None:
             self.replica_set.close()
         self.pool.close()
@@ -746,14 +774,20 @@ def make_handler(gw: Gateway):
                     self._json(404, {"error": "no promotion started"})
                 else:
                     self._json(200, status)
-            elif self.path == "/metrics":
-                body = self.gateway.metrics_text().encode()
+            elif self.path.split("?")[0] == "/metrics":
+                # exemplars only on the explicit ?exemplars=1 debug view:
+                # the annotation tail is a parse error to a classic
+                # Prometheus parser and would fail the WHOLE scrape
+                body = self.gateway.metrics_text(
+                    with_exemplars=exemplars_requested(self.path)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/debug/slo":
+                self._json(200, self.gateway.slo_report())
             elif self.path.startswith("/debug/trace/"):
                 tid = self.path[len("/debug/trace/"):]
                 doc = self.gateway.trace(tid) if tid else None
@@ -990,6 +1024,14 @@ def main(argv=None):
     p.add_argument("--trace_log", default="",
                    help="append every completed gateway span as one JSON "
                         "line to this file (offline trace forensics)")
+    p.add_argument("--slo_config", default="",
+                   help="JSON file of SLO specs (obs/slo.py format) judged "
+                        "at GET /debug/slo; default: the built-in gateway "
+                        "availability + latency objectives")
+    p.add_argument("--slo_sample_s", type=float, default=15.0,
+                   help="background SLO sampling interval so the burn-rate "
+                        "windows have history without a /debug/slo poller "
+                        "(0 disables the sampler)")
     p.add_argument("--replica_url", action="append", default=[],
                    help="front an EXISTING serving server (repeatable); "
                         "mutually exclusive with --replicas spawning")
@@ -1044,7 +1086,10 @@ def main(argv=None):
                      count_tokens=count_tokens),
                  model_name=args.model_path,
                  trace_ring=args.trace_ring,
-                 trace_log_path=args.trace_log or None)
+                 trace_log_path=args.trace_log or None,
+                 slos=load_slos(args.slo_config) if args.slo_config else None)
+    if args.slo_sample_s > 0:
+        gw.slo.start(args.slo_sample_s)
     for i, url in enumerate(args.replica_url):
         pool.add(HTTPReplica(f"replica-{i}", url))
     if args.replicas > 0:

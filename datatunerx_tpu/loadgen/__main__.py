@@ -1,0 +1,8 @@
+"""``python -m datatunerx_tpu.loadgen`` — the replay CLI."""
+
+import sys
+
+from datatunerx_tpu.loadgen.replay import main
+
+if __name__ == "__main__":
+    sys.exit(main())

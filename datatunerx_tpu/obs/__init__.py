@@ -13,6 +13,10 @@ Three pieces, one story — see what every plane of the platform is doing:
                    true TTFT/TPOT.
   obs.profiling  — on-demand N-second ``jax.profiler`` windows behind
                    ``POST /debug/profile`` (serving + gateway passthrough).
+  obs.slo        — declarative objectives over the registry's histograms
+                   and counters: multi-window burn-rate evaluation behind
+                   ``GET /debug/slo``, shared by the promotion guard and
+                   the load-replay epilogue.
 """
 
 from datatunerx_tpu.obs.metrics import (  # noqa: F401
@@ -26,6 +30,14 @@ from datatunerx_tpu.obs.metrics import (  # noqa: F401
     set_uptime,
 )
 from datatunerx_tpu.obs.profiling import Profiler, process_profiler  # noqa: F401
+from datatunerx_tpu.obs.slo import (  # noqa: F401
+    SLO,
+    SLOEvaluator,
+    default_slos,
+    load_slos,
+    parse_slos,
+    violations,
+)
 from datatunerx_tpu.obs.trace import (  # noqa: F401
     Span,
     Tracer,

@@ -50,6 +50,41 @@ def sample_percentile(values: Sequence[float], q: float) -> float:
     return s[idx]
 
 
+def annotation_start(line: str) -> int:
+    """Index where a `` # …`` annotation tail (exemplar or unknown) begins
+    on an exposition line, QUOTE-AWARE — a ``' # '`` inside a label value
+    is data, not an annotation. -1 when the line has none. The ONE scanner
+    shared by the gateway's replica scrape parser and the test/lint
+    exposition parser, so the two can't drift on the grammar."""
+    in_quotes = False
+    i = 0
+    n = len(line)
+    while i < n:
+        c = line[i]
+        if in_quotes:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == "#" and i >= 1 and line[i - 1] == " ":
+            return i - 1
+        i += 1
+    return -1
+
+
+def exemplars_requested(path: str) -> bool:
+    """Did the HTTP request path opt in to exemplar annotations with an
+    exact ``exemplars=1`` query parameter? Parsed, not substring-matched:
+    ``?no_exemplars=1`` must NOT enable the classic-parser-breaking tails."""
+    from urllib.parse import parse_qs, urlsplit
+
+    q = parse_qs(urlsplit(path or "").query)
+    return q.get("exemplars", ["0"])[-1] == "1"
+
+
 def escape_label_value(v: str) -> str:
     return (str(v).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
@@ -88,11 +123,27 @@ class Metric:
         with self._lock:
             return self._series.get(self._key(labels), 0.0)
 
+    def series(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Snapshot of every series (label-tuple key → value) — the SLO
+        evaluator samples counters through this instead of groping
+        ``_series`` under someone else's lock discipline."""
+        with self._lock:
+            return dict(self._series)
+
     def clear(self):
         """Drop all series (per-replica gauges are re-stated each scrape so
         removed replicas don't linger as stale series)."""
         with self._lock:
             self._series.clear()
+
+    def replace(self, values: "Sequence[Tuple[Optional[dict], float]]"):
+        """Swap the FULL series set atomically ([(labels, value), …]) — the
+        restate-at-sample-time path (SLO gauges) uses this instead of
+        clear()+set() so a concurrent expose() sees either the old or the
+        new complete set, never a half-restated one."""
+        new = {self._key(labels): float(v) for labels, v in values}
+        with self._lock:
+            self._series = new
 
     def expose(self) -> List[str]:
         lines = []
@@ -107,7 +158,14 @@ class Metric:
 
 
 class Histogram:
-    """Cumulative-bucket histogram (classic Prometheus shape)."""
+    """Cumulative-bucket histogram (classic Prometheus shape).
+
+    ``observe(value, trace_id=...)`` additionally keeps the LAST exemplar
+    per bucket — an OpenMetrics-style ``# {trace_id="dtx-…"} value ts``
+    annotation on the bucket line — so a p99 bucket links straight to the
+    request trace behind it (``GET /debug/trace/<id>``). With no trace id
+    the observe path is byte-identical to before: no allocation, no extra
+    branch work beyond one falsy check."""
 
     def __init__(self, name: str, help_text: str = "",
                  buckets: Sequence[float] = LATENCY_BUCKETS):
@@ -119,15 +177,20 @@ class Histogram:
         self._counts = [0] * len(self.buckets)
         self._sum = 0.0
         self._total = 0
+        # bucket index → (trace_id, observed value, unix ts); populated
+        # lazily — a histogram that never sees a trace id never pays for it
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float):
+    def observe(self, value: float, trace_id: Optional[str] = None):
         with self._lock:
             self._sum += value
             self._total += 1
             for i, edge in enumerate(self.buckets):
                 if value <= edge:
                     self._counts[i] += 1
+                    if trace_id:
+                        self._exemplars[i] = (trace_id, value, time.time())
                     break
 
     def percentile(self, q: float) -> float:
@@ -151,7 +214,30 @@ class Histogram:
         with self._lock:
             return self._total
 
-    def expose(self) -> List[str]:
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Snapshot of (upper edge, CUMULATIVE count) pairs plus implicit
+        total — the SLO evaluator's windowed good/total deltas come from
+        subtracting two of these."""
+        with self._lock:
+            out = []
+            cumulative = 0
+            for i, edge in enumerate(self.buckets):
+                cumulative += self._counts[i]
+                out.append((edge, cumulative))
+            return out
+
+    def exemplars(self) -> Dict[float, Tuple[str, float, float]]:
+        """Upper edge → (trace_id, observed value, unix ts) for every bucket
+        holding an exemplar."""
+        with self._lock:
+            return {self.buckets[i]: ex for i, ex in self._exemplars.items()}
+
+    def expose(self, with_exemplars: bool = True) -> List[str]:
+        """``with_exemplars=False`` emits the classic 0.0.4 exposition.
+        The HTTP servers default the WIRE to False (an exemplar tail is a
+        parse error to a classic Prometheus parser, which would fail the
+        whole scrape) and include exemplars only on the explicit
+        ``/metrics?exemplars=1`` debug view."""
         lines = []
         if self.help_text:
             lines.append(f"# HELP {self.name} {self.help_text}")
@@ -161,8 +247,14 @@ class Histogram:
             for i, edge in enumerate(self.buckets):
                 cumulative += self._counts[i]
                 le = "+Inf" if edge == float("inf") else repr(edge)
-                lines.append(format_sample(
-                    f"{self.name}_bucket", {"le": le}, cumulative))
+                line = format_sample(
+                    f"{self.name}_bucket", {"le": le}, cumulative)
+                ex = self._exemplars.get(i) if with_exemplars else None
+                if ex is not None:
+                    tid, val, ts = ex
+                    line += (f' # {{trace_id="{escape_label_value(tid)}"}} '
+                             f"{val} {round(ts, 3)}")
+                lines.append(line)
             lines.append(f"{self.name}_sum {self._sum}")
             lines.append(f"{self.name}_count {self._total}")
         return lines
@@ -196,12 +288,22 @@ class Registry:
                 self._metrics[name] = m
             return m
 
-    def expose(self) -> str:
+    def get(self, name: str):
+        """The registered metric object, or None — for read-only consumers
+        (the SLO evaluator) that must not implicitly declare a series just
+        by asking about it."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self, with_exemplars: bool = True) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
         lines: List[str] = []
         for m in metrics:
-            lines.extend(m.expose())
+            if isinstance(m, Histogram):
+                lines.extend(m.expose(with_exemplars=with_exemplars))
+            else:
+                lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
 

@@ -1102,10 +1102,16 @@ class BatchedEngine:
         tracing on, the request's span timeline into the trace ring."""
         n = len(req.tokens)
         if req.first_token_ts is not None:
-            self._h_ttft.observe((req.first_token_ts - req.t_submit) * 1e3)
+            # exemplar only when tracing: the trace id is then resolvable at
+            # GET /debug/trace/<id>, and the tracing-off observe stays the
+            # bare-arithmetic path (token-parity test's no-overhead contract)
+            tid = req.trace_id if self.tracing else None
+            self._h_ttft.observe((req.first_token_ts - req.t_submit) * 1e3,
+                                 trace_id=tid)
             if req.last_token_ts is not None and n > 1:
                 self._h_tpot.observe(
-                    (req.last_token_ts - req.first_token_ts) / (n - 1) * 1e3)
+                    (req.last_token_ts - req.first_token_ts) / (n - 1) * 1e3,
+                    trace_id=tid)
         if self.tracing:
             span = build_request_span(
                 req.trace_id, req.t_submit, req.timeline,

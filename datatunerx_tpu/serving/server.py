@@ -23,10 +23,12 @@ from typing import Optional
 from datatunerx_tpu.obs.metrics import (
     Registry,
     adapter_load_histogram,
+    exemplars_requested,
     serving_latency_histograms,
     set_build_info,
     set_uptime,
 )
+from datatunerx_tpu.obs.slo import SLOEvaluator, default_slos
 
 
 class ServingState:
@@ -42,25 +44,45 @@ class ServingState:
         # serializes scrape-time gauge restating (concurrent scrapes would
         # race clear/set on the labeled counters)
         self.scrape_lock = threading.Lock()
+        # SLO evaluator over this registry (obs/slo.py) — built lazily so
+        # tests driving the Handler directly get a working /debug/slo, and
+        # main() can install a --slo_config set before the first request
+        self.slo: Optional[SLOEvaluator] = None
+        self.slo_lock = threading.Lock()
 
 
 STATE = ServingState()
 
 
-def metrics_text() -> str:
+def slo_evaluator() -> SLOEvaluator:
+    """The server's evaluator, created on first use with the default
+    serving objectives unless main() already installed a configured one."""
+    with STATE.slo_lock:
+        if STATE.slo is None:
+            STATE.slo = SLOEvaluator(STATE.registry, default_slos("serving"))
+        return STATE.slo
+
+
+def metrics_text(with_exemplars: bool = True) -> str:
     """The /metrics body: scrape-time gauges re-stated into the shared
     registry next to the engine's live histograms. Factored off the HTTP
     handler so scripts/metrics_lint.py validates the same bytes a scraper
-    sees."""
+    sees. The HTTP wire defaults to with_exemplars=False (classic-parser
+    safety); ``/metrics?exemplars=1`` opts in."""
     with STATE.scrape_lock:
-        return _metrics_text_locked()
+        return _metrics_text_locked(with_exemplars)
 
 
-def _metrics_text_locked() -> str:
+def _metrics_text_locked(with_exemplars: bool = True) -> str:
     reg = STATE.registry
     eng = STATE.engine
     set_build_info(reg, "serving")
     set_uptime(reg, "serving", STATE.started_at)
+    # dtx_slo_* verdict gauges: sample FIRST so window baselines advance
+    # under scrape-only deployments (no /debug/slo poller, no sampler)
+    ev = slo_evaluator()
+    ev.sample()
+    ev.restate_gauges(ev.evaluate())
     # declare the serving latency histograms even before the engine loads:
     # a scraper sees stable series from the first scrape (zero counts), and
     # an engine sharing this registry observes into these same objects
@@ -182,7 +204,7 @@ def _metrics_text_locked() -> str:
                 reqs = dict(raw)
     for name, n in sorted((reqs or {}).items()):
         a_reqs.set(n, {"adapter": name})
-    return reg.expose()
+    return reg.expose(with_exemplars=with_exemplars)
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -219,10 +241,14 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path == "/v1/models":
             self._json(200, {"object": "list", "data": [
                 {"id": STATE.model_path, "object": "model"}]})
-        elif self.path == "/metrics":
+        elif self.path.split("?")[0] == "/metrics":
             self._metrics()
         elif self.path == "/admin/adapters":
             self._adapters_get()
+        elif self.path == "/debug/slo":
+            # same evaluator/report shape as the gateway's /debug/slo —
+            # obs/slo.py is the single verdict implementation
+            self._json(200, slo_evaluator().report(plane="serving"))
         elif self.path.startswith("/debug/trace/"):
             self._debug_trace(self.path[len("/debug/trace/"):])
         else:
@@ -324,8 +350,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def _metrics(self):
         """Prometheus text exposition from the shared registry (obs.metrics):
-        engine latency histograms + scrape-time gauges, one encoder."""
-        body = metrics_text().encode()
+        engine latency histograms + scrape-time gauges, one encoder.
+        Exemplar annotations only on the ?exemplars=1 debug view (classic
+        parsers reject the tail)."""
+        # getattr: tests drive a bare Handler (no request line, no path)
+        body = metrics_text(
+            with_exemplars=exemplars_requested(
+                getattr(self, "path", ""))).encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
         self.send_header("Content-Length", str(len(body)))
@@ -670,7 +701,23 @@ def main(argv=None):
     p.add_argument("--trace_log", default="",
                    help="append every completed request span as one JSON "
                         "line to this file (offline trace forensics)")
+    p.add_argument("--slo_config", default="",
+                   help="JSON file of SLO specs (obs/slo.py format) judged "
+                        "at GET /debug/slo; default: built-in serving "
+                        "availability + TTFT objectives")
+    p.add_argument("--slo_sample_s", type=float, default=15.0,
+                   help="background SLO sampling interval (0 = sample only "
+                        "on /debug/slo)")
     args = p.parse_args(argv)
+
+    if args.slo_config:
+        from datatunerx_tpu.obs.slo import load_slos
+
+        with STATE.slo_lock:
+            STATE.slo = SLOEvaluator(STATE.registry,
+                                     load_slos(args.slo_config))
+    if args.slo_sample_s > 0:
+        slo_evaluator().start(args.slo_sample_s)
 
     load_engine_async(args.model_path, args.checkpoint_path, args.template,
                       args.max_seq_len, quantization=args.quantization,

@@ -157,6 +157,12 @@ class MetricsLogger:
             os.environ.get("DTX_PREFETCH_ADVISE_RECORDS", "20"))
         self._advise_ms = float(
             os.environ.get("DTX_PREFETCH_ADVISE_MS", "5.0"))
+        # in-run retuning (the ROADMAP's "remaining piece"): when the live
+        # HostPrefetcher is attached, the advisory doesn't just print — it
+        # RESIZES the running prefetcher's bounded queue to the suggested
+        # depth (DTX_PREFETCH_RETUNE=0 reverts to advise-only)
+        self._prefetcher = None
+        self._retune = os.environ.get("DTX_PREFETCH_RETUNE", "1") != "0"
         # Shared-registry mirror of the training plane (obs/metrics.py, PR 7):
         # every logged record re-states dtx_train_*/dtx_eval_* gauges —
         # including the pipeline-health signals pipe_step_wait_ms and
@@ -204,6 +210,22 @@ class MetricsLogger:
         with open(os.path.join(self.watch_dir, filename), "a") as f:
             f.write(json.dumps(record) + "\n")
 
+    def attach_prefetcher(self, prefetcher) -> None:
+        """Hand the logger the LIVE HostPrefetcher (anything with a
+        ``resize(depth)``) so the advisory can act in-run instead of only
+        suggesting a flag for next time. Re-attach per epoch — the trainer
+        rebuilds its prefetcher at epoch boundaries; the current effective
+        depth carries over via ``effective_prefetch_depth``."""
+        self._prefetcher = prefetcher
+
+    def effective_prefetch_depth(self) -> Optional[int]:
+        """The depth the NEXT prefetcher should be built with: the retuned
+        value once retuning acted, else the configured depth."""
+        adv = self.prefetch_advisory
+        if adv and adv.get("retuned"):
+            return adv["suggested_prefetch_depth"]
+        return self.prefetch_depth
+
     def _maybe_advise_prefetch(self, metrics: Dict[str, float]):
         """Once per run: when dtx_train_pipe_step_wait_ms p95 over the last
         DTX_PREFETCH_ADVISE_RECORDS logged records exceeds
@@ -233,18 +255,33 @@ class MetricsLogger:
             "records": len(window),
             "prefetch_depth": depth,
             "suggested_prefetch_depth": suggested,
+            "retuned": False,
         }
+        # act, don't just advise: resize the live prefetcher's queue to the
+        # suggested depth (this epoch benefits; effective_prefetch_depth
+        # carries it into the next epoch's prefetcher)
+        retuned = False
+        if self._retune and self._prefetcher is not None:
+            try:
+                self._prefetcher.resize(suggested)
+                retuned = True
+            except Exception:  # noqa: BLE001 — advisory must never kill a run
+                pass
+        self.prefetch_advisory["retuned"] = retuned
         self.registry.gauge(
             "dtx_train_prefetch_depth_suggested",
             "Advisory: a deeper --prefetch_depth would likely hide input "
             "stalls (0 = no advisory fired).").set(
             suggested, {"uid": self.uid} if self.uid else None)
+        acted = (f"; retuned the live prefetcher to depth {suggested}"
+                 if retuned else
+                 f"; try --prefetch_depth {suggested}")
         print(
             f"[advice] input pipeline stalls: pipe_step_wait_ms p95="
             f"{p95:.1f}ms over the last {len(window)} records exceeds "
             f"{self._advise_ms:g}ms — the step loop is waiting on the "
-            f"input path; try --prefetch_depth {suggested}"
-            + (f" (currently {depth})" if depth else ""),
+            f"input path{acted}"
+            + (f" (configured {depth})" if depth else ""),
             flush=True)
 
     def log_train(self, step: int, metrics: Dict[str, float]):

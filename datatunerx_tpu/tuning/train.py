@@ -394,9 +394,15 @@ def run(args: TrainArgs) -> dict:
             batches, host_pf = prefetch_batches(
                 src,
                 place_fn=lambda b: place_batch(b, mesh, accum=accum_batches),
-                depth=args.prefetch_depth,
+                # a retuned depth survives epoch boundaries: the advisory's
+                # live resize carries into every later epoch's prefetcher
+                depth=logger.effective_prefetch_depth()
+                or args.prefetch_depth,
                 stats=pipe_stats,
             )
+            # hand the LIVE prefetcher to the advisory so it retunes the
+            # bounded queue in-run instead of only printing a flag
+            logger.attach_prefetcher(host_pf)
         else:
             batches = src
         try:

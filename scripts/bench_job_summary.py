@@ -49,15 +49,18 @@ def main() -> int:
                     f"attempts={pf.get('attempts')} {shown}")
             if hung:
                 row += f" — **died in `{hung}`**"
-        # serving latency distribution: the p50/p95 TTFT/TPOT the serve
-        # smoke exists to surface
+        # serving latency distribution: the p50/p95/p99 TTFT/TPOT the
+        # serve smoke exists to surface (p99 is where chunked-prefill
+        # head-of-line damage shows first)
         sv = last.get("serve")
         if isinstance(sv, dict):
             row += ("\n  - serve: "
                     f"ttft p50={sv.get('ttft_ms_p50')}ms "
-                    f"p95={sv.get('ttft_ms_p95')}ms · "
+                    f"p95={sv.get('ttft_ms_p95')}ms "
+                    f"p99={sv.get('ttft_ms_p99')}ms · "
                     f"tpot p50={sv.get('tpot_ms_p50')}ms "
-                    f"p95={sv.get('tpot_ms_p95')}ms · "
+                    f"p95={sv.get('tpot_ms_p95')}ms "
+                    f"p99={sv.get('tpot_ms_p99')}ms · "
                     f"requests={sv.get('requests')} "
                     f"errors={sv.get('errors')}")
             # adapter-churn mode: residency hit rate + load latency are the
@@ -71,6 +74,25 @@ def main() -> int:
                         f"evictions={ad.get('evictions')} · "
                         f"load p50={ad.get('load_ms_p50')}ms "
                         f"p95={ad.get('load_ms_p95')}ms")
+        # load-replay mode: the SLO verdict IS the headline — a chaos run
+        # whose objectives held, or the violated objectives by name
+        rp = last.get("replay")
+        if isinstance(rp, dict):
+            chaos_ops = " ".join(
+                f"{c.get('op')}@{c.get('t')}s" for c in rp.get("chaos", []))
+            row += ("\n  - replay: "
+                    f"requests={rp.get('requests')} "
+                    f"errors={rp.get('errors')} · "
+                    f"ttft p50={rp.get('ttft_ms_p50')}ms "
+                    f"p95={rp.get('ttft_ms_p95')}ms "
+                    f"p99={rp.get('ttft_ms_p99')}ms · "
+                    f"chaos: {chaos_ops or 'none'}")
+            if rp.get("slo_pass"):
+                row += "\n  - replay SLO verdict: **PASS**"
+            else:
+                names = "; ".join(rp.get("slo_violations") or []) \
+                    or "unknown objective"
+                row += f"\n  - replay SLO verdict: **FAIL** — {names}"
     summary = os.environ.get("GITHUB_STEP_SUMMARY")
     if summary:
         with open(summary, "a", encoding="utf-8") as f:

@@ -1,11 +1,14 @@
-"""Exposition-format + naming lint for the gateway, serving and experiment
-/metrics.
+"""Exposition-format + naming lint for the gateway, serving, experiment
+and loadgen /metrics.
 
 Builds each plane's exposition IN PROCESS (the same bytes a scraper gets:
 `Gateway.metrics_text()` — including the per-replica traffic-weight and
-attempt-outcome series the canary promotion reads — the serving server's
-`metrics_text()` against a duck-typed engine, and an `ExperimentMetrics`
-registry driven through one simulated closed-loop pass), then validates:
+attempt-outcome series the canary promotion reads, plus the dtx_slo_*
+verdict gauges — the serving server's `metrics_text()` against a
+duck-typed engine, an `ExperimentMetrics` registry driven through one
+simulated closed-loop pass, and a load-replay recording pass whose TTFT
+histogram carries a trace-id exemplar so the OpenMetrics exemplar format
+stays under this blocking gate), then validates:
 
   format  — the invariants a real Prometheus server enforces: one # TYPE
             line per metric preceding all its samples, no duplicate
@@ -31,6 +34,10 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # metrics whose name carries no plane prefix on purpose (shared identity /
 # process series stated by obs.metrics on every plane)
 SHARED_NAMES = {"dtx_build_info"}
+# shared FAMILIES: the SLO verdict gauges (obs/slo.py) are restated into
+# every plane's registry under one name so dashboards join them across
+# planes on the {slo} label
+SHARED_PREFIXES = ("dtx_slo_",)
 # words that mean "this samples a duration" and demand a unit suffix
 TIME_WORDS = ("latency", "wait", "duration", "uptime", "elapsed", "ttft",
               "tpot")
@@ -54,10 +61,12 @@ def lint_exposition(text: str, plane: str):
         if not name.startswith("dtx_"):
             findings.append(f"{where}: missing dtx_ prefix")
         elif (name not in SHARED_NAMES
+              and not name.startswith(SHARED_PREFIXES)
               and not name.startswith(f"dtx_{plane}_")):
             findings.append(
                 f"{where}: missing plane prefix dtx_{plane}_ (shared "
-                "names must be registered in metrics_lint SHARED_NAMES)")
+                "names must be registered in metrics_lint SHARED_NAMES "
+                "or SHARED_PREFIXES)")
         if mtype == "counter" and not name.endswith("_total"):
             findings.append(f"{where}: counter must end in _total")
         if mtype != "counter" and name.endswith("_total"):
@@ -131,6 +140,35 @@ def serving_exposition() -> str:
         serving.STATE.engine = old_engine
 
 
+def loadgen_exposition() -> str:
+    """Drive the load-replay recording path once (a stub client, no
+    sockets) so every dtx_loadgen_* series AND the dtx_slo_* verdict
+    gauges are built and linted — including at least one trace-id exemplar
+    on the TTFT histogram, which keeps the exemplar exposition format
+    under the blocking lint."""
+    from datatunerx_tpu.loadgen.replay import ReplayRunner
+    from datatunerx_tpu.obs.slo import SLOEvaluator, default_slos
+
+    class _StubClient:
+        def send(self, event, trace_id):
+            code = 503 if event.get("fail") else 200
+            return {"code": code, "error": None, "chars": 8,
+                    "ttft_ms": 12.5, "latency_ms": 40.0}
+
+    runner = ReplayRunner(_StubClient(), max_inflight=2)
+    evaluator = SLOEvaluator(runner.registry, default_slos("loadgen"))
+    runner.run([{"t": 0.0, "messages": [{"role": "user", "content": "x"}]},
+                {"t": 0.0, "messages": [{"role": "user", "content": "y"}],
+                 "fail": True}])
+    evaluator.restate_gauges(evaluator.evaluate())
+    text = runner.registry.expose()
+    if ' # {trace_id="' not in text:
+        raise AssertionError(
+            "loadgen exposition carries no trace-id exemplar — the "
+            "exemplar contract regressed")
+    return text
+
+
 def experiment_exposition() -> str:
     """Drive every ExperimentMetrics recording path once so each
     dtx_experiment_* series exposes real samples."""
@@ -155,7 +193,8 @@ def main() -> int:
     findings = []
     for plane, build in (("gateway", gateway_exposition),
                          ("serving", serving_exposition),
-                         ("experiment", experiment_exposition)):
+                         ("experiment", experiment_exposition),
+                         ("loadgen", loadgen_exposition)):
         try:
             text = build()
         except Exception as e:  # noqa: BLE001 — a crash IS the finding
@@ -165,8 +204,8 @@ def main() -> int:
     for f in findings:
         print(f"metrics-lint: {f}")
     if not findings:
-        print("metrics-lint: gateway + serving + experiment expositions "
-              "clean")
+        print("metrics-lint: gateway + serving + experiment + loadgen "
+              "expositions clean")
     return 1 if findings else 0
 
 

@@ -366,3 +366,93 @@ def test_pipelined_trainer_losses_match_inline(devices8):
         return out
 
     assert losses(False) == losses(True)
+
+
+# --------------------------------------------------------- in-run retuning
+
+def test_host_prefetcher_resize_deepens_live_queue():
+    """resize() grows the bounded queue while the worker runs: the worker
+    immediately fills the new headroom, order is preserved, nothing drops."""
+    src = CountingSource(100)
+    pf = HostPrefetcher(src, depth=2)
+    try:
+        deadline = time.monotonic() + 2.0
+        while src.pulled < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert src.pulled <= 3  # old bound holds first
+        assert pf.resize(6) == 6
+        deadline = time.monotonic() + 2.0
+        while src.pulled < 7 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # queue now holds 6, worker blocked holding one more
+        assert 6 <= src.pulled <= 7, f"resize not honored: {src.pulled}"
+        got = [b["i"] for b in pf]
+        assert got == list(range(100))  # order + completeness survive
+    finally:
+        pf.close()
+
+
+def test_host_prefetcher_resize_rejects_bad_depth():
+    pf = HostPrefetcher(CountingSource(3), depth=2)
+    try:
+        with pytest.raises(ValueError):
+            pf.resize(0)
+    finally:
+        pf.close()
+
+
+class _FakePrefetcher:
+    def __init__(self):
+        self.resized_to = None
+
+    def resize(self, depth):
+        self.resized_to = depth
+        return depth
+
+
+def test_metrics_logger_retunes_live_prefetcher(tmp_path, monkeypatch):
+    """The once-per-run advisory ACTS when a live prefetcher is attached:
+    the queue is resized to the suggested depth, the advisory records it,
+    and effective_prefetch_depth carries the new depth into later epochs."""
+    from datatunerx_tpu.training.metrics_log import MetricsLogger
+
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_RECORDS", "5")
+    logger = MetricsLogger(str(tmp_path), total_steps=100, prefetch_depth=2)
+    pf = _FakePrefetcher()
+    logger.attach_prefetcher(pf)
+    for step in range(5):
+        logger.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 50.0})
+    adv = logger.prefetch_advisory
+    assert adv is not None and adv["retuned"] is True
+    assert adv["suggested_prefetch_depth"] == 4
+    assert pf.resized_to == 4
+    assert logger.effective_prefetch_depth() == 4
+
+
+def test_metrics_logger_retune_opt_out(tmp_path, monkeypatch):
+    from datatunerx_tpu.training.metrics_log import MetricsLogger
+
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_RECORDS", "5")
+    monkeypatch.setenv("DTX_PREFETCH_RETUNE", "0")
+    logger = MetricsLogger(str(tmp_path), total_steps=100, prefetch_depth=2)
+    pf = _FakePrefetcher()
+    logger.attach_prefetcher(pf)
+    for step in range(5):
+        logger.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 50.0})
+    adv = logger.prefetch_advisory
+    assert adv is not None and adv["retuned"] is False
+    assert pf.resized_to is None  # advise-only: the flag stays a suggestion
+    assert logger.effective_prefetch_depth() == 2
+
+
+def test_metrics_logger_no_retune_without_stall(tmp_path, monkeypatch):
+    from datatunerx_tpu.training.metrics_log import MetricsLogger
+
+    monkeypatch.setenv("DTX_PREFETCH_ADVISE_RECORDS", "5")
+    logger = MetricsLogger(str(tmp_path), total_steps=100, prefetch_depth=2)
+    pf = _FakePrefetcher()
+    logger.attach_prefetcher(pf)
+    for step in range(8):
+        logger.log_train(step, {"loss": 1.0, "pipe_step_wait_ms": 0.1})
+    assert logger.prefetch_advisory is None
+    assert pf.resized_to is None

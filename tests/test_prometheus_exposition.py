@@ -20,6 +20,7 @@ from datatunerx_tpu.gateway.metrics import (
     Registry,
     escape_label_value,
 )
+from datatunerx_tpu.obs.metrics import annotation_start
 from datatunerx_tpu.gateway.replica_pool import InProcessReplica, ReplicaPool
 from datatunerx_tpu.gateway.server import Gateway, serve
 from datatunerx_tpu.serving import server as serving_server
@@ -32,11 +33,20 @@ SAMPLE_RE = re.compile(
 LABEL_RE = re.compile(
     r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"'
 )
+# OpenMetrics-style exemplar annotation: ` # {labels} value [timestamp]`.
+# Emitted on histogram bucket lines (obs.metrics.Histogram exemplars);
+# validated here, then stripped before the classic sample parse.
+EXEMPLAR_RE = re.compile(
+    r' # \{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*")(?:,[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*")*)\} (?P<value>[^ ]+)(?: (?P<ts>[0-9.]+))?$'
+)
 
 
 def parse_exposition(text: str):
     """→ (samples {series_key: float}, types {metric: type}). Asserts the
-    format invariants along the way."""
+    format invariants along the way. Exemplar annotations are validated
+    (well-formed, bucket lines only) and stripped."""
     assert text.endswith("\n"), "exposition must end with a newline"
     types = {}
     samples = {}
@@ -44,6 +54,14 @@ def parse_exposition(text: str):
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
             continue
+        pos = -1 if line.startswith("#") else annotation_start(line)
+        if pos >= 0:
+            m = EXEMPLAR_RE.match(line[pos:])
+            assert m, f"line {lineno}: malformed exemplar annotation: {line!r}"
+            assert line[:pos].split("{")[0].endswith("_bucket"), \
+                f"line {lineno}: exemplar on a non-bucket sample: {line!r}"
+            float(m.group("value"))  # exemplar value must parse
+            line = line[:pos]
         if line.startswith("# TYPE "):
             parts = line.split()
             assert len(parts) == 4, f"line {lineno}: malformed TYPE: {line!r}"
@@ -192,3 +210,13 @@ def test_gateway_metrics_exposition_valid():
         assert samples[(
             "dtx_gateway_replica_circuit_state",
             (("replica", r), ("state", "closed")))] == 1
+
+
+def test_parse_exposition_label_value_containing_hash_is_not_exemplar():
+    """A label VALUE with ' # ' is data, not an annotation — the parser
+    must not flag it as a malformed exemplar (mirrors the gateway scrape
+    parser's quote-aware tolerance)."""
+    reg = Registry()
+    reg.gauge("t_resident", "help").set(1, {"adapter": "a # b"})
+    samples, _ = parse_exposition(reg.expose())
+    assert samples[("t_resident", (("adapter", "a # b"),))] == 1
