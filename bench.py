@@ -328,11 +328,17 @@ def child_serve(preflight=None):
 def child_replay(preflight=None):
     """DTX_BENCH_REPLAY=1: the trace-driven load-replay + chaos harness
     (datatunerx_tpu/loadgen/) against a 2-replica in-process fleet of REAL
-    BatchedEngines behind a real Gateway — one /admin/drain injected
-    mid-run — judged by the SLO epilogue. The line carries client-side
-    TTFT/latency percentiles and the SLO verdict with any violated
-    objective NAMED, which scripts/bench_job_summary.py lifts into the GH
-    job summary. CPU numbers are smoke-only, like the serve bench."""
+    BatchedEngines behind a real Gateway, with a drain fired MID-STREAM
+    (the chaos action waits for in-flight work) — judged by the SLO
+    epilogue. Runs TWICE: with the KV session handoff on (drained
+    sessions migrate; the run asserts ZERO dropped sessions and ZERO
+    re-prefills via the engines' prefill-counter delta) and with it off
+    plus an export-kill (today's reap-deadline behavior: sessions die
+    mid-stream and fail over cold, re-prefilling — the counted baseline
+    the handoff removes). The line carries both runs' numbers and the SLO
+    verdict with any violated objective NAMED, which
+    scripts/bench_job_summary.py lifts into the GH job summary. CPU
+    numbers are smoke-only, like the serve bench."""
     import jax
 
     if os.environ.get("DTX_BENCH_FORCE_CPU"):
@@ -347,6 +353,7 @@ def child_replay(preflight=None):
     from datatunerx_tpu.loadgen.replay import (
         LocalClient,
         ReplayRunner,
+        drain_when_busy,
         slo_epilogue,
     )
     from datatunerx_tpu.loadgen.workload import WorkloadModel, summarize
@@ -359,56 +366,107 @@ def child_replay(preflight=None):
     n_requests = int(os.environ.get("DTX_BENCH_REPLAY_REQUESTS",
                                     "24" if on_tpu else "12"))
     rps = float(os.environ.get("DTX_BENCH_REPLAY_RPS", "8"))
-    engines = [
-        BatchedEngine(f"preset:{model}", template="vanilla",
-                      max_seq_len=max_seq, slots=2, decode_chunk=4)
-        for _ in range(2)  # shared program memo: second engine is cheap
-    ]
-    pool = ReplicaPool([InProcessReplica(f"replica-{i}", e)
-                        for i, e in enumerate(engines)])
-    gw = Gateway(pool, model_name=f"preset:{model}")
-    try:
-        # tiny prompts: the replay measures the HARNESS + scheduler under
-        # churn, not model quality; compile once before the clock starts
-        engines[0].generate(engines[0].tokenizer.encode("warm up"),
-                            max_new_tokens=2)
-        wl = WorkloadModel(requests=n_requests, sessions=3, rps=rps,
-                           seed=7, prompt_chars=40, prompt_cap_chars=200,
-                           output_tokens=6, output_cap_tokens=12)
-        events = wl.generate()
-        mid = events[-1]["t"] * 0.5
-        chaos = ChaosInjector(
-            [{"t": round(mid, 3), "op": "drain", "replica": "replica-1"}],
-            {"drain": lambda op: {"drained": gw.drain(op["replica"])}})
-        runner = ReplayRunner(LocalClient(gw), max_inflight=8)
-        evaluator = SLOEvaluator(runner.registry, default_slos("loadgen"))
-        t0 = time.perf_counter()
-        report = runner.run(events, chaos=chaos)
-        wall = time.perf_counter() - t0
-        verdict = slo_epilogue(evaluator, since_t=0.0,
-                               out=lambda s: print(s, file=sys.stderr))
-    finally:
-        gw.close()
+
+    def one_run(handoff: bool):
+        engines = [
+            BatchedEngine(f"preset:{model}", template="vanilla",
+                          max_seq_len=max_seq, slots=2, decode_chunk=4)
+            for _ in range(2)  # shared program memo: second engine is cheap
+        ]
+        pool = ReplicaPool([InProcessReplica(f"replica-{i}", e)
+                            for i, e in enumerate(engines)])
+        gw = Gateway(pool, model_name=f"preset:{model}",
+                     session_handoff=handoff)
+        try:
+            # tiny prompts: the replay measures the HARNESS + scheduler
+            # under churn, not model quality; compile before the clock
+            engines[0].generate(engines[0].tokenizer.encode("warm up"),
+                                max_new_tokens=2)
+            admits0 = sum(sum(e.prefill_stats.values()) for e in engines)
+            wl = WorkloadModel(requests=n_requests, sessions=3, rps=rps,
+                               seed=7, prompt_chars=40,
+                               prompt_cap_chars=200,
+                               output_tokens=24, output_cap_tokens=48)
+            events = wl.generate()
+            mid = events[-1]["t"] * 0.5
+
+            def _drain(op):
+                out = drain_when_busy(gw, op["replica"])
+                if not handoff:
+                    # today's reap-deadline kill: in-flight sessions on
+                    # the drained replica die mid-stream and fail over
+                    # on the cold (re-prefill) path. Loop briefly — a
+                    # session still in its prefill isn't exportable yet.
+                    killed, deadline = 0, time.monotonic() + 2.0
+                    while killed == 0 and time.monotonic() < deadline:
+                        killed = len(
+                            engines[1].export_sessions()["sessions"])
+                        if killed == 0:
+                            time.sleep(0.02)
+                    out["killed"] = killed
+                return out
+
+            chaos = ChaosInjector(
+                [{"t": round(mid, 3), "op": "drain",
+                  "replica": "replica-1"}],
+                {"drain": _drain})
+            runner = ReplayRunner(LocalClient(gw), max_inflight=8)
+            evaluator = SLOEvaluator(runner.registry,
+                                     default_slos("loadgen"))
+            t0 = time.perf_counter()
+            report = runner.run(events, chaos=chaos)
+            wall = time.perf_counter() - t0
+            verdict = slo_epilogue(evaluator, since_t=0.0,
+                                   out=lambda s: print(s, file=sys.stderr))
+            admissions = (sum(sum(e.prefill_stats.values())
+                              for e in engines) - admits0)
+            # each request cold-admits exactly once; anything beyond is a
+            # session that re-prefilled after the drain
+            re_prefills = max(0, admissions - report["requests"])
+            return {
+                "workload": summarize(events),
+                "requests": report["requests"],
+                "errors": report["errors"],
+                "codes": report["codes"],
+                "ttft_ms_p50": report["ttft_ms_p50"],
+                "ttft_ms_p95": report["ttft_ms_p95"],
+                "ttft_ms_p99": report["ttft_ms_p99"],
+                "latency_ms_p99": report["latency_ms_p99"],
+                "chaos": report.get("chaos", []),
+                "handoff": gw.handoff_stats(),
+                "admissions": admissions,
+                "re_prefills": re_prefills,
+                "slo_pass": verdict["pass"],
+                "slo_violations": verdict["violations"],
+                "wall_s": wall,
+            }
+        finally:
+            gw.close()
+
+    hot = one_run(handoff=True)
+    # the drain-mid-stream acceptance assertions: handoff on = nothing
+    # dropped, nothing re-prefilled
+    assert hot["errors"] == 0, \
+        f"handoff-on replay dropped sessions: {hot['codes']}"
+    assert hot["re_prefills"] == 0, \
+        f"handoff-on replay re-prefilled {hot['re_prefills']} session(s)"
+    cold = one_run(handoff=False)
 
     line = {
         "metric": f"replay_requests_per_sec[{model},2replicas,drain]",
-        "value": round(report["requests"] / wall, 2) if wall > 0 else 0.0,
+        "value": (round(hot["requests"] / hot["wall_s"], 2)
+                  if hot["wall_s"] > 0 else 0.0),
         "unit": "req/s",
         "vs_baseline": None,
         "platform": jax.devices()[0].platform,
         "cpu_fallback": not on_tpu,
-        "replay": {
-            "workload": summarize(events),
-            "requests": report["requests"],
-            "errors": report["errors"],
-            "codes": report["codes"],
-            "ttft_ms_p50": report["ttft_ms_p50"],
-            "ttft_ms_p95": report["ttft_ms_p95"],
-            "ttft_ms_p99": report["ttft_ms_p99"],
-            "latency_ms_p99": report["latency_ms_p99"],
-            "chaos": report.get("chaos", []),
-            "slo_pass": verdict["pass"],
-            "slo_violations": verdict["violations"],
+        "replay": {k: v for k, v in hot.items() if k != "wall_s"},
+        "replay_cold": {
+            "errors": cold["errors"],
+            "codes": cold["codes"],
+            "re_prefills": cold["re_prefills"],
+            "handoff": cold["handoff"],
+            "slo_pass": cold["slo_pass"],
         },
     }
     if preflight is not None:

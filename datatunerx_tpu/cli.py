@@ -30,7 +30,12 @@ Talks to the operator's REST API (operator/apiserver.py):
                                        multi-turn adapter-churning traffic,
                                        fault injection over the admin
                                        surfaces, SLO epilogue that exits
-                                       nonzero naming violated objectives
+                                       nonzero naming violated objectives;
+                                       --from_trace_log converts a gateway
+                                       --trace_log into a replayable
+                                       dtx-load-trace (real traffic shape),
+                                       --expect_handoff asserts a mid-
+                                       stream drain dropped nothing
 
 Server address from --server or DTX_SERVER (default http://127.0.0.1:8080);
 bearer auth via DTX_API_TOKEN when the server requires it.

@@ -24,19 +24,27 @@ def autoscale_hint(
     shed_recent: Optional[int] = None,
     queue_high_per_replica: int = 4,
     latency_target_s: float = 30.0,
+    slo_burn: Optional[dict] = None,
 ) -> dict:
     """Pure function of current observations → desired-replica hint.
 
     Scale up when the queue backs up past ``queue_high_per_replica`` waiting
     requests per available replica, when requests are being shed, or when
-    p95 latency blows through the target. Scale down only on a fully idle
-    gateway (empty queue, comfortable latency). One step per poll: the
-    controller re-polls, so ramping is feedback-driven rather than jumpy.
+    the latency signal breaches. Scale down only on a fully idle gateway
+    (empty queue, comfortable latency). One step per poll: the controller
+    re-polls, so ramping is feedback-driven rather than jumpy.
 
     ``shed_count`` is the lifetime total (reported); the scale-up trigger
     uses ``shed_recent`` — sheds since the previous poll — so one overload
     blip long past doesn't demand scale-up forever. Callers without a
     since-last-poll delta may omit it, accepting the ratchet.
+
+    ``slo_burn`` (``{"name", "burn_rate"}`` — the gateway's worst-burning
+    configured objective) REPLACES the raw-p95 signal when present: burn
+    rate > 1.0 spends error budget faster than the objective allows, which
+    is the scaling contract an operator actually declared; a raw p95
+    threshold is a guess about one. Without it (no ``--slo_config``), the
+    p95 branch behaves exactly as before.
     """
     n = max(1, replicas)
     desired = n
@@ -53,6 +61,15 @@ def autoscale_hint(
     elif queue_depth > backlog_high:
         desired = n + 1
         reason = f"queue depth {queue_depth} > {backlog_high}"
+    elif slo_burn is not None:
+        if slo_burn["burn_rate"] > 1.0:
+            desired = n + 1
+            reason = (f"SLO {slo_burn['name']} burn rate "
+                      f"{slo_burn['burn_rate']:.2f} > 1.0")
+        elif (queue_depth == 0 and n > 1
+              and slo_burn["burn_rate"] < 0.25):
+            desired = n - 1
+            reason = "idle"
     elif p95_latency_s > latency_target_s:
         desired = n + 1
         reason = (f"p95 latency {p95_latency_s:.2f}s > "
@@ -61,7 +78,7 @@ def autoscale_hint(
           and p95_latency_s < latency_target_s / 4):
         desired = n - 1
         reason = "idle"
-    return {
+    out = {
         "replicas": n,
         "availableReplicas": available_replicas,
         "desiredReplicas": desired,
@@ -71,6 +88,10 @@ def autoscale_hint(
         "p95LatencySeconds": round(p95_latency_s, 4),
         "reason": reason,
     }
+    if slo_burn is not None:
+        out["sloBurnRate"] = slo_burn["burn_rate"]
+        out["sloObjective"] = slo_burn["name"]
+    return out
 
 
 def parse_hint(doc: Optional[dict]) -> Optional[dict]:

@@ -30,6 +30,14 @@ from datatunerx_tpu.obs.metrics import (
 )
 
 
+# The error text a migrated-away request dies with (same literal as
+# serving/migration.MIGRATED_SESSION — it crosses the wire as an SSE error
+# event's plain-text message, so the marker is matched, not typed). A
+# ReplicaError carrying it means "this session was exported, splice the
+# imported continuation" — not a replica fault.
+MIGRATED_MARKER = "session migrated"
+
+
 class ReplicaError(Exception):
     """A replica failed to serve a request (connection refused, died
     mid-stream, 5xx). The gateway fails over; the breaker records it.
@@ -86,6 +94,15 @@ def _adapter_label(line: str, prefix: str) -> Optional[str]:
     except (ValueError, IndexError):
         return None
     return "".join(out)
+
+
+def _error_detail(e: "urllib.error.HTTPError") -> str:
+    """The serving server's JSON error body (or the bare HTTP reason) —
+    the one extraction every HTTPReplica error path shares."""
+    try:
+        return str(json.load(e).get("error", e.reason))
+    except Exception:  # noqa: BLE001 — non-JSON body: the reason is all we have
+        return str(e.reason)
 
 
 def _client_error_message(e: BaseException) -> str:
@@ -223,6 +240,33 @@ class Replica:
         replica)."""
         return self.stats()
 
+    # --------------------------------------------------- KV migration fabric
+    def export_sessions(self, slots: Optional[List[int]] = None,
+                        wire: Optional[str] = None) -> Optional[dict]:
+        """Serialize (and terminate) the replica's in-flight decode
+        sessions for handoff. None = the replica kind/engine has no
+        migration surface; otherwise {"sessions": [payload...],
+        "skipped": [...]}. Raises ReplicaError on transport faults."""
+        return None
+
+    def import_session(self, payload: dict):
+        """Admit an exported session and resume its decode. None =
+        unsupported; otherwise ``(meta, stream)`` where ``meta`` carries
+        ``text_so_far`` (the detokenized migrated tail) and ``stream``
+        yields the continuation deltas. Raises ReplicaError on refusal
+        (status 409: no slot / blocks / adapter) or fault."""
+        return None
+
+    def adapter_inventory(self) -> Optional[Dict[str, str]]:
+        """Resident adapter name → checkpoint path (the warm set a
+        replacement replica should rebuild); None when unknown."""
+        return None
+
+    def preload_adapter(self, name: str, checkpoint: str) -> bool:
+        """Register + warm one adapter (warm-set inheritance); False when
+        the replica kind can't."""
+        return False
+
     # -------------------------------------------------------- observability
     def fetch_trace(self, trace_id: str) -> Optional[dict]:
         """The replica's span timeline for one trace id (None = unknown or
@@ -337,6 +381,64 @@ class InProcessReplica(Replica):
             self.healthy = self.engine is not None
         return self.healthy
 
+    # --------------------------------------------------- KV migration fabric
+    def export_sessions(self, slots=None, wire=None):
+        fn = getattr(self.engine, "export_sessions", None)
+        if not callable(fn):
+            return None
+        try:
+            return fn(slots=slots, wire_quant=wire)
+        except Exception as e:  # noqa: BLE001 — export fault = replica fault
+            raise ReplicaError(f"{self.name}: export failed: {e}") from e
+
+    def import_session(self, payload: dict):
+        fn = getattr(self.engine, "import_session", None)
+        if not callable(fn):
+            return None
+        try:
+            meta = dict(fn(dict(payload)))
+        except (ValueError, KeyError) as e:
+            raise ReplicaError(
+                f"{self.name}: import refused: {_client_error_message(e)}",
+                status=409) from e
+        except Exception as e:  # noqa: BLE001
+            raise ReplicaError(f"{self.name}: import failed: {e}") from e
+        handle = meta.pop("_request", None)
+        return meta, self._guarded_resume(handle)
+
+    def _guarded_resume(self, handle):
+        """Map resume-stream faults to ReplicaError like chat_stream does,
+        so the gateway's splice failure handling sees one exception type."""
+        if handle is None:
+            return
+        try:
+            for delta in self.engine.resume_stream(handle):
+                yield delta
+        except ReplicaError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ReplicaError(f"{self.name}: resume failed: {e}") from e
+
+    def adapter_inventory(self) -> Optional[Dict[str, str]]:
+        catalog_fn = getattr(self.engine, "adapter_catalog", None)
+        if not callable(catalog_fn):
+            return None
+        try:
+            catalog = dict(catalog_fn())
+        except Exception:  # noqa: BLE001 — inventory is best-effort
+            return None
+        resident = getattr(self.engine, "resident_adapters", None)
+        if resident is not None:
+            catalog = {n: c for n, c in catalog.items() if n in resident}
+        return catalog or None
+
+    def preload_adapter(self, name: str, checkpoint: str) -> bool:
+        loader = getattr(self.engine, "load_adapter", None)
+        if not callable(loader):
+            return False
+        loader(name, checkpoint, preload=True)
+        return True
+
     def fetch_trace(self, trace_id: str) -> Optional[dict]:
         store = getattr(self.engine, "trace_store", None)
         if store is None:
@@ -440,15 +542,14 @@ class HTTPReplica(Replica):
                 body = json.load(r)
             return body["choices"][0]["message"]["content"]
         except urllib.error.HTTPError as e:
+            detail = _error_detail(e)
             # 4xx is the CLIENT's error (bad adapter name, bad body): the
             # replica is fine, don't trip the breaker or fail over
             if 400 <= e.code < 500:
-                try:
-                    detail = json.load(e).get("error", e.reason)
-                except Exception:  # noqa: BLE001
-                    detail = e.reason
-                raise ValueError(str(detail)) from e
-            raise ReplicaError(f"{self.name}: HTTP {e.code}") from e
+                raise ValueError(detail) from e
+            # the detail rides along so markers the gateway matches on
+            # (MIGRATED_MARKER) survive a non-streamed 500 crossing the wire
+            raise ReplicaError(f"{self.name}: HTTP {e.code}: {detail}") from e
         except (OSError, ValueError, KeyError) as e:
             raise ReplicaError(f"{self.name}: {e}") from e
 
@@ -494,6 +595,108 @@ class HTTPReplica(Replica):
             self.healthy = False
         return self.healthy
 
+    # --------------------------------------------------- KV migration fabric
+    def _admin_error(self, e: "urllib.error.HTTPError") -> ReplicaError:
+        return ReplicaError(f"{self.name}: {_error_detail(e)}",
+                            status=e.code)
+
+    def export_sessions(self, slots=None, wire=None):
+        body: dict = {}
+        if slots is not None:
+            body["slots"] = list(slots)
+        if wire:
+            body["wire"] = wire
+        try:
+            with self._post("/admin/sessions/export", body) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            if e.code == 501:
+                return None  # replica build without the migration surface
+            raise self._admin_error(e) from e
+        except (OSError, ValueError) as e:
+            raise ReplicaError(f"{self.name}: export failed: {e}") from e
+
+    def import_session(self, payload: dict):
+        body = dict(payload)
+        body["stream"] = True
+        try:
+            resp = self._post("/admin/sessions/import", body)
+        except urllib.error.HTTPError as e:
+            if e.code == 501:
+                return None
+            raise self._admin_error(e) from e
+        except OSError as e:
+            raise ReplicaError(f"{self.name}: import failed: {e}") from e
+        # first SSE event is the import receipt; the rest is the spliced
+        # continuation stream, handed back lazily
+        try:
+            first = self._next_event(resp)
+        except Exception as e:  # noqa: BLE001
+            resp.close()
+            raise ReplicaError(
+                f"{self.name}: import stream died: {e}") from e
+        if first is None or "imported" not in first:
+            resp.close()
+            detail = (first or {}).get("error", {}).get("message", "no receipt")
+            raise ReplicaError(f"{self.name}: import failed: {detail}")
+        return first["imported"], self._resume_deltas(resp)
+
+    @staticmethod
+    def _next_event(resp) -> Optional[dict]:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue
+            data = line[len("data: "):]
+            if data == "[DONE]":
+                return None
+            return json.loads(data)
+        return None
+
+    def _resume_deltas(self, resp):
+        try:
+            with resp:
+                while True:
+                    evt = self._next_event(resp)
+                    if evt is None:
+                        return
+                    if "error" in evt:
+                        raise ReplicaError(
+                            f"{self.name}: "
+                            f"{evt['error'].get('message')}")
+                    delta = evt.get("delta")
+                    if delta:
+                        yield delta
+        except ReplicaError:
+            raise
+        except Exception as e:  # noqa: BLE001 — stream cut = replica fault
+            raise ReplicaError(f"{self.name}: resume died: {e}") from e
+
+    def adapter_inventory(self) -> Optional[Dict[str, str]]:
+        try:
+            with urllib.request.urlopen(
+                    self.base_url + "/admin/adapters", timeout=2) as r:
+                doc = json.load(r)
+        except Exception:  # noqa: BLE001 — inventory is best-effort
+            return None
+        checkpoints = doc.get("checkpoints") or {}
+        resident = doc.get("resident") or []
+        out = {n: checkpoints[n] for n in resident if n in checkpoints}
+        return out or None
+
+    def preload_adapter(self, name: str, checkpoint: str) -> bool:
+        try:
+            with self._post("/admin/adapters",
+                            {"name": name, "checkpoint": checkpoint,
+                             "load": True}) as r:
+                json.load(r)
+            return True
+        except urllib.error.HTTPError as e:
+            raise self._admin_error(e) from e
+        except (OSError, ValueError) as e:
+            raise ReplicaError(
+                f"{self.name}: adapter preload failed: {e}") from e
+
     def fetch_trace(self, trace_id: str) -> Optional[dict]:
         """GET the replica's half of a trace. Debug path, not routing: a
         short timeout and None on any failure (the gateway still returns
@@ -517,14 +720,9 @@ class HTTPReplica(Replica):
             out["replica"] = self.name
             return out
         except urllib.error.HTTPError as e:
-            try:
-                detail = json.load(e).get("error", e.reason)
-            except Exception:  # noqa: BLE001
-                detail = e.reason
             # carry the replica's real status (409 conflict, 400 bad dir)
             # so the gateway relays it instead of guessing from the text
-            raise ReplicaError(f"{self.name}: {detail}",
-                               status=e.code) from e
+            raise self._admin_error(e) from e
         except (OSError, ValueError) as e:
             raise ReplicaError(f"{self.name}: {e}") from e
 

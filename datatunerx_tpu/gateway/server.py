@@ -38,6 +38,7 @@ from datatunerx_tpu.gateway.admission import AdmissionController, Overloaded
 from datatunerx_tpu.gateway.autoscale import autoscale_hint
 from datatunerx_tpu.gateway.metrics import MS_BUCKETS, Registry
 from datatunerx_tpu.gateway.replica_pool import (
+    MIGRATED_MARKER,
     HTTPReplica,
     NoReplicaAvailable,
     Replica,
@@ -55,6 +56,73 @@ from datatunerx_tpu.obs.trace import Span, Tracer, TraceStore
 from datatunerx_tpu.serving.local_backend import _free_port
 
 
+# an import may PARK on the target's scheduler this long waiting for
+# capacity (BatchedEngine.import_session wait_s default) — the claim wait
+# must outlast it, or a session that imports late degrades to a cold
+# re-prefill PLUS an orphaned continuation
+HANDOFF_IMPORT_WAIT_S = 10.0
+HANDOFF_CLAIM_WAIT_S = HANDOFF_IMPORT_WAIT_S + 2.0
+
+
+class _HandoffBuffer:
+    """Imported session continuations parked between the drain thread
+    (which exports from the source and imports on the target) and the
+    request thread whose stream just died with the migrated marker. One
+    entry per trace id, claimed once; ``claim`` can WAIT because the
+    stream's death races the import completing. Entries unclaimed past
+    the TTL are swept (streams closed) on every put AND claim — any
+    gateway traffic at all unpins an abandoned handoff's HTTP response."""
+
+    def __init__(self, ttl_s: float = 120.0):
+        self.ttl_s = ttl_s
+        self._cond = threading.Condition()
+        self._entries: dict = {}
+
+    @staticmethod
+    def _close(entries):
+        for e in entries:
+            close = getattr(e.get("stream"), "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    pass
+
+    def _sweep_locked(self):
+        now = time.monotonic()
+        return [self._entries.pop(tid)
+                for tid in [t for t, e in self._entries.items()
+                            if now - e["t"] > self.ttl_s]]
+
+    def put(self, trace_id: str, entry: dict):
+        if not trace_id:
+            # unclaimable (payload with no trace id): release the imported
+            # continuation immediately — nobody can ever splice it
+            self._close([entry])
+            return
+        entry["t"] = time.monotonic()
+        with self._cond:
+            stale = self._sweep_locked()
+            self._entries[trace_id] = entry
+            self._cond.notify_all()
+        self._close(stale)
+
+    def claim(self, trace_id: str, wait_s: float = 0.0) -> Optional[dict]:
+        with self._cond:
+            stale = self._sweep_locked()
+        self._close(stale)  # outside the lock: close() may do socket work
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            while True:
+                entry = self._entries.pop(trace_id, None)
+                if entry is not None:
+                    return entry
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._cond.wait(left)
+
+
 class Gateway:
     """Transport-independent core: tests drive this directly; the HTTP
     handler below is a thin shell around it."""
@@ -64,7 +132,7 @@ class Gateway:
                  max_attempts: int = 3, model_name: str = "",
                  trace_ring: int = 256,
                  trace_log_path: Optional[str] = None,
-                 slos=None):
+                 slos=None, session_handoff: bool = True):
         self.pool = pool
         self.router = Router(pool, policy=policy)
         self.admission = admission or AdmissionController()
@@ -105,6 +173,31 @@ class Gateway:
         # as dtx_slo_* gauges on every /metrics scrape — the same evaluator
         # class the promotion guard and the replay epilogue run
         self.slo = SLOEvaluator(self.registry, slos or default_slos("gateway"))
+        # operator-configured SLOs also drive /autoscale off burn rate
+        # instead of raw p95 (defaults stay advisory-only: they are loose
+        # bootstrap objectives, not a scaling contract)
+        self.slo_configured = slos is not None
+        # KV migration fabric: drain exports every in-flight session from
+        # the leaving replica and imports it elsewhere; the dying streams
+        # splice the imported continuation instead of re-prefilling
+        self.session_handoff = session_handoff
+        self._handoff = _HandoffBuffer()
+        self.last_handoff: Optional[dict] = None
+        self._handoffs = self.registry.counter(
+            "dtx_gateway_handoff_total",
+            "Drain/failover session handoffs by outcome (imported = "
+            "resumed re-prefill-free elsewhere, cold = fell back to the "
+            "re-prefill path, export_failed / unsupported = source could "
+            "not export).")
+        self._splices = self.registry.counter(
+            "dtx_gateway_handoff_splices_total",
+            "Client streams spliced onto an imported continuation, by "
+            "outcome.")
+        self._h_handoff = self.registry.histogram(
+            "dtx_gateway_handoff_ms",
+            "Per-session export→import handoff time (trace exemplars "
+            "resolve at /debug/trace/<id>).",
+            buckets=MS_BUCKETS)
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -167,7 +260,29 @@ class Gateway:
                 root.event("admitted")
                 tried: set = set()
                 last: Optional[Exception] = None
+                expect_handoff = False
                 for attempt in range(self.max_attempts):
+                    # a drained-away session leaves its imported
+                    # continuation here — splice it instead of re-routing
+                    # (and re-prefilling) the whole request
+                    entry = self._claim_handoff(root.trace_id,
+                                                expect_handoff)
+                    expect_handoff = False
+                    if entry is not None:
+                        try:
+                            # emitted="" makes the splice yield the full
+                            # text: migrated tail + continuation
+                            text = "".join(
+                                self._consume_splice(entry, "", root))
+                        except ReplicaError as e:
+                            last = e
+                            continue
+                        self._latency.observe(time.monotonic() - t0,
+                                              trace_id=root.trace_id)
+                        root.set(replica=entry.get("target"),
+                                 attempts=attempt + 1, handoff=True)
+                        self._finish_request_span(root)
+                        return text
                     replica = self._route(messages, adapter, session_id,
                                           tried, on_event=root.event)
                     tried.add(replica.name)
@@ -191,6 +306,14 @@ class Gateway:
                         self._finish_request_span(root)
                         return text
                     except ReplicaError as e:
+                        if self.session_handoff and MIGRATED_MARKER in str(e):
+                            # not a fault: the session was exported off a
+                            # draining replica; next pass splices it
+                            expect_handoff = True
+                            root.event("handoff_pending",
+                                       replica=replica.name)
+                            last = e
+                            continue
                         replica.record_outcome(
                             False, (time.monotonic() - t_attempt) * 1e3)
                         self._replica_failed(replica)
@@ -229,7 +352,32 @@ class Gateway:
                 root.event("admitted")
                 emitted = ""
                 tried: set = set()
+                expect_handoff = False
                 for attempt in range(self.max_attempts):
+                    # a drained-away session leaves its imported
+                    # continuation in the handoff buffer: splice it onto
+                    # the client's stream instead of re-prefilling
+                    entry = self._claim_handoff(root.trace_id,
+                                                expect_handoff)
+                    expect_handoff = False
+                    if entry is not None:
+                        try:
+                            for delta in self._consume_splice(entry,
+                                                              emitted, root):
+                                if not emitted:
+                                    root.event("first_delta",
+                                               replica=entry.get("target"))
+                                emitted += delta
+                                yield delta
+                        except ReplicaError:
+                            continue  # next attempt: the cold path
+                        self._latency.observe(time.monotonic() - t0,
+                                              trace_id=root.trace_id)
+                        root.set(replica=entry.get("target"),
+                                 attempts=attempt + 1, chars=len(emitted),
+                                 handoff=True)
+                        self._finish_request_span(root)
+                        return
                     replica = self._route(messages, adapter, session_id,
                                           tried, on_event=root.event)
                     tried.add(replica.name)
@@ -266,6 +414,15 @@ class Gateway:
                         self._finish_request_span(root)
                         return
                     except ReplicaError as e:
+                        if self.session_handoff and MIGRATED_MARKER in str(e):
+                            # the session was exported off a draining
+                            # replica — not a fault; the next pass waits
+                            # for (then splices) the imported continuation
+                            expect_handoff = True
+                            root.event("handoff_pending",
+                                       replica=replica.name,
+                                       resumed_at_char=len(emitted))
+                            continue
                         replica.record_outcome(
                             False, (time.monotonic() - t_attempt) * 1e3)
                         self._replica_failed(replica)
@@ -313,6 +470,154 @@ class Gateway:
             raise ReplicaError(f"{replica.name}: {e}") from e
         finally:
             replica.release()
+
+    # ----------------------------------------------------- session handoff
+    def _claim_handoff(self, trace_id: str,
+                       expect: bool) -> Optional[dict]:
+        """Pop this request's imported continuation, if any. When the
+        previous attempt died with the migrated marker (``expect``), wait
+        long enough to outlast the import's own park deadline — giving up
+        earlier would re-prefill cold AND orphan the late import."""
+        if not self.session_handoff:
+            return None
+        entry = self._handoff.claim(
+            trace_id, wait_s=HANDOFF_CLAIM_WAIT_S if expect else 0.0)
+        if entry is None or entry.get("failed"):
+            return None  # tombstone = the drain already counted it cold
+        return entry
+
+    def _consume_splice(self, entry: dict, emitted: str, root: Span):
+        """Relay an imported continuation, recording splice outcome and
+        target-replica accounting — the shared core of chat's and
+        chat_stream's handoff paths. Yields net-new text; raises
+        ReplicaError (after failure accounting) when the target dies
+        mid-splice, which the caller turns into a cold retry."""
+        target = self.pool.get(entry.get("target") or "")
+        root.event("handoff_splice", replica=entry.get("target"),
+                   resumed_at_char=len(emitted))
+        t_attempt = time.monotonic()
+        try:
+            for delta in self._splice_deltas(entry, emitted):
+                yield delta
+        except ReplicaError as e:
+            self._splices.inc({"outcome": "failed"})
+            root.event("handoff_splice_failed", error=str(e))
+            if target is not None:
+                target.record_outcome(
+                    False, (time.monotonic() - t_attempt) * 1e3)
+                self._replica_failed(target)
+            raise
+        self._splices.inc({"outcome": "ok"})
+        if target is not None:
+            target.breaker.record_success()
+            target.record_outcome(
+                True, (time.monotonic() - t_attempt) * 1e3)
+
+    def _splice_deltas(self, entry: dict, emitted: str):
+        """Yield ONLY net-new text for a spliced stream: reconcile the
+        import's ``text_so_far`` against what the client already received
+        (token-exact resume makes them equal; the skip logic absorbs any
+        detokenization-boundary char drift), then relay the continuation."""
+        pre = str(entry.get("text_so_far") or "")
+        if len(pre) > len(emitted):
+            yield pre[len(emitted):]
+        skip = max(0, len(emitted) - len(pre))
+        for delta in entry["stream"]:
+            if skip > 0:
+                if len(delta) <= skip:
+                    skip -= len(delta)
+                    continue
+                delta = delta[skip:]
+                skip = 0
+            if delta:
+                yield delta
+
+    def handoff_sessions(self, source: Replica) -> dict:
+        """Export every in-flight decode session from ``source`` and
+        import each onto another available replica (adapter-resident
+        targets first, like the router's preference). Imported sessions
+        park in the handoff buffer keyed by trace id; the dying client
+        streams splice them. Sessions no target can admit are counted
+        cold and fall back to today's re-prefill failover."""
+        summary: dict = {"source": source.name, "exported": 0,
+                         "imported": 0, "cold": 0, "skipped": 0}
+        try:
+            doc = source.export_sessions()
+        except ReplicaError as e:
+            self._handoffs.inc({"outcome": "export_failed"})
+            summary["error"] = str(e)
+            return summary
+        if doc is None:
+            self._handoffs.inc({"outcome": "unsupported"})
+            summary["unsupported"] = True
+            return summary
+        skipped = doc.get("skipped") or []
+        summary["skipped"] = len(skipped)
+        if skipped:
+            print(f"[gateway] handoff from {source.name}: "
+                  f"{len(skipped)} session(s) not exportable "
+                  f"({sorted({s.get('reason') for s in skipped})})",
+                  flush=True)
+        for payload in doc.get("sessions") or []:
+            summary["exported"] += 1
+            self._handoff_one(source, payload, summary)
+        self.last_handoff = summary
+        return summary
+
+    def _handoff_one(self, source: Replica, payload: dict, summary: dict):
+        t0 = time.monotonic()
+        tid = str(payload.get("trace_id") or "")
+        adapter = str(payload.get("adapter") or "")
+        targets = [r for r in self.pool.available() if r.name != source.name]
+
+        def _resident_rank(r: Replica) -> int:
+            if not adapter:
+                return 0
+            try:
+                res = r.stats().get("resident_adapters")
+            except Exception:  # noqa: BLE001 — stats are advisory
+                return 1
+            return 0 if (res and adapter in res) else 1
+
+        targets.sort(key=lambda r: (_resident_rank(r), r.name))
+        last_err: Optional[Exception] = None
+        for target in targets:
+            try:
+                res = target.import_session(payload)
+            except ReplicaError as e:
+                last_err = e
+                continue
+            if res is None:
+                continue  # replica kind without the migration surface
+            meta, stream = res
+            self._handoff.put(tid, {
+                "target": target.name, "meta": meta, "stream": stream,
+                "text_so_far": str(meta.get("text_so_far") or "")})
+            self._handoffs.inc({"outcome": "imported"})
+            self._h_handoff.observe((time.monotonic() - t0) * 1e3,
+                                    trace_id=tid or None)
+            summary["imported"] += 1
+            return
+        # nothing could admit it: the dying stream takes the cold path
+        # (a tombstone stops the claimer's wait immediately)
+        self._handoff.put(tid, {"failed": True})
+        self._handoffs.inc({"outcome": "cold"})
+        summary["cold"] += 1
+        if last_err is not None:
+            summary["last_error"] = str(last_err)
+            print(f"[gateway] handoff of {tid or '<no-trace>'} fell back "
+                  f"cold: {last_err}", flush=True)
+
+    def handoff_stats(self) -> dict:
+        """Handoff outcome counts (the dtx_gateway_handoff_total series),
+        plus splice outcomes — the replay harness's zero-drop assertion
+        reads this."""
+        out: dict = {}
+        for key, value in self._handoffs.series().items():
+            out[dict(key).get("outcome", "")] = int(value)
+        for key, value in self._splices.series().items():
+            out[f"splice_{dict(key).get('outcome', '')}"] = int(value)
+        return out
 
     # -------------------------------------------------------- observability
     def trace(self, trace_id: str) -> Optional[dict]:
@@ -384,7 +689,28 @@ class Gateway:
             shed_count=shed_total,
             shed_recent=shed_recent,
             p95_latency_s=self._latency.percentile(0.95),
+            slo_burn=self._slo_burn() if self.slo_configured else None,
         )
+
+    def _slo_burn(self) -> Optional[dict]:
+        """The worst-burning configured objective, for the autoscale hint.
+        Per the multi-window page rule, an SLO's effective burn is the MIN
+        over its populated windows (every window must burn to page); the
+        hint reports the max of those across objectives."""
+        worst: Optional[dict] = None
+        try:
+            self.slo.sample()
+            for doc in self.slo.evaluate():
+                populated = [w for w in doc["windows"] if not w["no_data"]]
+                if not populated:
+                    continue
+                burn = min(w["burn_rate"] for w in populated)
+                if worst is None or burn > worst["burn_rate"]:
+                    worst = {"name": doc["name"],
+                             "burn_rate": round(burn, 4)}
+        except Exception:  # noqa: BLE001 — a broken SLO eval must not 500 /autoscale
+            return None
+        return worst
 
     def record_request(self, code: int):
         self._requests.inc({"code": str(code)})
@@ -547,11 +873,24 @@ class Gateway:
     def drain(self, name: str) -> bool:
         """Drain a replica for a rolling restart. Managed replicas get the
         full treatment (reap the subprocess, spawn a replacement); bare
-        pool replicas just stop receiving new requests."""
+        pool replicas just stop receiving new requests.
+
+        With ``session_handoff`` on (default), every in-flight decode
+        session is exported from the leaving replica and imported onto a
+        peer BEFORE the reap — the drained replica empties immediately and
+        no client stream re-prefills. Sessions nothing can admit fall back
+        to today's cold path, logged and counted."""
+        replica = self.pool.get(name)
+        if replica is None:
+            return False
+        if self.session_handoff:
+            replica.drain()  # no new routes while sessions migrate
+            if any(r.name != name for r in self.pool.available()):
+                self.handoff_sessions(replica)  # summary → self.last_handoff
         if self.replica_set is not None and self.replica_set.drain(name):
             self.router.forget_replica(name)
             return True
-        if self.pool.drain(name):
+        if self.pool.drain(name) or replica.draining:
             self.router.forget_replica(name)
             return True
         return False
@@ -587,6 +926,11 @@ class ManagedReplicaSet:
         self._procs: dict = {}
         self._reaping: set = set()
         self._next_idx = 0
+        # drained replicas' promotion weight + adapter warm-set, queued for
+        # the replacement spawn to inherit: a replacement joining at
+        # defaults (weight 1.0, cold pool) skews smooth-WRR shares
+        # mid-promotion and pays every tenant's load-on-miss again
+        self._inherit: List[dict] = []
         self._lock = threading.Lock()
         # serializes whole reconcile passes: drain()/scale() callers (HTTP
         # handler threads) race the supervisor tick, and two concurrent
@@ -617,8 +961,49 @@ class ManagedReplicaSet:
             self._procs[name] = proc
         replica = HTTPReplica(name, f"http://127.0.0.1:{port}")
         replica.healthy = False  # until the health probe sees model loaded
+        self._apply_inheritance(replica)
         self.pool.add(replica)
         return replica
+
+    def _apply_inheritance(self, replica: Replica):
+        """Hand a freshly-spawned replacement the drained replica's
+        promotion weight immediately, and rebuild its adapter warm set
+        once it reports healthy (a background thread — the model load is
+        minutes, the spawn must not block on it)."""
+        with self._lock:
+            now = time.monotonic()
+            # entries expire: a drain whose replacement never spawned
+            # (target dropped meanwhile) must not skew a later scale-up
+            self._inherit = [e for e in self._inherit
+                             if now - e["t"] < 300.0]
+            entry = self._inherit.pop(0) if self._inherit else None
+        if entry is None:
+            return
+        replica.weight = entry["weight"]
+        if entry.get("adapters"):
+            threading.Thread(
+                target=self._warm_replacement,
+                args=(replica, dict(entry["adapters"])),
+                daemon=True).start()
+
+    def _warm_replacement(self, replica: Replica, adapters: dict):
+        deadline = time.monotonic() + max(self.drain_timeout_s, 30.0) + 300.0
+        while not self._shutdown.is_set() and time.monotonic() < deadline:
+            try:
+                if replica.probe_health():
+                    break
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            if self._shutdown.wait(0.2):
+                return
+        else:
+            return
+        for name, ckpt in sorted(adapters.items()):
+            try:
+                replica.preload_adapter(name, ckpt)
+            except Exception as e:  # noqa: BLE001 — warm-set is best-effort
+                print(f"[gateway] warm-set {name!r} on {replica.name} "
+                      f"failed: {e}", flush=True)
 
     def scale(self, n: int) -> int:
         n = max(0, int(n))
@@ -637,7 +1022,7 @@ class ManagedReplicaSet:
         if not managed or replica is None:
             return False
         replica.drain()
-        self._start_reap(replica)
+        self._start_reap(replica, inherit=True)
         self._reconcile()  # spawn the replacement now, not next tick
         return True
 
@@ -671,8 +1056,9 @@ class ManagedReplicaSet:
                 # safety net: however a managed replica got its draining
                 # flag (/admin/drain via pool.drain, an operator poking the
                 # pool directly), it must end up reaped — draining without
-                # a reaper is how zombies used to accumulate
-                self._start_reap(r)
+                # a reaper is how zombies used to accumulate. The target is
+                # unchanged here, so a replacement will spawn: it inherits.
+                self._start_reap(r, inherit=True)
             else:
                 live.append(r)
         live.sort(key=lambda r: r.name)
@@ -682,11 +1068,22 @@ class ManagedReplicaSet:
             replica.drain()
             self._start_reap(replica)
 
-    def _start_reap(self, replica: HTTPReplica):
+    def _start_reap(self, replica: HTTPReplica, inherit: bool = False):
         with self._lock:
             if replica.name in self._reaping or replica.name not in self._procs:
                 return
             self._reaping.add(replica.name)
+        if inherit:
+            # snapshot NOW, while the draining replica still answers: the
+            # replacement spawn (possibly this same reconcile pass) pops it
+            entry = {"weight": float(getattr(replica, "weight", 1.0)),
+                     "adapters": None, "t": time.monotonic()}
+            try:
+                entry["adapters"] = replica.adapter_inventory()
+            except Exception:  # noqa: BLE001 — inventory is best-effort
+                pass
+            with self._lock:
+                self._inherit.append(entry)
         threading.Thread(target=self._reap, args=(replica,),
                          daemon=True).start()
 
@@ -940,8 +1337,12 @@ def make_handler(gw: Gateway):
 
         def _drain(self, req: dict, trace_id: str):
             name = req.get("replica") or ""
+            self.gateway.last_handoff = None
             if self.gateway.drain(name):
-                self._json(200, {"draining": name}, trace_id)
+                body = {"draining": name}
+                if self.gateway.last_handoff is not None:
+                    body["handoff"] = self.gateway.last_handoff
+                self._json(200, body, trace_id)
             else:
                 self._json(404, {"error": f"no replica {name!r}"}, trace_id)
 
@@ -1032,6 +1433,11 @@ def main(argv=None):
                    help="background SLO sampling interval so the burn-rate "
                         "windows have history without a /debug/slo poller "
                         "(0 disables the sampler)")
+    p.add_argument("--session_handoff", type=int, default=1,
+                   help="1 (default): drain exports every in-flight KV "
+                        "session from the leaving replica and imports it "
+                        "on a peer — rolling restarts drop nothing and "
+                        "re-prefill nothing; 0 reverts to cold drain")
     p.add_argument("--replica_url", action="append", default=[],
                    help="front an EXISTING serving server (repeatable); "
                         "mutually exclusive with --replicas spawning")
@@ -1087,7 +1493,8 @@ def main(argv=None):
                  model_name=args.model_path,
                  trace_ring=args.trace_ring,
                  trace_log_path=args.trace_log or None,
-                 slos=load_slos(args.slo_config) if args.slo_config else None)
+                 slos=load_slos(args.slo_config) if args.slo_config else None,
+                 session_handoff=bool(args.session_handoff))
     if args.slo_sample_s > 0:
         gw.slo.start(args.slo_sample_s)
     for i, url in enumerate(args.replica_url):

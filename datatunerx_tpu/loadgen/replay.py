@@ -289,12 +289,16 @@ def slo_epilogue(evaluator: SLOEvaluator, since_t: float,
 
 class _FakeEngine:
     """A serving-engine stand-in for the self-test fleet: streams a few
-    deltas with a small per-token delay, supports adapter names and an
-    injectable mid-stream fault — enough surface for routing, failover,
-    drain and adapter-evict chaos without loading a model."""
+    deltas with a small per-token delay, supports adapter names, an
+    injectable mid-stream fault, AND the KV-migration surface
+    (export_sessions / import_session / resume_stream with the real
+    engines' duck-typed contract) — enough for routing, failover, drain
+    handoff and adapter-evict chaos without loading a model."""
 
     def __init__(self, name: str, delay_s: float = 0.002,
                  adapters: Optional[List[str]] = None):
+        from datatunerx_tpu.obs.trace import TraceStore
+
         self.name = name
         self.delay_s = delay_s
         self.fail = False
@@ -304,30 +308,85 @@ class _FakeEngine:
         self.resident_adapters = {a for a in self.adapter_ids if a}
         self.slots = 4
         self._slot_req = [None] * 4
+        # a real (tiny) trace store so InProcessReplica forwards trace ids
+        # — the handoff buffer is keyed by them
+        self.trace_store = TraceStore(capacity=64)
+        self._lock = threading.Lock()
+        self._live: dict = {}
 
     def unload_adapter(self, name: str) -> bool:
         present = name in self.resident_adapters
         self.resident_adapters.discard(name)
         return present
 
-    def chat_stream(self, messages, max_new_tokens: int = 16, **kw):
+    def chat_stream(self, messages, max_new_tokens: int = 16,
+                    trace_id: str = "", **kw):
         if self.fail:
             raise RuntimeError(f"{self.name}: injected fault")
         n = max(1, min(int(max_new_tokens), 8))
-        for i in range(n):
-            time.sleep(self.delay_s)
-            if self.fail and i > 0:
-                raise RuntimeError(f"{self.name}: killed mid-stream")
-            yield "tok "
+        sess = {"trace_id": trace_id, "total": n, "emitted": 0,
+                "migrate": False, "adapter": kw.get("adapter", "")}
+        if trace_id:
+            with self._lock:
+                self._live[trace_id] = sess
+        try:
+            for i in range(n):
+                time.sleep(self.delay_s)
+                if self.fail and i > 0:
+                    raise RuntimeError(f"{self.name}: killed mid-stream")
+                if sess["migrate"]:
+                    # same marker literal the real engine dies with
+                    # (gateway/replica_pool.MIGRATED_MARKER)
+                    raise RuntimeError(
+                        f"session migrated off {self.name}")
+                sess["emitted"] += 1
+                yield "tok "
+        finally:
+            if trace_id:
+                with self._lock:
+                    self._live.pop(trace_id, None)
 
     def chat(self, messages, **kw):
         return "".join(self.chat_stream(messages, **kw))
+
+    # ------------------------------------------ KV migration (fake twin)
+    def export_sessions(self, slots=None, wire_quant=None) -> dict:
+        with self._lock:
+            live = list(self._live.values())
+        sessions = []
+        for sess in live:
+            sess["migrate"] = True  # the stream dies with the marker
+            sessions.append({"fake": True, "trace_id": sess["trace_id"],
+                             "emitted": int(sess["emitted"]),
+                             "total": int(sess["total"]),
+                             "adapter": sess["adapter"]})
+        return {"sessions": sessions, "skipped": []}
+
+    def import_session(self, payload: dict) -> dict:
+        if not payload.get("fake"):
+            raise ValueError("foreign session payload")
+        adapter = payload.get("adapter") or ""
+        if adapter and adapter not in self.adapter_ids:
+            raise ValueError(f"unknown adapter {adapter!r}")
+        emitted = int(payload["emitted"])
+        handle = {"remaining": max(0, int(payload["total"]) - emitted)}
+        return {"session": payload.get("trace_id"), "tokens": emitted,
+                "text_so_far": "tok " * emitted, "_request": handle}
+
+    def resume_stream(self, handle: dict):
+        for _ in range(handle["remaining"]):
+            time.sleep(self.delay_s)
+            if self.fail:
+                raise RuntimeError(f"{self.name}: killed mid-resume")
+            yield "tok "
 
     def healthy(self) -> bool:
         return not self.fail
 
 
-def build_selftest_fleet(adapters: Optional[List[str]] = None):
+def build_selftest_fleet(adapters: Optional[List[str]] = None,
+                         session_handoff: bool = True,
+                         delay_s: float = 0.002):
     """2 in-process fake replicas behind a real Gateway — the CI smoke
     fleet. Returns (gateway, engines)."""
     from datatunerx_tpu.gateway.replica_pool import (
@@ -337,20 +396,37 @@ def build_selftest_fleet(adapters: Optional[List[str]] = None):
     from datatunerx_tpu.gateway.server import Gateway
 
     adapters = adapters if adapters is not None else ["tenant-a", "tenant-b"]
-    engines = [_FakeEngine(f"replica-{i}", adapters=adapters)
+    engines = [_FakeEngine(f"replica-{i}", delay_s=delay_s,
+                           adapters=adapters)
                for i in range(2)]
     pool = ReplicaPool([InProcessReplica(e.name, e) for e in engines])
-    gw = Gateway(pool, model_name="selftest")
+    gw = Gateway(pool, model_name="selftest",
+                 session_handoff=session_handoff)
     return gw, engines
 
 
+def drain_when_busy(gw, name: str, wait_s: float = 3.0) -> dict:
+    """Chaos action: wait (bounded) until the replica actually holds
+    in-flight work, then drain it — a time-offset drain that lands on an
+    idle replica proves nothing about mid-stream handoff."""
+    r = gw.pool.get(name)
+    deadline = time.monotonic() + wait_s
+    while (r is not None and r.inflight == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    busy = r.inflight if r is not None else None
+    return {"drained": gw.drain(name), "inflight_at_drain": busy,
+            "handoff": gw.last_handoff}
+
+
 def selftest_chaos(gw, engines, duration_s: float) -> ChaosInjector:
-    """The default self-test schedule: one /admin/drain mid-run (replica-1
-    stops taking traffic; availability must hold on replica-0)."""
+    """The default self-test schedule: one /admin/drain mid-run, fired
+    when the replica is mid-stream (replica-1 stops taking traffic; its
+    sessions hand off and availability must hold on replica-0)."""
     ops = [{"t": round(duration_s * 0.5, 3), "op": "drain",
             "replica": "replica-1"}]
     actions = {
-        "drain": lambda op: {"drained": gw.drain(op["replica"])},
+        "drain": lambda op: drain_when_busy(gw, op["replica"]),
         "kill": lambda op: _kill_engine(engines, op["replica"]),
         "adapter_unload": lambda op: {
             "unloaded": [e.unload_adapter(op["adapter"])
@@ -426,6 +502,13 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default="",
                    help="replay this recorded JSONL trace instead of "
                         "generating traffic")
+    p.add_argument("--from_trace_log", default="",
+                   help="convert a gateway --trace_log JSONL (completed "
+                        "request spans) into the replay workload: real "
+                        "arrival times, adapter mix and output sizes, "
+                        "synthetic prompt text (spans don't record "
+                        "message content); combine with --record to save "
+                        "the converted dtx-load-trace")
     p.add_argument("--record", default="",
                    help="write the generated trace here (with no --url/"
                         "--selftest: generate-and-exit)")
@@ -451,13 +534,30 @@ def main(argv=None) -> int:
                    metavar="NAME=OBJECTIVE[@THRESHOLD]",
                    help="override an SLO's objective (and latency "
                         "threshold) — prove the epilogue detects a breach")
+    p.add_argument("--handoff", choices=["on", "off"], default="on",
+                   help="selftest fleet: drain hands in-flight sessions "
+                        "to the surviving replica (on, default) or drops "
+                        "them on today's cold re-prefill path (off)")
+    p.add_argument("--expect_handoff", action="store_true",
+                   help="fail (exit 1) unless the run handed off at least "
+                        "one session with zero cold fallbacks and zero "
+                        "5xx — the drain-mid-stream CI assertion")
+    p.add_argument("--selftest_delay", type=float, default=0.002,
+                   help="selftest per-token delay (raise it so a "
+                        "mid-stream drain reliably catches sessions)")
     p.add_argument("--report_json", default="",
                    help="write the full report (results + chaos log + SLO "
                         "verdicts) to this file")
     args = p.parse_args(argv)
 
     adapters = [a.strip() for a in args.adapters.split(",") if a.strip()]
-    if args.trace:
+    if args.from_trace_log:
+        from datatunerx_tpu.loadgen.workload import from_trace_log
+
+        meta, events = from_trace_log(args.from_trace_log)
+        print(f"[replay] converted {args.from_trace_log}: "
+              f"{summarize(events)}")
+    elif args.trace:
         meta, events = read_trace(args.trace)
         print(f"[replay] trace {args.trace}: {summarize(events)}")
     else:
@@ -490,7 +590,9 @@ def main(argv=None) -> int:
     trace_duration = events[-1]["t"] if events else 0.0
     try:
         if args.selftest:
-            gw, engines = build_selftest_fleet(adapters or None)
+            gw, engines = build_selftest_fleet(
+                adapters or None, session_handoff=args.handoff == "on",
+                delay_s=args.selftest_delay)
             client = LocalClient(gw)
             default = selftest_chaos(gw, engines, trace_duration)
             chaos = (ChaosInjector(load_chaos(args.chaos), default.actions)
@@ -517,13 +619,38 @@ def main(argv=None) -> int:
         for entry in report.get("chaos") or []:
             print(f"[chaos] t={entry['t']}s {entry['op']} "
                   f"{entry['args']} ok={entry['ok']} — {entry['detail']}")
+        if gw is not None:
+            report["handoff"] = gw.handoff_stats()
+            report["handoff_enabled"] = gw.session_handoff
+            print(f"[replay] session handoff "
+                  f"({'on' if gw.session_handoff else 'off'}): "
+                  f"{report['handoff'] or 'no sessions moved'}")
         verdict = slo_epilogue(evaluator, since_t=t_start - 1.0)
         report["slo"] = verdict
         report["workload"] = meta
+        rc = 0 if verdict["pass"] else 1
+        if args.expect_handoff:
+            problems = []
+            hs = report.get("handoff") or {}
+            if hs.get("imported", 0) < 1:
+                problems.append("no session was handed off")
+            if hs.get("cold", 0):
+                problems.append(f"{hs['cold']} session(s) fell back cold")
+            dropped = sum(n for c, n in report["codes"].items()
+                          if int(c) >= 500)
+            if dropped:
+                problems.append(f"{dropped} request(s) dropped (5xx)")
+            for p_ in problems:
+                print(f"[replay] handoff assertion FAILED: {p_}")
+            if problems:
+                rc = 1
+            else:
+                print("[replay] handoff assertion PASSED: sessions moved, "
+                      "zero cold fallbacks, zero drops")
         if args.report_json:
             with open(args.report_json, "w", encoding="utf-8") as f:
                 json.dump(report, f, indent=1)
-        return 0 if verdict["pass"] else 1
+        return rc
     finally:
         if gw is not None:
             gw.close()

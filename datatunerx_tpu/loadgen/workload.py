@@ -145,6 +145,70 @@ class WorkloadModel:
         }
 
 
+# ------------------------------------------------- gateway trace-log import
+
+def from_trace_log(path: str, prompt_chars: int = 80,
+                   chars_per_token: float = 4.0,
+                   temperature: float = 0.8) -> Tuple[dict, List[dict]]:
+    """Convert a gateway ``--trace_log`` JSONL (one completed span per
+    line, obs/trace.py format) into replay events — so replays are driven
+    by REAL recorded traffic instead of the synthetic workload model.
+
+    What the spans carry is what the replay gets: true arrival offsets
+    (``start_ms``), the adapter mix (``attrs.adapter``), per-request
+    output sizes (``attrs.chars``, streamed requests), and the trace id as
+    the session key (affinity-stable across a multi-turn id). Spans do NOT
+    record message content, so prompts are synthetic filler of
+    ``prompt_chars`` — shape-true timing/mix, not content replay.
+
+    Only gateway ROOT spans (``gateway.request`` / ``gateway.stream``)
+    become events; replica/engine halves of the same trace are skipped.
+    """
+    rng = random.Random(0)
+    rows: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for n, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                sp = json.loads(line)
+            except json.JSONDecodeError:
+                raise ValueError(f"{path}: line {n} is not JSON — is this "
+                                 "a gateway --trace_log file?")
+            if not isinstance(sp, dict):
+                continue
+            if sp.get("name") in ("gateway.request", "gateway.stream"):
+                rows.append(sp)
+    if not rows:
+        raise ValueError(
+            f"{path}: no gateway request spans found (expect "
+            "gateway.request/gateway.stream lines from --trace_log)")
+    rows.sort(key=lambda s: s.get("start_ms") or 0.0)
+    t0 = rows[0].get("start_ms") or 0.0
+    events: List[dict] = []
+    for i, sp in enumerate(rows):
+        attrs = sp.get("attrs") or {}
+        chars = attrs.get("chars")
+        if isinstance(chars, (int, float)) and chars > 0:
+            max_tokens = max(1, int(round(chars / chars_per_token)))
+        else:
+            max_tokens = 16  # non-streamed spans don't record output size
+        events.append({
+            "t": round(max(0.0, ((sp.get("start_ms") or t0) - t0) / 1e3), 4),
+            "session": sp.get("trace_id") or f"t{i}",
+            "turn": 0,
+            "messages": [{"role": "user",
+                          "content": _text(rng, prompt_chars)}],
+            "max_tokens": max_tokens,
+            "temperature": temperature,
+            "model": attrs.get("adapter") or "",
+        })
+    meta = {"source": "trace_log", "path": path,
+            "requests": len(events), "prompt_chars": prompt_chars,
+            "chars_per_token": chars_per_token}
+    return meta, events
+
+
 # ----------------------------------------------------------------- trace io
 
 def write_trace(path_or_fp, events: List[dict],

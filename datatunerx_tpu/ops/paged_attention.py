@@ -208,6 +208,24 @@ def paged_insert_row(cache: Dict, slot, table_row: jnp.ndarray,
     return out
 
 
+def row_trim(row: Dict, width: int) -> Dict:
+    """Trim a dense single-row cache to its first ``width`` linear
+    positions — the live prefix of a migrating session (serving/migration
+    serializes only real KV, not the row's unwritten tail). Device-side
+    slicing, so the host transfer that follows moves ``width`` columns
+    instead of the full ``max_seq_len`` row. The inverse (sentinel-padding
+    back to full width) lives in ``serving/migration.unpack_kv_row``."""
+    width = min(width, row["k"].shape[2])
+    out: Dict = {"len": row.get("len")}
+    out["k"] = row["k"][:, :, :width]
+    out["v"] = row["v"][:, :, :width]
+    if "k_scale" in row:
+        out["k_scale"] = row["k_scale"][:, :, :width]
+        out["v_scale"] = row["v_scale"][:, :, :width]
+    out["pos"] = row["pos"][:, :width]
+    return out
+
+
 def paged_extract_row(cache: Dict, slot, cursor) -> Dict:
     """Gather a slot's blocks back into a dense single-row cache (the
     prefix-cache storage format, width = blocks_per_slot × block_size =

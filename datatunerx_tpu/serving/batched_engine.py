@@ -67,6 +67,12 @@ from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
 MAX_STOP = 8  # static per-slot stop-token capacity
 
 
+class _RetryLater(Exception):
+    """A migration command that can't complete THIS tick but may next one
+    (adapter mid-load, no free slot, KV blocks exhausted) — the scheduler
+    re-queues it until its deadline."""
+
+
 class _PrefixCache:
     """Host-side LRU of prefilled single-row KV caches keyed by
     (prompt tokens, adapter). An exact hit skips prefill entirely; the longest
@@ -629,6 +635,17 @@ class BatchedEngine:
         self._admit_wait_reason = ""  # why the last _admit returned False
         self._wake = threading.Event()
         self._shutdown = threading.Event()
+        # KV migration fabric (serving/migration.py): export/import commands
+        # from admin HTTP threads, serviced by the scheduler between decode
+        # chunks — the scheduler owns every piece of slot state, so commands
+        # queue to it instead of locking it. Imports facing a transient
+        # shortage (free slot, KV blocks, adapter mid-load) park in
+        # _mig_retry and re-run next tick until their deadline.
+        self._mig_q: "queue.Queue[dict]" = queue.Queue()
+        self._mig_retry: List[dict] = []
+        # outcome counters behind dtx_serving_session_{export,import}_total
+        self.session_stats: Dict[str, Dict[str, int]] = {
+            "export": {}, "import": {}}
         # scheduler-tick trace, for tests and TTFT/TPOT forensics:
         # ("admit", slot, plen, mode) / ("prefill", slot, ntokens) /
         # ("activate", slot) / ("decode", K) / ("finish", slot)
@@ -1246,6 +1263,317 @@ class BatchedEngine:
         if self.tracing:
             req.mark("activate", slot=slot)
 
+    # ------------------------------------------------- KV migration fabric
+    def export_sessions(self, slots: Optional[Sequence[int]] = None,
+                        wire_quant: Optional[str] = None,
+                        timeout_s: float = 30.0) -> dict:
+        """Serialize every in-flight decode session (or just ``slots``)
+        into portable payloads (serving/migration.py wire format) AND
+        terminate the source requests with the migrated marker — their
+        streams end, and the gateway splices the imported continuation.
+
+        Runs on the scheduler thread (state owner); this call just queues
+        the command and waits. Returns {"sessions": [...], "skipped":
+        [{"slot", "reason"}]} — slots mid-chunked-prefill are skipped
+        (their KV is incomplete; they finish in place on the draining
+        replica, the counted fallback)."""
+        return self._mig_call({"kind": "export",
+                               "slots": (None if slots is None
+                                         else [int(s) for s in slots]),
+                               "wire": wire_quant}, timeout_s)
+
+    def import_session(self, payload: dict, timeout_s: float = 30.0,
+                       wait_s: float = 10.0) -> dict:
+        """Admit an exported session: allocate blocks, scatter the KV row
+        back in (``paged_insert_row`` via the same jitted insert admission
+        uses), restore the decode state — including the slot's live PRNG
+        key, so greedy AND fixed-seed sampled resumption are token-exact —
+        and resume decode.
+
+        Transient shortages (no free slot, KV blocks exhausted, adapter
+        still loading) PARK the import and retry each scheduler tick for
+        up to ``wait_s`` — a busy target admits the migrating session as
+        soon as capacity frees, ahead of its cold FIFO queue — then refuse.
+        Raises ValueError on refusals (including permanent ones: unknown
+        adapter, incompatible model) and RuntimeError on engine faults.
+        The returned meta carries ``"_request"`` (the live Request handle
+        for ``resume_stream``) and ``text_so_far`` (the detokenized
+        migrated tail)."""
+        return self._mig_call(
+            {"kind": "import", "payload": payload,
+             "deadline": time.monotonic() + wait_s}, timeout_s)
+
+    def resume_stream(self, req: Request):
+        """Continuation deltas of an imported session: text BEYOND the
+        migrated tail, streamed as decode produces it (the tail itself was
+        already emitted to the client by the source replica)."""
+        acc = list(req.tokens[: getattr(req, "resume_base", 0)])
+        sent = (self.tokenizer.decode(acc, skip_special_tokens=True)
+                if acc else "")
+        while True:
+            t = req.stream.get()
+            if t is None:
+                break
+            acc.append(t)
+            text = self.tokenizer.decode(acc, skip_special_tokens=True)
+            if len(text) > len(sent) and not text.endswith("�"):
+                yield text[len(sent):]
+                sent = text
+        if req.error:
+            raise RuntimeError(req.error)
+
+    def adapter_catalog(self) -> Dict[str, str]:
+        """Registered adapter name → checkpoint path (dynamic pools only)
+        — what a replacement replica needs to rebuild this replica's
+        warm set."""
+        if self.adapter_registry is None:
+            return {}
+        return {n: self.adapter_registry.describe(n)["checkpoint"]
+                for n in self.adapter_registry.names()}
+
+    def _mig_call(self, cmd: dict, timeout_s: float):
+        if self._shutdown.is_set():
+            raise RuntimeError("engine is shut down")
+        cmd["_done"] = threading.Event()
+        self._mig_q.put(cmd)
+        self._wake.set()
+        if not cmd["_done"].wait(timeout_s):
+            raise TimeoutError(
+                f"engine did not service session {cmd['kind']} within "
+                f"{timeout_s}s")
+        if cmd.get("_error"):
+            if cmd.get("_refused"):
+                raise ValueError(cmd["_error"])
+            raise RuntimeError(cmd["_error"])
+        return cmd["_result"]
+
+    def _count_mig(self, kind: str, outcome: str):
+        d = self.session_stats[kind]
+        d[outcome] = d.get(outcome, 0) + 1
+
+    def _service_migrations(self):
+        if not self._mig_retry and self._mig_q.empty():
+            return
+        pending, self._mig_retry = self._mig_retry, []
+        while True:
+            try:
+                pending.append(self._mig_q.get_nowait())
+            except queue.Empty:
+                break
+        for cmd in pending:
+            try:
+                if cmd["kind"] == "export":
+                    cmd["_result"] = self._do_export(cmd)
+                else:
+                    cmd["_result"] = self._do_import(cmd)
+            except _RetryLater as retry:
+                if time.monotonic() < cmd.get("deadline", 0.0):
+                    cmd["_retry_reason"] = str(retry)
+                    self._mig_retry.append(cmd)
+                    continue
+                cmd["_error"] = str(retry)
+                cmd["_refused"] = True
+                self._count_mig(cmd["kind"], "refused")
+            except (ValueError, KeyError) as e:
+                cmd["_error"] = str(e)
+                cmd["_refused"] = True
+                self._count_mig(cmd["kind"], "refused")
+            except Exception as e:  # noqa: BLE001 — fail the command, not the loop
+                cmd["_error"] = str(e)
+                cmd["_refused"] = False
+                self._count_mig(cmd["kind"], "error")
+            cmd["_done"].set()
+
+    def _do_export(self, cmd: dict) -> dict:
+        want = cmd.get("slots")
+        sessions: List[dict] = []
+        skipped: List[dict] = []
+        for slot in range(self.slots):
+            if want is not None and slot not in want:
+                continue
+            req = self._slot_req[slot]
+            if req is None:
+                if want is not None:
+                    skipped.append({"slot": slot, "reason": "empty"})
+                continue
+            if not self._decode_ready[slot]:
+                skipped.append({"slot": slot,
+                                "reason": "prefill_in_progress"})
+                self._count_mig("export", "skipped_prefill")
+                continue
+            try:
+                payload = self._export_slot(slot, req, cmd.get("wire"))
+            except Exception as e:  # noqa: BLE001 — skip the slot, keep the rest
+                skipped.append({"slot": slot, "reason": str(e)})
+                self._count_mig("export", "error")
+                continue
+            sessions.append(payload)
+            self._count_mig("export", "ok")
+            self._trace("export", slot)
+            if self.tracing:
+                req.mark("export", slot=slot, cursor=payload["cursor"])
+            self._release_slot(slot)
+            # the slot is still ACTIVE on device — every other release
+            # happens after the decode kernel deactivated it. Clear the
+            # mask (and the token budget) NOW: an interleaved decode chunk
+            # would otherwise keep sampling this slot and write a stale
+            # token through the NEXT tenant's freshly-installed block
+            # table while that tenant is still chunk-prefilling.
+            self._active = self._active.at[slot].set(False)
+            self._remaining = self._remaining.at[slot].set(0)
+            from datatunerx_tpu.serving.migration import MIGRATED_SESSION
+
+            self._complete(req, error=f"{MIGRATED_SESSION}: slot exported")
+        return {"sessions": sessions, "skipped": skipped}
+
+    def _export_slot(self, slot: int, req: Request,
+                     wire: Optional[str]) -> dict:
+        from datatunerx_tpu.serving import migration as mig
+
+        # the migration path's designed sync point: the slot's scalar
+        # decode state crosses to host once per exported session
+        cursor, pos, remaining, rng, logits = jax.device_get(  # dtxlint: disable=DTX001
+            (self._cache["len"][slot], self._pos[slot],
+             self._remaining[slot], self._rng[slot], self._logits[slot]))
+        if self.paged:
+            row = self._extract(self._cache, jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(cursor, jnp.int32))
+        else:
+            row = {"k": self._cache["k"][:, slot:slot + 1],
+                   "v": self._cache["v"][:, slot:slot + 1],
+                   "pos": self._cache["pos"][slot:slot + 1],
+                   "len": jnp.asarray(cursor, jnp.int32)}
+            if "k_scale" in self._cache:
+                row["k_scale"] = self._cache["k_scale"][:, slot:slot + 1]
+                row["v_scale"] = self._cache["v_scale"][:, slot:slot + 1]
+        return mig.build_payload(
+            self.cfg, self.kv_quant,
+            request={"trace_id": req.trace_id,
+                     "adapter": req.adapter_name,
+                     "prompt_ids": list(req.prompt_ids),
+                     "tokens": list(req.tokens),
+                     "max_new_tokens": req.max_new_tokens,
+                     "temperature": req.temperature, "top_p": req.top_p,
+                     "seed": req.seed, "stop_ids": list(req.stop_ids)},
+            row=row, cursor=cursor, pos=pos, remaining=remaining,
+            rng=rng, logits=logits, wire=wire)
+
+    def _do_import(self, cmd: dict) -> dict:
+        from datatunerx_tpu.serving import migration as mig
+
+        payload = mig.normalize_payload(cmd["payload"], self.cfg)
+        cursor = payload["cursor"]
+        pos_val = payload["pos"]
+        W = self.max_seq_len
+        if cursor >= W:
+            raise ValueError(
+                f"session depth {cursor} exceeds this replica's context {W}")
+        remaining = max(1, min(payload["remaining"], W - cursor))
+        slot = next((i for i in range(self.slots)
+                     if self._slot_req[i] is None), None)
+        if slot is None:
+            raise _RetryLater(
+                f"no free cache slot to import into ({self.slots} busy)")
+        name = payload["adapter"]
+        idx = 0
+        pinned = False
+        if name:
+            if self.adapter_registry is not None:
+                # hit/miss stats latch across retry ticks, like a
+                # readmission retry at _admit
+                first_lookup = not cmd.get("_adapter_seen", False)
+                cmd["_adapter_seen"] = True
+                try:
+                    acquired = self.adapter_registry.acquire(
+                        name, count_hit=first_lookup)
+                except KeyError:
+                    raise ValueError(
+                        f"unknown adapter {name!r} on this replica")
+                if acquired is None:
+                    # mid-load (or pool pinned): retry next tick until the
+                    # command's deadline — the import itself kicked the
+                    # load-on-miss, same as admission would
+                    loading = self.adapter_registry.describe(
+                        name).get("loading", False)
+                    raise _RetryLater(
+                        f"adapter {name!r} "
+                        + ("still loading" if loading
+                           else "pool exhausted (all slots pinned)"))
+                idx, pinned = acquired, True
+            elif name in self._static_adapter_ids:
+                idx = self._static_adapter_ids[name]
+            else:
+                raise ValueError(f"unknown adapter {name!r} on this replica")
+        blocks: Optional[List[int]] = None
+        try:
+            if self.paged:
+                blocks = self._alloc_blocks(cursor + remaining)
+                if blocks is None:
+                    raise _RetryLater(
+                        "kv blocks exhausted "
+                        f"(need {-(-(cursor + remaining) // self.block_size)}"
+                        f", free {self._allocator.free_count})")
+            row = mig.unpack_kv_row(payload["kv"], full_width=W,
+                                    quantize=self.kv_quant)
+            row_logits = mig.unpack_logits(payload, self.cfg.vocab_size)
+            req = Request(
+                payload["prompt_ids"], payload["max_new_tokens"],
+                payload["temperature"], payload["top_p"],
+                payload["seed"], payload["stop_ids"],
+                idx, adapter_name=name,
+                trace_id=(payload["trace_id"]
+                          or f"dtx-{uuid.uuid4().hex[:16]}"))
+            req.tokens = payload["tokens"]
+            req.resume_base = len(req.tokens)
+            if self.paged:
+                (self._cache, self._logits, self._pos, self._remaining,
+                 self._active, self._temps, self._top_ps, self._stops,
+                 self._adapter_idx, self._rng) = self._insert_paged(
+                    self._cache, self._logits, self._pos, self._remaining,
+                    self._active, self._temps, self._top_ps, self._stops,
+                    self._adapter_idx, self._rng,
+                    jnp.asarray(slot, jnp.int32), self._table_row(blocks),
+                    row, row_logits, jnp.asarray(cursor, jnp.int32),
+                    *self._arm_args(req, pos_val, remaining),
+                )
+            else:
+                (self._cache, self._logits, self._pos, self._remaining,
+                 self._active, self._temps, self._top_ps, self._stops,
+                 self._adapter_idx, self._rng) = self._insert(
+                    self._cache, self._logits, self._pos, self._remaining,
+                    self._active, self._temps, self._top_ps, self._stops,
+                    self._adapter_idx, self._rng,
+                    jnp.asarray(slot, jnp.int32), row, row_logits,
+                    jnp.asarray(cursor, jnp.int32),
+                    *self._arm_args(req, pos_val, remaining),
+                )
+            # token-exact resume: replace the seed-derived key the insert
+            # armed with the SOURCE slot's live rng stream
+            self._rng = self._rng.at[slot].set(
+                jnp.asarray(payload["rng"], jnp.uint32))
+        except Exception:
+            if blocks:
+                self._allocator.free(blocks)
+            if pinned:
+                self.adapter_registry.release(name)
+            raise
+        if pinned:
+            self._slot_adapter[slot] = name
+        self._slot_blocks[slot] = blocks or []
+        self._slot_req[slot] = req
+        self._decode_ready[slot] = True
+        self._count_mig("import", "ok")
+        self._trace("import", slot, cursor)
+        if self.tracing:
+            req.mark("import", slot=slot, cursor=cursor, adapter=name,
+                     tail_tokens=req.resume_base)
+        text = (self.tokenizer.decode(req.tokens, skip_special_tokens=True)
+                if req.tokens else "")
+        return {"session": req.trace_id, "slot": slot,
+                "tokens": req.resume_base, "cursor": cursor,
+                "remaining": remaining, "adapter": name,
+                "text_so_far": text, "_request": req}
+
     def _release_slot(self, slot: int):
         self._slot_req[slot] = None
         self._pending.pop(slot, None)
@@ -1263,6 +1591,10 @@ class BatchedEngine:
 
     def _scheduler(self):
         while not self._shutdown.is_set():
+            # migrations first: an imported session is already mid-decode
+            # (its prefill budget was spent on the source replica), so it
+            # outranks cold admissions for free slots
+            self._service_migrations()
             self._admit_waiting()
             self._prefill_tick()
 
@@ -1455,3 +1787,17 @@ class BatchedEngine:
         self._shutdown.set()
         self._wake.set()
         self._thread.join(timeout=10)
+        # fail any migration commands the scheduler will never service so
+        # their callers don't sit out the full wait timeout (the scheduler
+        # thread is joined above — nothing else touches the retry list now)
+        pending = list(self._mig_retry)
+        self._mig_retry = []  # dtxlint: disable=DTX006 — owner thread already joined
+        while True:
+            try:
+                pending.append(self._mig_q.get_nowait())
+            except queue.Empty:
+                break
+        for cmd in pending:
+            cmd["_error"] = "engine shut down"
+            cmd["_refused"] = False
+            cmd["_done"].set()

@@ -1,0 +1,235 @@
+"""KV session wire format: serialize a live decode session for transfer.
+
+The KV migration fabric (ROADMAP) moves an in-flight session between
+replicas without re-prefilling: the source engine exports the slot's KV
+prefix (a dense row, trimmed to its live cursor), the decode state that
+makes resumption token-exact (next-token logits, the slot's live PRNG key,
+position/remaining cursors, sampling params), the generated-token tail,
+and the adapter *name* (PR 10: names are the stable cross-fleet identity —
+pool slot indices are replica-local). The target allocates blocks, scatters
+the row back in via ``paged_insert_row``, and decode continues as if the
+session had never moved.
+
+Wire encodings for the KV row:
+
+  bf16  — the cache's native bf16 bytes (LOSSLESS: resumed decode is
+          bit-identical to an undisturbed run). The default for bf16
+          caches.
+  int8  — the ``kv_quant`` representation (int8 values + per-vector f32
+          scales over head_dim, ``ops/attention.py kv_quantize``). The
+          default — and exact — encoding for ``kv_quant="int8"`` engines,
+          whose cache already holds these bytes; selecting it for a bf16
+          cache halves the payload but rounds the prefix through int8
+          (bounded, but no longer bit-exact).
+
+Cross-encoding imports are supported in every direction (bf16 wire into an
+int8 cache re-quantizes through the same kv_quantize path; int8 wire into a
+bf16 cache dequantizes), so heterogeneous fleets can still hand sessions
+around. Payloads are JSON with base64 array bodies — they ride the admin
+HTTP surface (``POST /admin/sessions/export`` / ``/import``).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from datatunerx_tpu.ops.attention import kv_quantize
+from datatunerx_tpu.ops.paged_attention import POS_SENTINEL, row_trim
+
+PAYLOAD_KIND = "dtx-kv-session"
+PAYLOAD_VERSION = 1
+
+# The error string a migrated-away request dies with. The gateway matches
+# on it (gateway/replica_pool.py MIGRATED_MARKER keeps the same literal —
+# it must survive an SSE error event crossing the wire as plain text) to
+# splice the imported continuation instead of re-prefilling.
+MIGRATED_SESSION = "session migrated"
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode()
+
+
+def _unb64(data: str, dtype, shape) -> np.ndarray:
+    buf = base64.b64decode(data.encode())
+    arr = np.frombuffer(buf, dtype=dtype)
+    if arr.size != int(np.prod(shape)):
+        raise ValueError(
+            f"kv payload body holds {arr.size} elements, shape {shape} "
+            f"needs {int(np.prod(shape))}")
+    return arr.reshape(shape)
+
+
+def model_signature(cfg, kv_quant: Optional[str]) -> dict:
+    """What must match (or be convertible) for an import to be correct."""
+    return {"layers": cfg.num_layers, "kv_heads": cfg.num_kv_heads,
+            "head_dim": cfg.head_dim, "vocab": cfg.vocab_size,
+            "kv_quant": kv_quant or ""}
+
+
+def check_signature(payload: dict, cfg) -> None:
+    sig = payload.get("model_sig") or {}
+    for key, want in (("layers", cfg.num_layers),
+                      ("kv_heads", cfg.num_kv_heads),
+                      ("head_dim", cfg.head_dim),
+                      ("vocab", cfg.vocab_size)):
+        if sig.get(key) != want:
+            raise ValueError(
+                f"session payload is from an incompatible model: "
+                f"{key}={sig.get(key)} here {want}")
+    if payload.get("kind") != PAYLOAD_KIND:
+        raise ValueError(
+            f"not a {PAYLOAD_KIND} payload (kind={payload.get('kind')!r})")
+    if payload.get("version") != PAYLOAD_VERSION:
+        raise ValueError(
+            f"unsupported session payload version {payload.get('version')!r}")
+
+
+def pack_kv_row(row: Dict, cursor: int, wire: str) -> dict:
+    """A dense row cache (``paged_extract_row`` output or a dense-cache
+    slot slice) → JSON-safe wire doc, trimmed to the live ``cursor``.
+
+    ``wire`` is "int8" or "bf16"; int8 input rows (kv_quant caches) are
+    shipped as-is under "int8" (exact), and a bf16 row asked for "int8"
+    goes through kv_quantize (the over-the-wire compression path)."""
+    row = row_trim(row, max(1, cursor))
+    k, v = row["k"], row["v"]
+    quantized_cache = "k_scale" in row
+    if wire == "int8" and not quantized_cache:
+        # host transfer happens inside kv_quantize's consumers; do the
+        # quantization on device, then pull the small int8 bodies
+        k, ks = kv_quantize(k)
+        v, vs = kv_quantize(v)
+    elif quantized_cache:
+        wire = "int8"  # an int8 cache's bytes ARE the int8 wire encoding
+        ks, vs = row["k_scale"], row["v_scale"]
+    else:
+        wire = "bf16"
+        ks = vs = None
+    # the migration path's designed host sync: one device_get per array
+    k_np = np.asarray(k)  # dtxlint: disable=DTX001 — migration serialization point
+    v_np = np.asarray(v)  # dtxlint: disable=DTX001 — migration serialization point
+    pos_np = np.asarray(row["pos"], np.int32)  # dtxlint: disable=DTX001 — migration serialization point
+    L, _, W, KV, d = k_np.shape
+    doc = {
+        "wire": wire, "width": int(W), "layers": int(L),
+        "kv_heads": int(KV), "head_dim": int(d),
+        "k": _b64(k_np), "v": _b64(v_np),
+        "pos": _b64(pos_np),
+    }
+    if wire == "int8":
+        doc["k_scale"] = _b64(np.asarray(ks, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
+        doc["v_scale"] = _b64(np.asarray(vs, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
+    return doc
+
+
+def unpack_kv_row(doc: dict, full_width: int,
+                  quantize: Optional[str]) -> Dict:
+    """Wire doc → a dense row cache dict shaped for this engine's cache
+    (``[L, 1, full_width, KV, d]`` + sentinel-padded positions), converting
+    between int8 and bf16 encodings as the target's ``quantize`` demands."""
+    L, W = int(doc["layers"]), int(doc["width"])
+    KV, d = int(doc["kv_heads"]), int(doc["head_dim"])
+    if W > full_width:
+        raise ValueError(
+            f"session KV depth {W} exceeds this replica's context "
+            f"{full_width}")
+    wire = doc.get("wire") or "bf16"
+    shape = (L, 1, W, KV, d)
+    if wire == "int8":
+        k = _unb64(doc["k"], np.int8, shape)
+        v = _unb64(doc["v"], np.int8, shape)
+        ks = _unb64(doc["k_scale"], np.float32, shape[:-1])
+        vs = _unb64(doc["v_scale"], np.float32, shape[:-1])
+    elif wire == "bf16":
+        k = _unb64(doc["k"], jnp.bfloat16, shape)
+        v = _unb64(doc["v"], jnp.bfloat16, shape)
+        ks = vs = None
+    else:
+        raise ValueError(f"unknown kv wire encoding {wire!r}")
+    pos = _unb64(doc["pos"], np.int32, (1, W))
+
+    def _pad(a: np.ndarray, fill=0) -> jnp.ndarray:
+        widths = [(0, 0)] * a.ndim
+        widths[2 if a.ndim >= 3 else 1] = (0, full_width - W)
+        return jnp.asarray(np.pad(a, widths, constant_values=fill))
+
+    row: Dict = {"pos": _pad(pos, fill=POS_SENTINEL)}
+    if quantize == "int8":
+        if wire != "int8":  # bf16 wire into an int8 cache: re-quantize
+            kq, ks_j = kv_quantize(jnp.asarray(k))
+            vq, vs_j = kv_quantize(jnp.asarray(v))
+            k = np.asarray(kq)  # dtxlint: disable=DTX001 — migration deserialization point
+            v = np.asarray(vq)  # dtxlint: disable=DTX001 — migration deserialization point
+            ks = np.asarray(ks_j)  # dtxlint: disable=DTX001 — migration deserialization point
+            vs = np.asarray(vs_j)  # dtxlint: disable=DTX001 — migration deserialization point
+        row["k"], row["v"] = _pad(k), _pad(v)
+        row["k_scale"], row["v_scale"] = _pad(ks), _pad(vs)
+    else:
+        if wire == "int8":  # int8 wire into a bf16 cache: dequantize
+            k = (k.astype(np.float32) * ks[..., None])
+            v = (v.astype(np.float32) * vs[..., None])
+        row["k"] = _pad(k.astype(jnp.bfloat16))
+        row["v"] = _pad(v.astype(jnp.bfloat16))
+    return row
+
+
+def pack_logits(logits) -> str:
+    return _b64(np.asarray(logits, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
+
+
+def unpack_logits(payload: dict, vocab: int) -> jnp.ndarray:
+    return jnp.asarray(_unb64(payload["logits"], np.float32, (vocab,)))
+
+
+def build_payload(cfg, kv_quant: Optional[str], request: dict, row: Dict,
+                  cursor, pos, remaining, rng, logits,
+                  wire: Optional[str] = None) -> dict:
+    """Assemble the full wire payload for one exported session.
+
+    ``request`` carries the Request's host-side fields (trace_id, adapter
+    name, prompt/token lists, sampling params); ``cursor``/``pos``/
+    ``remaining``/``rng``/``logits`` are the slot's decode-state scalars,
+    already device_get'd by the engine; ``row`` is the (device) dense KV
+    row this function trims, encodes, and pulls to host."""
+    cursor = int(cursor)
+    default_wire = "int8" if kv_quant == "int8" else "bf16"
+    return {
+        "kind": PAYLOAD_KIND, "version": PAYLOAD_VERSION,
+        **request,
+        "pos": int(pos), "remaining": int(remaining), "cursor": cursor,
+        "rng": [int(x) for x in np.asarray(rng, np.uint32)],
+        "logits": pack_logits(logits),
+        "kv": pack_kv_row(row, cursor, wire or default_wire),
+        "model_sig": model_signature(cfg, kv_quant),
+    }
+
+
+def normalize_payload(payload: dict, cfg) -> dict:
+    """Validate an incoming payload against this engine's model and cast
+    every scalar the import consumes to its canonical host type — the one
+    place JSON-shaped input is trusted-but-verified."""
+    check_signature(payload, cfg)
+    out = dict(payload)
+    out["cursor"] = int(payload["cursor"])
+    out["pos"] = int(payload["pos"])
+    out["remaining"] = int(payload["remaining"])
+    out["max_new_tokens"] = int(payload.get("max_new_tokens",
+                                            out["remaining"]))
+    out["temperature"] = float(payload.get("temperature", 0.0))
+    out["top_p"] = float(payload.get("top_p", 1.0))
+    out["seed"] = int(payload.get("seed", 0))
+    out["stop_ids"] = [int(s) for s in (payload.get("stop_ids") or [])]
+    out["prompt_ids"] = [int(t) for t in (payload.get("prompt_ids") or [])]
+    out["tokens"] = [int(t) for t in (payload.get("tokens") or [])]
+    out["adapter"] = str(payload.get("adapter") or "")
+    out["trace_id"] = str(payload.get("trace_id") or "")
+    rng = payload.get("rng") or []
+    if len(rng) != 2:
+        raise ValueError("session payload rng must be a 2-word PRNG key")
+    out["rng"] = [int(x) for x in rng]
+    return out

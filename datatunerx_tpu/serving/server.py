@@ -189,6 +189,22 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
         a_evict.set(occ.get("evictions", 0))
         a_hits.set(occ.get("hits", 0))
         a_miss.set(occ.get("misses", 0))
+    # KV migration fabric: session export/import outcomes (restated from
+    # the engine's scheduler-thread counters, cleared first like the rest)
+    s_exp = reg.counter("dtx_serving_session_export_total",
+                        "Live decode sessions exported for replica-to-"
+                        "replica handoff, by outcome.")
+    s_imp = reg.counter("dtx_serving_session_import_total",
+                        "Exported sessions imported (re-prefill-free "
+                        "resume), by outcome.")
+    s_exp.clear()
+    s_imp.clear()
+    sess_stats = getattr(eng, "session_stats", None)
+    if isinstance(sess_stats, dict):
+        for outcome, n in sorted((sess_stats.get("export") or {}).items()):
+            s_exp.set(n, {"outcome": outcome})
+        for outcome, n in sorted((sess_stats.get("import") or {}).items()):
+            s_imp.set(n, {"outcome": outcome})
     # per-adapter demand: prefer the occupancy doc's LOCK-GUARDED copy
     # (dynamic engines); static engines snapshot under the engine's own
     # lock — copying the live dict bare would race a concurrent submit
@@ -274,10 +290,14 @@ class Handler(BaseHTTPRequestHandler):
                 "resident": sorted(n for n in (ids or {}) if n),
             })
             return
+        catalog_fn = getattr(eng, "adapter_catalog", None)
         self._json(200, {
             "dynamic": True,
             "registered": occ.pop("registered_adapters", []),
             "resident": occ.pop("resident_adapters", []),
+            # name → checkpoint: what a replacement replica needs to
+            # rebuild this warm set (ManagedReplicaSet drain inheritance)
+            "checkpoints": (catalog_fn() if callable(catalog_fn) else {}),
             "pool": occ,
         })
 
@@ -404,9 +424,108 @@ class Handler(BaseHTTPRequestHandler):
         # echo the CLAMPED window, not the request — what will actually run
         self._json(202, {"profiling": log_dir, "seconds": effective})
 
+    # --------------------------------------------------- KV migration fabric
+    def _sessions_export(self, req: dict):
+        """POST /admin/sessions/export {"slots": [..]?, "wire":
+        "bf16"|"int8"?} — serialize (and terminate) in-flight decode
+        sessions for replica-to-replica handoff. 501 on engines without
+        the migration surface."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        fn = getattr(eng, "export_sessions", None)
+        if not callable(fn):
+            self._json(501, {"error": "engine has no session export"})
+            return
+        try:
+            self._json(200, fn(slots=req.get("slots"),
+                               wire_quant=req.get("wire") or None))
+        except TimeoutError as e:
+            self._json(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — serving must answer
+            self._json(500, {"error": str(e)})
+
+    def _sessions_import(self, req: dict):
+        """POST /admin/sessions/import <payload> — admit an exported
+        session and resume its decode. Default response is an SSE stream:
+        first event ``{"imported": meta}``, then ``{"delta": text}``
+        continuation events (text beyond the migrated tail), then
+        ``[DONE]`` — one round-trip carries the receipt AND the spliced
+        stream. ``"stream": false`` blocks until the session finishes and
+        returns the full text (tooling/tests). 409 on a refusal the
+        caller should fall back cold on (no slot, blocks exhausted,
+        unknown adapter, incompatible payload)."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        fn = getattr(eng, "import_session", None)
+        if not callable(fn):
+            self._json(501, {"error": "engine has no session import"})
+            return
+        stream = bool(req.pop("stream", True))
+        try:
+            meta = dict(fn(req))
+        except (ValueError, KeyError) as e:
+            self._json(409, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._json(503, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001
+            self._json(500, {"error": str(e)})
+            return
+        handle = meta.pop("_request", None)
+        if not stream:
+            if handle is not None:
+                handle.done.wait(300)
+                meta["error"] = handle.error
+                meta["text"] = eng.tokenizer.decode(
+                    handle.tokens, skip_special_tokens=True)
+            self._json(200, {"imported": meta})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+
+        def event(payload: dict):
+            self.wfile.write(b"data: " + json.dumps(payload).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
+        code = 200
+        try:
+            event({"imported": meta})
+            try:
+                if handle is not None:
+                    for delta in eng.resume_stream(handle):
+                        event({"delta": delta})
+            except Exception as e:  # noqa: BLE001 — headers already sent
+                event({"error": {"message": str(e)}})
+                code = 500
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            code = 499
+        self._record(code)
+
     def do_POST(self):
         if self.path == "/perplexity":
             self._perplexity()
+            return
+        if self.path in ("/admin/sessions/export", "/admin/sessions/import"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"invalid JSON body: {e}"})
+                return
+            if self.path.endswith("/export"):
+                self._sessions_export(req)
+            else:
+                self._sessions_import(req)
             return
         if self.path == "/admin/adapters":
             try:

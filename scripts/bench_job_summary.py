@@ -87,6 +87,15 @@ def main() -> int:
                     f"p95={rp.get('ttft_ms_p95')}ms "
                     f"p99={rp.get('ttft_ms_p99')}ms · "
                     f"chaos: {chaos_ops or 'none'}")
+            ho = rp.get("handoff")
+            if isinstance(ho, dict):
+                cold = last.get("replay_cold") or {}
+                row += ("\n  - replay drain handoff: "
+                        f"imported={ho.get('imported', 0)} "
+                        f"cold={ho.get('cold', 0)} "
+                        f"re_prefills={rp.get('re_prefills', 0)} "
+                        f"(handoff-off baseline: "
+                        f"re_prefills={cold.get('re_prefills', '?')})")
             if rp.get("slo_pass"):
                 row += "\n  - replay SLO verdict: **PASS**"
             else:

@@ -88,6 +88,9 @@ class _StatsEngine:
     slots = 4
     _slot_req = [object(), None, None, None]
     prefill_stats = {"full": 2, "reuse": 1, "extend": 0}
+    # KV migration fabric outcome counters (dtx_serving_session_* series)
+    session_stats = {"export": {"ok": 2, "skipped_prefill": 1},
+                     "import": {"ok": 2, "refused": 1}}
     adapter_ids = {"": 0, "tenant-a": 1, "tenant-b": -1}
     resident_adapters = {"tenant-a": 1}
     adapter_requests = {"": 3, "tenant-a": 2, "tenant-b": 1}

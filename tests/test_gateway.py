@@ -663,11 +663,36 @@ class FakeProc:
         return self.returncode
 
 
+class WarmableFakeEngine(FakeEngine):
+    """FakeEngine + the dynamic-adapter surface inheritance reads/writes:
+    a resident warm set with checkpoints, and a load_adapter recorder."""
+
+    def __init__(self, name, warm_set=None, **kw):
+        super().__init__(name, adapters=tuple(warm_set or ()), **kw)
+        self._warm = dict(warm_set or {})
+        self.resident_adapters = dict.fromkeys(self._warm, 1)
+        self.loaded: list = []
+
+    def adapter_catalog(self):
+        return dict(self._warm)
+
+    def load_adapter(self, name, checkpoint, preload=True):
+        self.loaded.append((name, checkpoint))
+        self._warm[name] = checkpoint
+        self.resident_adapters[name] = 1
+        return {"name": name, "checkpoint": checkpoint}
+
+    def healthy(self):
+        return True
+
+
 class FakeManagedReplicaSet(ManagedReplicaSet):
     """ManagedReplicaSet whose spawn() creates an in-process replica and a
     FakeProc instead of a real serving.server subprocess — the reap logic
-    under test (drain → terminate → pool removal → replacement) is
-    identical."""
+    under test (drain → terminate → pool removal → replacement → weight +
+    warm-set inheritance) is identical."""
+
+    engine_factory = staticmethod(lambda name: FakeEngine(name))
 
     def spawn(self):
         with self._lock:
@@ -676,7 +701,8 @@ class FakeManagedReplicaSet(ManagedReplicaSet):
         name = f"replica-{idx}"
         with self._lock:
             self._procs[name] = FakeProc()
-        replica = InProcessReplica(name, FakeEngine(name))
+        replica = InProcessReplica(name, self.engine_factory(name))
+        self._apply_inheritance(replica)
         self.pool.add(replica)
         return replica
 
@@ -757,6 +783,51 @@ def test_pool_level_drain_is_reaped_by_supervisor(tmp_path):
         assert _wait_until(lambda: pool.get("replica-1") is None)
         assert "replica-1" not in mrs._procs
         assert _wait_until(lambda: len(pool.replicas()) == 2)
+    finally:
+        mrs.close()
+        pool.close()
+
+
+def test_drain_replacement_inherits_weight_and_warm_set(tmp_path):
+    """Regression: the replacement spawned for a drained replica used to
+    join at defaults (weight 1.0, cold adapter pool) — mid-promotion that
+    skews the smooth-WRR shares, and every tenant pays load-on-miss again.
+    Now it inherits the drained replica's traffic weight at spawn and
+    rebuilds its resident warm set once healthy."""
+    pool = ReplicaPool()
+    mrs = FakeManagedReplicaSet(pool, [], workdir=str(tmp_path / "w"),
+                                drain_timeout_s=2.0, supervise_interval_s=0)
+    mrs.engine_factory = staticmethod(
+        lambda name: WarmableFakeEngine(name))
+    gw = Gateway(pool)
+    gw.replica_set = mrs
+    try:
+        mrs.scale(2)
+        drained = pool.get("replica-0")
+        drained.weight = 0.25  # mid-promotion canary share
+        drained.engine._warm = {"tenant-a": "/ckpts/a",
+                                "tenant-b": "/ckpts/b"}
+        drained.engine.resident_adapters = {"tenant-a": 1, "tenant-b": 1}
+
+        assert gw.drain("replica-0")
+        assert _wait_until(lambda: pool.get("replica-2") is not None)
+        replacement = pool.get("replica-2")
+        assert replacement.weight == 0.25, \
+            "replacement must inherit the drained replica's traffic weight"
+        assert _wait_until(
+            lambda: sorted(replacement.engine.loaded) == [
+                ("tenant-a", "/ckpts/a"), ("tenant-b", "/ckpts/b")]), \
+            replacement.engine.loaded
+
+        # a DOWNSCALED replica's state is NOT inherited: the next scale-up
+        # spawn joins at defaults (no stale entry misapplied)
+        pool.get("replica-1").weight = 0.5
+        mrs.scale(1)
+        assert _wait_until(lambda: len(pool.replicas()) == 1)
+        mrs.scale(2)
+        assert _wait_until(lambda: len(pool.replicas()) == 2)
+        newest = max(pool.replicas(), key=lambda r: r.name)
+        assert newest.weight == 1.0
     finally:
         mrs.close()
         pool.close()
