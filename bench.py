@@ -196,6 +196,10 @@ def child_serve(preflight=None):
     paged = os.environ.get("DTX_BENCH_SERVE_PAGED", "1") != "0"
     block = int(os.environ.get("DTX_BENCH_BLOCK_SIZE", "16"))
     budget = int(os.environ.get("DTX_BENCH_PREFILL_BUDGET", "256"))
+    # decode path: auto = Pallas in-place kernel on TPU, XLA gather
+    # elsewhere; "on" forces the kernel (interpret-mode on CPU — slower,
+    # smoke-only) so the kernel-vs-gather contract runs on every platform
+    kernel_mode = os.environ.get("DTX_BENCH_SERVE_KERNEL", "auto")
     # adapter-churn mode: M synthetic tenant adapters rotate through a
     # P-slot pool with M > P, so the run exercises load-on-miss + LRU
     # eviction under mixed traffic and reports adapter hit rate + load
@@ -215,21 +219,45 @@ def child_serve(preflight=None):
         adapter_ckpts = make_adapter_sweep(tmpdir, f"preset:{model}",
                                            n_adapters)
         adapter_names = sorted(adapter_ckpts)
-    eng = BatchedEngine(
-        f"preset:{model}", template="vanilla", max_seq_len=max_seq,
-        slots=slots, decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK",
-                                                     "8")),
+    decode_chunk = int(os.environ.get("DTX_BENCH_DECODE_CHUNK", "8"))
+    engine_kw = dict(
+        template="vanilla", max_seq_len=max_seq, slots=slots,
+        decode_chunk=decode_chunk,
         adapters=adapter_ckpts or None,
         adapter_pool=adapter_pool if n_adapters else 0,
         kv_block_size=block if paged else 0,
         prefill_token_budget=budget if paged else 0,
     )
+    eng = BatchedEngine(f"preset:{model}",
+                        paged_kernel=kernel_mode if paged else "auto",
+                        **engine_kw)
+    decode_parity_checked = False
     try:
         tok = eng.tokenizer
         short_ids = tok.encode("a quick question about the weather today")
         long_ids = tok.encode("background context " * (max_seq // 4))
         eng.generate(short_ids, max_new_tokens=2)  # compile prefill+decode
         eng.generate(long_ids, max_new_tokens=2)
+
+        if eng.paged_kernel:
+            # a fast-but-wrong number must be unreportable: before the
+            # clock starts, the kernel engine's outputs are asserted
+            # token-identical (greedy AND fixed-seed sampled) against a
+            # gather-oracle twin sharing every other knob
+            oracle = BatchedEngine(f"preset:{model}", paged_kernel="off",
+                                   **engine_kw)
+            try:
+                for ids in (short_ids, long_ids[: max_seq // 4]):
+                    for kw in ({}, {"temperature": 0.8, "top_p": 0.9,
+                                    "seed": 11}):
+                        want = oracle.generate(ids, max_new_tokens=8, **kw)
+                        got = eng.generate(ids, max_new_tokens=8, **kw)
+                        assert got == want, (
+                            "paged kernel diverged from the gather oracle "
+                            f"(kw={kw}): {got} != {want}")
+            finally:
+                oracle.close()
+            decode_parity_checked = True
 
         lock = threading.Lock()
         per_req = []  # (t_submit, [token arrival times])
@@ -279,8 +307,10 @@ def child_serve(preflight=None):
     mean = lambda xs: sum(xs) / len(xs) if xs else 0.0
     pct = lambda xs, q: (xs[min(len(xs) - 1, int(q * len(xs)))]
                          if xs else 0.0)
+    decode_path = eng.decode_path
     tag = (f"{model},slots{slots}," +
            (f"paged,bs{block},budget{budget}" if paged else "dense") +
+           (",kernel" if decode_path == "pallas" else "") +
            (f",adapters{n_adapters}/pool{adapter_pool}"
             if n_adapters else ""))
     line = {
@@ -292,10 +322,17 @@ def child_serve(preflight=None):
         # signal: the MEASURED platform, straight from the device that ran
         "platform": jax.devices()[0].platform,
         "cpu_fallback": not on_tpu,
+        # decode-path provenance next to platform/cpu_fallback: which
+        # attention read served this number (pallas kernel / XLA gather /
+        # dense), and whether the kernel run passed its pre-clock
+        # token-parity gate against the gather oracle
+        "paged_kernel": decode_path == "pallas",
+        "decode_path": decode_path,
         "serve": {
             "requests": len(per_req),
             "errors": len(errors),
             "tokens": total_tokens,
+            "decode_parity_checked": decode_parity_checked,
             "ttft_ms_mean": round(mean(ttfts) * 1e3, 1),
             "ttft_ms_p50": round(pct(ttfts, 0.5) * 1e3, 1),
             "ttft_ms_p95": round(pct(ttfts, 0.95) * 1e3, 1),
@@ -479,9 +516,13 @@ def child_replay(preflight=None):
 # The probe reports each phase AS IT COMPLETES (one JSON line, flushed), so
 # when the backend wedges the parent can read the partial stdout of the
 # killed child and name the phase that hung — backend init, the first XLA
-# compile, or the first execution. That turns the ROADMAP "TPU hang since
-# r03" line from a mystery into a diagnosis.
-PREFLIGHT_PHASES = ("backend_init", "first_compile", "first_execute")
+# compile, the first execution, or the first PALLAS (Mosaic) compile+run.
+# That turns the ROADMAP "TPU hang since r03" line from a mystery into a
+# diagnosis: if the plain-XLA phases pass but pallas_execute hangs, the
+# Mosaic pipeline (which the paged-decode kernel rides) is the suspect —
+# not the backend.
+PREFLIGHT_PHASES = ("backend_init", "first_compile", "first_execute",
+                    "pallas_execute")
 
 _PREFLIGHT_CODE = """\
 import json, os, time
@@ -502,6 +543,19 @@ print(json.dumps({"phase": "first_compile",
 out = float(compiled(x)[0, 0])
 t3 = time.perf_counter()
 print(json.dumps({"phase": "first_execute", "ms": round((t3 - t2) * 1e3, 1),
+                  "result": out}), flush=True)
+# tiny Pallas kernel through the real Mosaic pipeline on TPU (interpret
+# emulation elsewhere) — self-contained so the probe needs no repo import;
+# engineered to reproduce the matmul phases' 256.0 check value
+from jax.experimental import pallas as pl
+def _k(a_ref, o_ref):
+    o_ref[:] = a_ref[:] + a_ref[:]
+a = jnp.full((128, 128), 128.0, jnp.float32)
+pk = pl.pallas_call(_k, out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    interpret=dev.platform != "tpu")
+out = float(pk(a)[0, 0])
+t4 = time.perf_counter()
+print(json.dumps({"phase": "pallas_execute", "ms": round((t4 - t3) * 1e3, 1),
                   "result": out}), flush=True)
 """
 

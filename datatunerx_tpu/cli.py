@@ -229,6 +229,7 @@ def cmd_serve(args):
             "--adapter_rank_max", str(args.adapter_rank_max),
             "--kv_block_size", str(args.kv_block_size),
             "--kv_blocks", str(args.kv_blocks),
+            "--paged_kernel", args.paged_kernel,
             "--prefill_token_budget", str(args.prefill_token_budget),
             "--replicas", str(max(args.replicas, 1)),
             "--policy", args.policy,
@@ -253,6 +254,7 @@ def cmd_serve(args):
         "--adapter_rank_max", str(args.adapter_rank_max),
         "--kv_block_size", str(args.kv_block_size),
         "--kv_blocks", str(args.kv_blocks),
+        "--paged_kernel", args.paged_kernel,
         "--prefill_token_budget", str(args.prefill_token_budget),
     ]
     return serving_main(argv)
@@ -416,6 +418,11 @@ def main(argv=None):
                     help="paged KV cache block size in tokens (0 = dense)")
     vp.add_argument("--kv_blocks", type=int, default=0,
                     help="paged pool size in blocks (default: dense parity)")
+    vp.add_argument("--paged_kernel", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="Pallas in-place paged decode kernel: auto = "
+                         "kernel on TPU / gather elsewhere, on = force "
+                         "(interpret-mode on CPU), off = gather oracle")
     vp.add_argument("--prefill_token_budget", type=int, default=0,
                     help="prefill tokens per scheduler tick between decode "
                          "chunks (0 = unbounded)")

@@ -1461,6 +1461,8 @@ def main(argv=None):
     p.add_argument("--prefix_cache", type=int, default=0)
     p.add_argument("--kv_block_size", type=int, default=0)
     p.add_argument("--kv_blocks", type=int, default=0)
+    p.add_argument("--paged_kernel", default="auto",
+                   choices=["auto", "on", "off"])
     p.add_argument("--prefill_chunk", type=int, default=256)
     p.add_argument("--prefill_token_budget", type=int, default=0)
     args = p.parse_args(argv)
@@ -1515,6 +1517,7 @@ def main(argv=None):
                        "--prefix_cache", str(args.prefix_cache),
                        "--kv_block_size", str(args.kv_block_size),
                        "--kv_blocks", str(args.kv_blocks),
+                       "--paged_kernel", args.paged_kernel,
                        "--prefill_chunk", str(args.prefill_chunk),
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]

@@ -44,6 +44,13 @@ class ModelConfig:
     # Replaces bitsandbytes (reference cmd/tuning/train.py:224-234).
     quantization: Optional[str] = None
     quant_impl: str = "xla"  # "xla" | "pallas"
+    # paged-decode attention kernel (ops/pallas_paged_attention.py): True
+    # routes single-token decode over a block-table cache through the Pallas
+    # in-place kernel instead of the XLA gather; engages only when the cache
+    # is paged, T == 1, and sliding_window is None (everything else keeps
+    # the gather oracle). Resolved by the serving engine from its
+    # --paged_kernel auto|on|off flag; training never sets it.
+    paged_kernel: bool = False
 
     def __post_init__(self):
         if self.head_dim is None:

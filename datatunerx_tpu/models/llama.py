@@ -36,6 +36,7 @@ from datatunerx_tpu.ops.attention import (
     cache_positions_update,
     kv_cache_update,
     kv_cache_width,
+    kv_cache_write_paged,
     make_causal_bias,
 )
 from datatunerx_tpu.ops.paged_attention import POS_SENTINEL
@@ -231,6 +232,18 @@ def forward(
         seq_len=seq_len,
     )
 
+    # Pallas in-place decode: single-token steps over a paged cache read the
+    # K/V blocks through the block table inside the kernel — no gathered
+    # [B, W, KV, d] view, no [B, 1, T, W] bias tensor. Everything else
+    # (prefill, chunked prefill, sliding window, dense caches) keeps the
+    # gather path, which doubles as the kernel's parity oracle.
+    paged_kernel = (
+        cache is not None
+        and "block_tables" in cache
+        and getattr(cfg, "paged_kernel", False)
+        and T == 1
+        and cfg.sliding_window is None
+    )
     if cache is None:
         kv_positions = positions
         kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
@@ -239,9 +252,10 @@ def forward(
     else:
         # record each new slot's rope position; pads (attention_mask 0) get
         # the sentinel so the causal check masks them everywhere. The paged
-        # cache returns the gathered per-slot linear view as kv_positions.
+        # cache returns the gathered per-slot linear view as kv_positions
+        # (or None on the kernel path, which masks the pos POOL in place).
         cache_pos, kv_positions = cache_positions_update(
-            cache, positions, attention_mask)
+            cache, positions, attention_mask, gather=not paged_kernel)
         kv_valid = None  # sentinel positions handle both unwritten and pads
         kv_seg = None
     # flash/ring kernels skip the [B, T, S] bias entirely (building it would
@@ -255,7 +269,7 @@ def forward(
         and (cfg.attention_impl != "ring" or segment_ids is None)
         and (cfg.attention_impl != "flash" or T % 128 == 0 or T < 128)
     )
-    if _flash_ok:
+    if _flash_ok or paged_kernel:
         bias = None
     else:
         bias = make_causal_bias(
@@ -304,16 +318,30 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        if ck is not None:
-            # dense (scalar/per-slot cursor) or paged (block-table) write +
-            # full-width read via the shared cache interface (ops/attention)
-            ck, cv, cks, cvs, k_att, v_att = kv_cache_update(
-                cache, ck, cv, cks, cvs, k, v)
-        else:
-            k_att, v_att = k, v
+        if ck is not None and paged_kernel:
+            # in-place decode: scatter the token's K/V into its blocks, then
+            # the Pallas kernel reads them back through the block table —
+            # the [B, W, KV, d] gathered view never materializes
+            from datatunerx_tpu.ops.pallas_paged_attention import (
+                paged_attention_decode_step,
+            )
 
-        attn = attention(q, k_att, v_att, bias, impl=att_impl,
-                         segment_ids=segment_ids if att_impl == "flash" else None)
+            ck, cv, cks, cvs = kv_cache_write_paged(
+                cache, ck, cv, cks, cvs, k, v)
+            attn = paged_attention_decode_step(
+                q, ck, cv, cks, cvs, cache, cache_pos, positions)
+        else:
+            if ck is not None:
+                # dense (scalar/per-slot cursor) or paged (block-table)
+                # write + full-width read via the shared cache interface
+                ck, cv, cks, cvs, k_att, v_att = kv_cache_update(
+                    cache, ck, cv, cks, cvs, k, v)
+            else:
+                k_att, v_att = k, v
+
+            attn = attention(
+                q, k_att, v_att, bias, impl=att_impl,
+                segment_ids=segment_ids if att_impl == "flash" else None)
         attn = attn.reshape(B, T, cfg.q_dim)
         x = x + _proj(attn, lp["o_proj"], lget("o_proj"), lora_scale, kget(3),
                       drop, qm, (cfg.q_dim, D), qp, lora_adapter_idx)

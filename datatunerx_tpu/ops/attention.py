@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from datatunerx_tpu.ops.paged_attention import (
     POS_SENTINEL,
     paged_kv_update,
+    paged_kv_write,
     paged_record_positions,
     paged_view_width,
 )
@@ -100,19 +101,21 @@ def kv_cache_width(cache: dict) -> int:
 
 
 def cache_positions_update(cache: dict, positions: jnp.ndarray,
-                           attention_mask):
+                           attention_mask, gather: bool = True):
     """Record the new tokens' rope positions at each slot's write cursor.
 
     Returns ``(pos_state, kv_positions)``: the updated position state (dense
     [B, S] table, or the paged [NB, bs] pool) and the per-slot linear
     position view ``[B, W]`` the causal bias masks against. Pads
-    (attention_mask 0) get POS_SENTINEL so they are masked everywhere."""
+    (attention_mask 0) get POS_SENTINEL so they are masked everywhere.
+    ``gather=False`` (paged kernel decode) skips the gathered view — the
+    kernel masks against the pos pool in place — returning ``(pool, None)``."""
     pos_update = positions
     if attention_mask is not None:
         pos_update = jnp.where(attention_mask.astype(bool), positions,
                                POS_SENTINEL)
     if "block_tables" in cache:
-        return paged_record_positions(cache, pos_update)
+        return paged_record_positions(cache, pos_update, gather=gather)
     B, T = positions.shape
     if cache["len"].ndim == 0:
         cache_pos = jax.lax.dynamic_update_slice(
@@ -124,6 +127,21 @@ def cache_positions_update(cache: dict, positions: jnp.ndarray,
         idx = cache["len"][:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
         cache_pos = cache["pos"].at[rows, idx].set(pos_update)
     return cache_pos, cache_pos
+
+
+def kv_cache_write_paged(cache: dict, ck, cv, cks, cvs, k, v):
+    """Paged write WITHOUT the gathered read — the Pallas kernel decode
+    path: quantize the new tokens exactly as ``kv_cache_update`` would,
+    scatter them through the block tables, and return only the updated
+    pool leaves; the kernel then reads the blocks in place."""
+    if cks is not None:
+        k_w, ks_w = kv_quantize(k)
+        v_w, vs_w = kv_quantize(v)
+    else:
+        k_w, v_w = k.astype(ck.dtype), v.astype(cv.dtype)
+        ks_w = vs_w = None
+    return paged_kv_write(ck, cv, cks, cvs, cache["block_tables"],
+                          cache["len"], k_w, v_w, ks_w, vs_w)
 
 
 def kv_cache_update(cache: dict, ck, cv, cks, cvs, k, v):

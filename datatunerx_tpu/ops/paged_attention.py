@@ -45,19 +45,31 @@ import jax.numpy as jnp
 POS_SENTINEL = 2**30
 
 
+class BlockAllocatorError(ValueError):
+    """A ``free()`` that would corrupt the free list: out-of-range block id,
+    double-free of an already-free block, or duplicate ids in one call.
+    Raised BEFORE any mutation — a rejected free changes nothing — because
+    the silent alternative is worse than a crash: a double-freed id gets
+    handed out twice and two live slots then scatter into the same physical
+    block."""
+
+
 class BlockAllocator:
     """Host-side free-list over the physical block pool.
 
     The scheduler thread is the only allocator writer, but gauges
     (``/metrics``, gateway stats) read ``free_count`` from HTTP threads —
     hence the lock. Blocks are handed out lowest-id-first and returned to
-    the head of the free list, so tests can assert deterministic reuse."""
+    the head of the free list, so tests can assert deterministic reuse.
+    ``free()`` validates ids against a shadow set of the free list and
+    raises BlockAllocatorError instead of admitting a corruption."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
+        self._free_set = set(self._free)
         self._lock = threading.Lock()
 
     @property
@@ -74,13 +86,30 @@ class BlockAllocator:
             if n > len(self._free):
                 return None
             out, self._free = self._free[:n], self._free[n:]
+            self._free_set.difference_update(out)
             return out
 
     def free(self, blocks: List[int]):
         if not blocks:
             return
         with self._lock:
-            self._free = sorted(blocks) + self._free
+            ids = [int(b) for b in blocks]
+            bad = [b for b in ids if not 0 <= b < self.num_blocks]
+            if bad:
+                raise BlockAllocatorError(
+                    f"free() of out-of-range block id(s) {bad} "
+                    f"(pool has {self.num_blocks} blocks)")
+            if len(set(ids)) != len(ids):
+                dupes = sorted({b for b in ids if ids.count(b) > 1})
+                raise BlockAllocatorError(
+                    f"free() lists block id(s) {dupes} more than once")
+            double = sorted(b for b in ids if b in self._free_set)
+            if double:
+                raise BlockAllocatorError(
+                    f"double-free of block id(s) {double}: already on the "
+                    "free list")
+            self._free = sorted(ids) + self._free
+            self._free_set.update(ids)
 
 
 def init_paged_cache(cfg, slots: int, num_blocks: int, block_size: int,
@@ -133,19 +162,42 @@ def _gather_tables(tables: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(tables >= 0, tables, 0)
 
 
-def paged_record_positions(cache: Dict, pos_update: jnp.ndarray):
+def paged_record_positions(cache: Dict, pos_update: jnp.ndarray,
+                           gather: bool = True):
     """Scatter the new tokens' rope positions through the block tables and
     return ``(new_pos_pool, kv_positions [B, W])`` — the gathered linear
     position view attention's causal bias masks against. Lanes backed by no
-    block read as POS_SENTINEL."""
+    block read as POS_SENTINEL.
+
+    ``gather=False`` (the Pallas kernel decode path) skips the gathered view
+    entirely — the kernel masks against the pos POOL through the block table
+    in place — and returns ``(new_pos_pool, None)``."""
     tables, lens, pool = cache["block_tables"], cache["len"], cache["pos"]
     num_blocks, block_size = pool.shape
     phys, off = _write_targets(tables, lens, pos_update.shape[1],
                                block_size, num_blocks)
     new_pool = pool.at[phys, off].set(pos_update)
+    if not gather:
+        return new_pool, None
     gathered = new_pool[_gather_tables(tables)]  # [B, nbps, bs]
     gathered = jnp.where((tables >= 0)[:, :, None], gathered, POS_SENTINEL)
     return new_pool, gathered.reshape(tables.shape[0], -1)
+
+
+def paged_kv_write(ck, cv, cks, cvs, tables, lens, k_w, v_w, ks_w, vs_w):
+    """Per-layer paged write WITHOUT the gathered read-back — the Pallas
+    kernel decode path's half of ``paged_kv_update``: scatter the new
+    tokens' K/V (and int8 scales) through the block tables and return the
+    updated pools; attention then reads the blocks in place."""
+    num_blocks, block_size = ck.shape[0], ck.shape[1]
+    phys, off = _write_targets(tables, lens, k_w.shape[1],
+                               block_size, num_blocks)
+    ck = ck.at[phys, off].set(k_w)
+    cv = cv.at[phys, off].set(v_w)
+    if cks is not None:
+        cks = cks.at[phys, off].set(ks_w)
+        cvs = cvs.at[phys, off].set(vs_w)
+    return ck, cv, cks, cvs
 
 
 def paged_kv_update(ck, cv, cks, cvs, tables, lens, k_w, v_w, ks_w, vs_w):
@@ -156,15 +208,9 @@ def paged_kv_update(ck, cv, cks, cvs, tables, lens, k_w, v_w, ks_w, vs_w):
     d]``. Returns updated pools plus the gathered ``[B, W, KV, d]`` views
     attention reads — element-identical to a dense row for every written
     lane, sentinel-masked elsewhere."""
-    num_blocks, block_size = ck.shape[0], ck.shape[1]
     B = k_w.shape[0]
-    phys, off = _write_targets(tables, lens, k_w.shape[1],
-                               block_size, num_blocks)
-    ck = ck.at[phys, off].set(k_w)
-    cv = cv.at[phys, off].set(v_w)
-    if cks is not None:
-        cks = cks.at[phys, off].set(ks_w)
-        cvs = cvs.at[phys, off].set(vs_w)
+    ck, cv, cks, cvs = paged_kv_write(ck, cv, cks, cvs, tables, lens,
+                                      k_w, v_w, ks_w, vs_w)
     tbl = _gather_tables(tables)
     k_all = ck[tbl].reshape(B, -1, ck.shape[-2], ck.shape[-1])
     v_all = cv[tbl].reshape(B, -1, cv.shape[-2], cv.shape[-1])

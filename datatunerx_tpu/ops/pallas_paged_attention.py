@@ -1,0 +1,262 @@
+"""Pallas in-place paged-attention decode kernel (vLLM PagedAttention done
+natively — PAPERS.md; the ROADMAP "Decode fast path" arc).
+
+The XLA gather path (ops/paged_attention.py ``paged_kv_update``) is
+token-exact but materializes a dense-equivalent ``[B, W, KV, d]`` linear
+view of every slot's blocks per layer per decode step: the block pool saves
+HBM *capacity* while decode still pays dense HBM *bandwidth* — a full-width
+gather write plus a full-width attention read, padding included. This
+kernel walks the per-slot block table with scalar prefetch and reads the
+K/V blocks IN PLACE: per decode token it streams only the slot's LIVE
+blocks through VMEM (K twice, V once — see below), so HBM traffic scales
+with ``len(session)`` instead of ``blocks_per_slot × block_size``, and the
+gathered view never exists.
+
+Correctness contract — the gather path stays alive as the parity ORACLE,
+and the PR 5 bit-parity suite asserts kernel-vs-gather token-exactness.
+That drives the kernel's two-phase shape:
+
+- **Phase 0 (stats)**: flash-style online-softmax accumulator over the
+  table's blocks — running row max ``m`` and rescaled normalizer ``l`` in
+  f32 VMEM scratch, exactly flash_attention.py's scheme.
+- **Phase 1 (weighted sum)**: with the row's ``m``/``l`` known, each
+  block's probabilities are the oracle's own ``exp(s - m) / l`` quantized
+  to the compute dtype BEFORE the PV product — replicating
+  ``xla_attention``'s ``probs.astype(v.dtype)`` rounding, which a
+  single-pass accumulator cannot (it would normalize after the cast).
+  Differences vs the oracle reduce to f32 summation order (~1e-7
+  relative), which greedy/sampled token streams don't see.
+
+Masking needs no bias tensor: a table entry < 0 skips its block outright
+(``pl.when``), and within a block the pos pool — POS_SENTINEL on every
+unwritten/pad lane — is compared against the query's rope position, the
+same ``kv_pos <= q_pos`` check the oracle's causal bias encodes. GQA maps
+each query-head group onto its KV head with a static in-kernel loop (no
+``jnp.repeat``); int8 ``kv_quant`` pools dequantize per block inside the
+kernel by the paged scale pools (pallas_quant.py's fuse-the-dequant idiom),
+rounding through the compute dtype exactly as ``kv_dequantize`` does.
+
+Testable under ``JAX_PLATFORMS=cpu`` via the shared interpret-mode gate
+(ops/_pallas.py); ``DTX_PALLAS_INTERPRET=0`` forces real Mosaic lowering
+for AOT certification.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite (flash_attention.py): -inf - -inf would NaN
+_LANES = 128  # stats scratch padded to the TPU lane width
+
+
+def _interpret() -> bool:
+    from datatunerx_tpu.ops._pallas import interpret_default
+
+    return interpret_default()
+
+
+def _decode_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   pos_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, nbps: int, kv_heads: int, group: int, scale: float,
+                   quant: bool):
+    """One (slot, table-entry, phase) grid step.
+
+    Grid is ``(B, 2 * nbps)``: the trailing dim walks the slot's table twice
+    — ``j < nbps`` is the stats phase, ``j >= nbps`` the weighted-sum phase.
+    Block j's K/V/pos land in VMEM via the scalar-prefetched table (invalid
+    entries clamp to physical block 0 and are skipped by ``pl.when``)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    jj = j - (j // nbps) * nbps  # table column this step covers
+    stats_phase = j < nbps
+    entry = tables_ref[b, jj]
+    q_pos = qpos_ref[b]
+    d = o_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _heads(ref, scale_ref):
+        """The block's per-head [bs, d] tiles, dequantized when quantized.
+
+        Pools arrive with the (KV, d) trailing dims MERGED ([1, bs, KV·d]
+        blocks): Mosaic cannot slice the middle dim of an int8 tile (and
+        per-head (…, 1, d) trailing block dims are illegal tilings), so the
+        whole tile is loaded/converted 2D and each head is a static
+        lane-dim slice — the nf4 kernel's planar-unpack idiom."""
+        full = ref[0]  # [bs, KV·d]
+        if quant:
+            full = full.astype(jnp.float32)
+        out = []
+        for kv in range(kv_heads):
+            h = full[:, kv * d:(kv + 1) * d]
+            if quant:
+                # match kv_dequantize: f32 product rounded through the
+                # compute dtype before the f32 MXU pass
+                h = (h * scale_ref[0][:, kv:kv + 1]).astype(o_ref.dtype)
+            out.append(h.astype(jnp.float32))
+        return out
+
+    def _masked_scores(k_heads):
+        """Masked f32 score rows, one [group, bs] per KV head."""
+        # pos block is [1, 1, bs] (the unit middle dim keeps the trailing
+        # block dims equal to the array dims — Mosaic's tiling rule)
+        mask = pos_ref[0, 0:1, :] <= q_pos  # sentinel + causal in one
+        out = []
+        for kv in range(kv_heads):
+            qg = q_ref[0, kv * group:(kv + 1) * group, :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qg, k_heads[kv], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            out.append(jnp.where(mask, s, NEG_INF))
+        return out
+
+    @pl.when((entry >= 0) & stats_phase)
+    def _stats():
+        for kv, s in enumerate(_masked_scores(_heads(k_ref, ks_ref))):
+            rows = slice(kv * group, (kv + 1) * group)
+            m_prev = m_ref[rows, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[rows, :] = (l_ref[rows, :] * corr
+                              + jnp.sum(jnp.exp(s - m_new), axis=1,
+                                        keepdims=True))
+            m_ref[rows, :] = jnp.broadcast_to(m_new,
+                                              (group, m_ref.shape[1]))
+
+    @pl.when((entry >= 0) & ~stats_phase)
+    def _weighted_sum():
+        v_heads = _heads(v_ref, vs_ref)
+        for kv, s in enumerate(_masked_scores(_heads(k_ref, ks_ref))):
+            rows = slice(kv * group, (kv + 1) * group)
+            l_row = jnp.maximum(l_ref[rows, 0:1], 1e-30)
+            # the oracle's probs: normalized THEN quantized to the compute
+            # dtype before the PV product (xla_attention rounds the same way)
+            p = (jnp.exp(s - m_ref[rows, 0:1]) / l_row).astype(o_ref.dtype)
+            acc_ref[rows, :] += jax.lax.dot_general(
+                p.astype(jnp.float32), v_heads[kv],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(j == 2 * nbps - 1)
+    def _finish():
+        o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,          # [B, H, d] — the decode step's single token
+    k_pool: jnp.ndarray,     # [NB, bs, KV, d] one layer's block pool
+    v_pool: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray],  # [NB, bs, KV] f32 (int8 pools) | None
+    v_scale: Optional[jnp.ndarray],
+    tables: jnp.ndarray,     # [B, nbps] int32, -1 = unallocated
+    pos_pool: jnp.ndarray,   # [NB, bs] int32 — POST-write (this token's rope
+                             # position already scattered in)
+    q_positions: jnp.ndarray,  # [B] int32 rope position of the query token
+    *,
+    interpret=None,
+) -> jnp.ndarray:
+    """In-place paged decode attention over the block pool: out [B, H, d].
+
+    Slots whose tables hold no valid block (released / never admitted)
+    produce zeros — the engine's emit mask already discards their tokens,
+    mirroring the garbage the oracle's sentinel-masked uniform softmax
+    yields for such rows."""
+    B, H, d = q.shape
+    NB, bs, KV, _ = k_pool.shape
+    nbps = tables.shape[1]
+    G = H // KV
+    quant = k_scale is not None
+
+    # the ORACLE's scale arithmetic, exactly: xla_attention computes
+    # 1/sqrt(f32(d)) in f32 — a python 1/d**0.5 double differs by 1 ulp for
+    # head dims like 96/112, enough to flip a bf16-rounded probability and
+    # break the token-parity contract on those models
+    scale = float(np.float32(1.0) / np.sqrt(np.float32(d)))
+    kernel = functools.partial(
+        _decode_kernel, nbps=nbps, kv_heads=KV, group=G,
+        scale=scale, quant=quant)
+
+    def kv_index(b, j, tables_ref, qpos_ref):
+        # clamp -1 → block 0: the DMA must stay in bounds; pl.when skips
+        # the compute, so the fetched garbage is never read
+        return (jnp.maximum(tables_ref[b, j - (j // nbps) * nbps], 0), 0, 0)
+
+    pos_index = scale_index = kv_index
+
+    def v_index(b, j, tables_ref, qpos_ref):
+        # V is consumed in phase 1 only; parking the index on block 0
+        # during phase 0 keeps Mosaic's same-block revisit from re-DMAing
+        # anything useless (interpret mode is indifferent)
+        jj = j - (j // nbps) * nbps
+        return (jnp.maximum(tables_ref[b, jj], 0) * (j >= nbps), 0, 0)
+
+    # pools enter the kernel with (KV, d) merged — [NB, bs, KV·d] — a free
+    # trailing-dims reshape that makes every per-head extraction a static
+    # LANE slice (Mosaic cannot slice the middle dim of an int8 tile)
+    in_specs = [
+        pl.BlockSpec((1, H, d), lambda b, j, t, p: (b, 0, 0)),
+        pl.BlockSpec((1, bs, KV * d), kv_index),
+        pl.BlockSpec((1, bs, KV * d), v_index),
+    ]
+    args = [q, k_pool.reshape(NB, bs, KV * d),
+            v_pool.reshape(NB, bs, KV * d)]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, KV), scale_index),
+                     pl.BlockSpec((1, bs, KV), scale_index)]
+        args += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, 1, bs), pos_index))
+    args.append(pos_pool[:, None])  # [NB, 1, bs]: Mosaic-legal tiling
+
+    kernel_args = kernel if quant else functools.partial(
+        _no_scale_kernel, kernel)
+    out = pl.pallas_call(
+        kernel_args,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, 2 * nbps),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, H, d), lambda b, j, t, p: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((H, d), jnp.float32),
+                pltpu.VMEM((H, _LANES), jnp.float32),
+                pltpu.VMEM((H, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(tables.astype(jnp.int32), q_positions.astype(jnp.int32), *args)
+    return out
+
+
+def _no_scale_kernel(kernel, tables_ref, qpos_ref, q_ref, k_ref, v_ref,
+                     pos_ref, o_ref, acc_ref, m_ref, l_ref):
+    """Arity shim for the unquantized pools: no scale refs in the call."""
+    kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, None, None,
+           pos_ref, o_ref, acc_ref, m_ref, l_ref)
+
+
+def paged_attention_decode_step(q, ck, cv, cks, cvs, cache: dict,
+                                pos_pool, positions, *, interpret=None):
+    """Model-facing wrapper: q ``[B, 1, H, d]`` (one decode token), the
+    layer-peeled pools, the live cache dict (block tables), the POST-write
+    pos pool, and the step's ``positions [B, 1]``. Returns ``[B, 1, H, d]``
+    in q.dtype — drop-in for the gather + ``xla_attention`` pair."""
+    B, T, H, d = q.shape
+    assert T == 1, f"paged decode kernel is single-token (T=1), got T={T}"
+    out = paged_decode_attention(
+        q[:, 0], ck, cv, cks, cvs, cache["block_tables"], pos_pool,
+        positions[:, 0], interpret=interpret)
+    return out[:, None]

@@ -503,6 +503,7 @@ class BatchedEngine:
         prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
         kv_block_size: int = 0,  # >0: paged block-pool cache (elastic HBM)
         kv_blocks: Optional[int] = None,  # pool size; default = dense parity
+        paged_kernel: str = "auto",  # Pallas in-place decode: auto|on|off
         prefill_chunk: int = 256,  # chunked-prefill program length (paged)
         prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
         registry: Optional[Registry] = None,  # shared /metrics registry
@@ -572,6 +573,30 @@ class BatchedEngine:
         self.kv_quant = kv_quant or None
         self.paged = kv_block_size > 0
         self.block_size = int(kv_block_size)
+        # Pallas in-place decode kernel (ops/pallas_paged_attention.py):
+        # "auto" engages it on a real TPU backend and keeps the XLA gather
+        # elsewhere (interpret-mode emulation would only slow CPU smoke
+        # runs); "on" forces it anywhere — CPU tests/bench run the kernel
+        # through the interpret gate — and "off" pins the gather oracle.
+        # The resolved bool rides the model config so the jitted programs
+        # (and the process-wide program memo key) see it.
+        mode = paged_kernel if isinstance(paged_kernel, str) else \
+            ("on" if paged_kernel else "off")
+        mode = (mode or "auto").strip().lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_kernel must be auto|on|off, got {paged_kernel!r}")
+        if mode == "on" and not self.paged:
+            raise ValueError(
+                "--paged_kernel on requires the paged KV cache "
+                "(--kv_block_size > 0)")
+        self.paged_kernel = self.paged and (
+            mode == "on"
+            or (mode == "auto" and jax.default_backend() == "tpu"))
+        if self.paged_kernel:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(self.cfg, paged_kernel=True)
         self._allocator: Optional[BlockAllocator] = None
         if self.paged:
             if self.max_seq_len % self.block_size:
@@ -709,6 +734,14 @@ class BatchedEngine:
         self._thread.start()
 
     # ------------------------------------------------------------ block pool
+    @property
+    def decode_path(self) -> str:
+        """How decode attention reads the KV cache: ``pallas`` (in-place
+        block-table kernel), ``gather`` (paged XLA oracle), or ``dense``."""
+        if not self.paged:
+            return "dense"
+        return "pallas" if self.paged_kernel else "gather"
+
     @property
     def total_kv_blocks(self) -> Optional[int]:
         return self._allocator.num_blocks if self._allocator else None

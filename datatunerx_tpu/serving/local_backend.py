@@ -73,10 +73,12 @@ class LocalServingBackend:
                 argv += ["--slots", str(spec["slots"])]
             # paged-cache + adapter-pool tuning flows through the
             # serveConfig untouched (serving.server and gateway.server
-            # both accept these)
+            # both accept these); paged_kernel rides along so an operator
+            # can pin the decode path per deployment ("auto" is default
+            # and needs no spec entry)
             for key in ("kv_block_size", "kv_blocks", "prefill_chunk",
                         "prefill_token_budget", "adapter_pool",
-                        "adapter_rank_max"):
+                        "adapter_rank_max", "paged_kernel"):
                 if spec.get(key):
                     argv += [f"--{key}", str(spec[key])]
             from datatunerx_tpu.operator.backends import _pkg_root

@@ -690,8 +690,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       adapters=None, adapter_pool=0, adapter_rank_max=8,
                       adapter_targets=None, kv_quant=None, prefix_cache=0,
                       kv_block_size=0, kv_blocks=0, prefill_chunk=256,
-                      prefill_token_budget=0, trace_ring=256,
-                      trace_log_path=None):
+                      prefill_token_budget=0, paged_kernel="auto",
+                      trace_ring=256, trace_log_path=None):
     def _load():
         try:
             STATE.model_path = model_path
@@ -703,7 +703,10 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               ("--adapter_pool", adapter_pool),
                               ("--prefix_cache", prefix_cache),
                               ("--kv_quant", kv_quant),
-                              ("--kv_block_size", kv_block_size)):
+                              ("--kv_block_size", kv_block_size),
+                              # only "on" demands the batched paged engine;
+                              # "off"/"auto" are no-ops everywhere else
+                              ("--paged_kernel", paged_kernel == "on")):
                 if val and not batched:
                     raise ValueError(
                         f"{flag} requires the batched engine "
@@ -721,6 +724,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
                     kv_quant=kv_quant or None, prefix_cache=prefix_cache,
                     kv_block_size=kv_block_size, kv_blocks=kv_blocks or None,
+                    paged_kernel=paged_kernel or "auto",
                     prefill_chunk=prefill_chunk,
                     prefill_token_budget=prefill_token_budget,
                     # the server's registry: engine TTFT/TPOT/prefill-chunk
@@ -805,6 +809,13 @@ def main(argv=None):
                    help="total blocks in the paged pool (default "
                         "slots × max_seq_len / kv_block_size; set lower to "
                         "serve the same slots in less HBM)")
+    p.add_argument("--paged_kernel", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="Pallas in-place paged-attention decode kernel: "
+                        "auto = kernel on TPU / XLA gather elsewhere, "
+                        "on = force the kernel (interpret-mode on CPU), "
+                        "off = always the gather oracle; needs "
+                        "--kv_block_size > 0 to engage")
     p.add_argument("--prefill_chunk", type=int, default=256,
                    help="chunked-prefill program length in tokens (paged "
                         "engine); long prompts prefill in chunks "
@@ -852,6 +863,7 @@ def main(argv=None):
                       kv_blocks=args.kv_blocks,
                       prefill_chunk=args.prefill_chunk,
                       prefill_token_budget=args.prefill_token_budget,
+                      paged_kernel=args.paged_kernel,
                       trace_ring=args.trace_ring,
                       trace_log_path=args.trace_log)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)

@@ -94,6 +94,8 @@ def _sds(tree, sharding):
 
 def _cost(compiled) -> dict:
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):  # jax returns [dict] on some versions
+        ca = ca[0] if ca else {}
     return {
         "flops": ca.get("flops"),
         "bytes_accessed": ca.get("bytes accessed"),
@@ -229,6 +231,32 @@ def kernel_artifacts(cert: Certifier, dev):
     cert.run("kernel/lora_fused_fwd", lambda: _lower(
         lambda x, w, a, b: pallas_lora_matmul(x, w, a, b, scale=4.0),
         x, w, a, b))
+
+    # paged-decode attention (ops/pallas_paged_attention.py): the serving
+    # fast path — scalar-prefetched block-table walk, bf16 and int8 pools
+    # at tinyllama serving geometry (GQA 32q/4kv, bs=16, 64 blocks/slot)
+    from datatunerx_tpu.ops.pallas_paged_attention import (
+        paged_decode_attention,
+    )
+
+    Bd, Hd, KVd, dd, bsd, nbps, NBd = 4, 32, 4, 64, 16, 64, 256
+    qd = jax.ShapeDtypeStruct((Bd, Hd, dd), jnp.bfloat16, sharding=sh)
+    tables = jax.ShapeDtypeStruct((Bd, nbps), jnp.int32, sharding=sh)
+    pos = jax.ShapeDtypeStruct((NBd, bsd), jnp.int32, sharding=sh)
+    qpos = jax.ShapeDtypeStruct((Bd,), jnp.int32, sharding=sh)
+    pool_bf16 = jax.ShapeDtypeStruct((NBd, bsd, KVd, dd), jnp.bfloat16,
+                                     sharding=sh)
+    pool_i8 = jax.ShapeDtypeStruct((NBd, bsd, KVd, dd), jnp.int8,
+                                   sharding=sh)
+    pool_sc = jax.ShapeDtypeStruct((NBd, bsd, KVd), jnp.float32, sharding=sh)
+    cert.run("kernel/paged_decode_bf16", lambda: _lower(
+        lambda q, k, v, t, p, qp: paged_decode_attention(
+            q, k, v, None, None, t, p, qp),
+        qd, pool_bf16, pool_bf16, tables, pos, qpos))
+    cert.run("kernel/paged_decode_int8_kv", lambda: _lower(
+        lambda q, k, v, ks, vs, t, p, qp: paged_decode_attention(
+            q, k, v, ks, vs, t, p, qp),
+        qd, pool_i8, pool_i8, pool_sc, pool_sc, tables, pos, qpos))
 
 
 # -------------------------------------------------------------- train steps

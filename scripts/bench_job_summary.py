@@ -35,6 +35,12 @@ def main() -> int:
                f"`cpu_fallback={last.get('cpu_fallback', '?')}` — "
                f"{last.get('metric')} = {last.get('value')} "
                f"{last.get('unit', '')}")
+        # serve-bench decode-path provenance: which attention read produced
+        # the number (pallas kernel vs XLA gather vs dense), lifted next to
+        # platform/cpu_fallback so a kernel regression can't hide behind an
+        # unlabeled tokens/s figure
+        if "decode_path" in last:
+            row += f" `decode_path={last.get('decode_path')}`"
         # pre-flight phase timings (backend init / first compile / first
         # execute) next to the provenance fields; a degraded line names the
         # phase the device died in
@@ -43,7 +49,8 @@ def main() -> int:
             phases = pf.get("phases_ms") or {}
             shown = " ".join(f"{k}={phases[k]}ms" for k in
                              ("backend_init", "first_compile",
-                              "first_execute") if k in phases)
+                              "first_execute", "pallas_execute")
+                             if k in phases)
             hung = pf.get("timed_out_phase") or pf.get("failed_phase")
             row += (f"\n  - preflight: `ok={pf.get('ok')}` "
                     f"attempts={pf.get('attempts')} {shown}")
@@ -63,6 +70,8 @@ def main() -> int:
                     f"p99={sv.get('tpot_ms_p99')}ms · "
                     f"requests={sv.get('requests')} "
                     f"errors={sv.get('errors')}")
+            if sv.get("decode_parity_checked"):
+                row += " · kernel-vs-gather parity: checked"
             # adapter-churn mode: residency hit rate + load latency are the
             # dynamic multi-adapter plane's own north-stars
             ad = sv.get("adapters")

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.ops.paged_attention import (
     BlockAllocator,
+    BlockAllocatorError,
     init_paged_cache,
 )
 from datatunerx_tpu.serving.batched_engine import BatchedEngine
@@ -35,6 +36,18 @@ def dense():
 def paged():
     eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
                         slots=2, decode_chunk=4, kv_block_size=16)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def kernel_eng():
+    """Pallas in-place decode kernel forced on (interpret mode under
+    JAX_PLATFORMS=cpu) — every other knob identical to ``paged``, which is
+    its gather-path oracle."""
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16,
+                        paged_kernel="on")
     yield eng
     eng.close()
 
@@ -68,6 +81,28 @@ def test_block_allocator_exhaustion_free_reuse():
     assert a.alloc(0) == []
     with pytest.raises(ValueError):
         BlockAllocator(0)
+
+
+def test_block_allocator_free_rejects_corruption():
+    """free() hardening: out-of-range ids, double-frees, and in-call
+    duplicates raise the typed error BEFORE mutating — the silent
+    alternative re-issues a live block to a second slot."""
+    a = BlockAllocator(4)
+    held = a.alloc(2)  # [0, 1]
+    with pytest.raises(BlockAllocatorError):
+        a.free([4])  # out of range (pool has ids 0..3)
+    with pytest.raises(BlockAllocatorError):
+        a.free([-1])
+    with pytest.raises(BlockAllocatorError):
+        a.free([2])  # never allocated — already on the free list
+    with pytest.raises(BlockAllocatorError):
+        a.free([0, 0])  # duplicate ids in one call
+    a.free(held)  # the legitimate free still works...
+    assert a.free_count == 4
+    with pytest.raises(BlockAllocatorError):
+        a.free(held)  # ...and replaying it is a double-free
+    assert a.free_count == 4  # rejected frees changed nothing
+    assert isinstance(BlockAllocatorError("x"), ValueError)
 
 
 # ------------------------------------------------------- model primitive
@@ -157,6 +192,139 @@ def test_paged_long_prompt_chunked_prefill_matches_dense(dense, budgeted):
     assert got == want, (got, want)
     chunks = [e for e in budgeted.sched_trace if e[0] == "prefill"]
     assert len(chunks) >= 2, "prompt did not prefill in chunks"
+
+
+# ------------------------------------------- pallas kernel decode parity
+#
+# The gather engine (``paged``) is the ORACLE: same pool, same tables, same
+# scheduler — only the attention read differs. The bar is token-exactness,
+# greedy AND fixed-seed sampled, across bf16/int8 pools, pooled adapters,
+# ragged in-flight lens, and the chunked-prefill → kernel-decode handoff.
+
+def test_kernel_decode_matches_gather_and_dense(dense, paged, kernel_eng):
+    assert kernel_eng.decode_path == "pallas"
+    assert paged.decode_path == "gather" and dense.decode_path == "dense"
+    prompt = dense.tokenizer.encode("the quick brown fox jumps over")
+    want = dense.generate(prompt, max_new_tokens=12)
+    assert paged.generate(prompt, max_new_tokens=12) == want
+    assert kernel_eng.generate(prompt, max_new_tokens=12) == want
+    # elastic accounting unchanged by the kernel: every block returned
+    assert kernel_eng.free_kv_blocks == kernel_eng.total_kv_blocks
+
+
+def test_kernel_sampled_matches_gather(paged, kernel_eng):
+    prompt = paged.tokenizer.encode("sampling determinism probe")
+    for seed in (0, 7):
+        want = paged.generate(prompt, max_new_tokens=10, temperature=0.8,
+                              top_p=0.9, seed=seed)
+        got = kernel_eng.generate(prompt, max_new_tokens=10, temperature=0.8,
+                                  top_p=0.9, seed=seed)
+        assert got == want, (seed, got, want)
+
+
+def test_kernel_ragged_inflight_matches_gather(paged, kernel_eng):
+    """Slots at DIFFERENT depths decoding concurrently (slots=2 forces
+    overlap): the kernel walks each slot's own table/len, so ragged batches
+    must match the gather engine token for token."""
+    tok = paged.tokenizer
+    prompts = [tok.encode("short one"),
+               tok.encode("a much longer prompt with plenty of context " * 3)]
+    want = [paged.generate(p, max_new_tokens=8 + 4 * i)
+            for i, p in enumerate(prompts)]
+    reqs = [kernel_eng.submit(p, max_new_tokens=8 + 4 * i)
+            for i, p in enumerate(prompts)]
+    for r, w in zip(reqs, want):
+        assert r.done.wait(300) and r.error is None, r.error
+        assert r.tokens == w, (r.tokens, w)
+
+
+def test_kernel_chunked_prefill_handoff(dense, kernel_eng):
+    """Chunked prefill stays on the gather path (T > 1) and hands its slot
+    to KERNEL decode — the seam between the two paths must be invisible."""
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16,
+                        prefill_chunk=64, prefill_token_budget=64,
+                        paged_kernel="on")
+    try:
+        prompt = dense.tokenizer.encode("long context " * 70)
+        want = dense.generate(prompt, max_new_tokens=8)
+        got = eng.generate(prompt, max_new_tokens=8)
+        assert got == want, (got, want)
+        chunks = [e for e in eng.sched_trace if e[0] == "prefill"]
+        assert len(chunks) >= 2, "prompt did not prefill in chunks"
+    finally:
+        eng.close()
+
+
+def test_kernel_int8_kv_parity():
+    """int8 kv_quant pools: the kernel dequantizes by the paged scale pools
+    in place and must match the gather path's dequantized read exactly."""
+    gather = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                           slots=2, decode_chunk=4, kv_block_size=16,
+                           kv_quant="int8", paged_kernel="off")
+    kern = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                         slots=2, decode_chunk=4, kv_block_size=16,
+                         kv_quant="int8", paged_kernel="on")
+    try:
+        prompt = gather.tokenizer.encode("quantized cache kernel probe")
+        for kw in ({}, {"temperature": 0.7, "top_p": 0.9, "seed": 11}):
+            want = gather.generate(prompt, max_new_tokens=8, **kw)
+            got = kern.generate(prompt, max_new_tokens=8, **kw)
+            assert got == want, (kw, got, want)
+    finally:
+        gather.close()
+        kern.close()
+
+
+def test_kernel_pooled_adapter_parity(tmp_path):
+    """Mixed-rank pooled adapters through kernel decode: LoRA deltas ride
+    the projections (not attention), but the adapter-indexed q/k/v feeding
+    the kernel must still produce gather-identical tokens — greedy and
+    fixed-seed sampled, base + both tenants."""
+    cks = _mixed_rank_checkpoints(tmp_path)
+    gather = BatchedEngine(MODEL, adapters=cks, adapter_pool=2,
+                           adapter_rank_max=8, template="vanilla",
+                           max_seq_len=256, slots=2, decode_chunk=4,
+                           kv_block_size=16, paged_kernel="off")
+    kern = BatchedEngine(MODEL, adapters=cks, adapter_pool=2,
+                         adapter_rank_max=8, template="vanilla",
+                         max_seq_len=256, slots=2, decode_chunk=4,
+                         kv_block_size=16, paged_kernel="on")
+    try:
+        prompt = gather.tokenizer.encode("tenant isolation kernel probe")
+        want = {}
+        for adapter in ("", "a", "b"):
+            want[adapter] = gather.generate(prompt, max_new_tokens=8,
+                                            adapter=adapter)
+            got = kern.generate(prompt, max_new_tokens=8, adapter=adapter)
+            assert got == want[adapter], (adapter, got, want[adapter])
+        assert want["a"] != want[""] and want["b"] != want[""]  # non-vacuous
+        for adapter in ("a", "b"):
+            w = gather.generate(prompt, max_new_tokens=8, adapter=adapter,
+                                temperature=0.8, top_p=0.9, seed=7)
+            g = kern.generate(prompt, max_new_tokens=8, adapter=adapter,
+                              temperature=0.8, top_p=0.9, seed=7)
+            assert g == w, (adapter, g, w)
+    finally:
+        gather.close()
+        kern.close()
+
+
+def test_kernel_flag_validation():
+    with pytest.raises(ValueError, match="kv_block_size"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                      paged_kernel="on")  # dense cache: nothing to kernel
+    with pytest.raises(ValueError, match="auto|on|off"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                      kv_block_size=16, paged_kernel="sometimes")
+    # auto on a CPU backend resolves to the gather oracle
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                        decode_chunk=4, kv_block_size=16,
+                        paged_kernel="auto")
+    try:
+        assert eng.decode_path == "gather" and not eng.paged_kernel
+    finally:
+        eng.close()
 
 
 def test_paged_lora_adapter_parity(tmp_path):

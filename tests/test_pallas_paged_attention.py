@@ -1,0 +1,186 @@
+"""Interpret-mode unit tests for the Pallas in-place paged-decode kernel
+(ops/pallas_paged_attention.py): the kernel must reproduce the XLA gather
+oracle — gathered linear view + causal bias + xla_attention — through every
+cache shape it claims: block-table walk, ragged per-slot lens, -1 sentinel
+entries, GQA head mapping, int8 dequant-by-scale, single-block and
+full-table slots. Engine-level token parity lives in test_paged_engine.py;
+these tests pin the kernel primitive itself."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.ops.attention import (
+    kv_dequantize,
+    kv_quantize,
+    make_causal_bias,
+    xla_attention,
+)
+from datatunerx_tpu.ops.paged_attention import POS_SENTINEL
+from datatunerx_tpu.ops.pallas_paged_attention import paged_decode_attention
+
+BS = 8  # block size (tokens per block)
+
+
+def _make_pool(key, B, NB, KV, d, lens, tables, dtype=jnp.float32,
+               quant=False):
+    """A block pool whose gathered view holds ``lens[b]`` real tokens per
+    slot: values written through the tables, positions 0..len-1, sentinel
+    elsewhere (exactly what the engine's scrub + writes produce)."""
+    kk, kv_, kq = jax.random.split(key, 3)
+    k_pool = jnp.zeros((NB, BS, KV, d), jnp.float32)
+    v_pool = jnp.zeros((NB, BS, KV, d), jnp.float32)
+    pos = jnp.full((NB, BS), POS_SENTINEL, jnp.int32)
+    k_rows, v_rows = [], []
+    for b in range(B):
+        W = tables.shape[1] * BS
+        kr = jax.random.normal(jax.random.fold_in(kk, b), (W, KV, d))
+        vr = jax.random.normal(jax.random.fold_in(kv_, b), (W, KV, d))
+        k_rows.append(kr)
+        v_rows.append(vr)
+        for i in range(int(lens[b])):
+            blk, off = tables[b, i // BS], i % BS
+            assert blk >= 0, "test table too short for its len"
+            k_pool = k_pool.at[blk, off].set(kr[i])
+            v_pool = v_pool.at[blk, off].set(vr[i])
+            pos = pos.at[blk, off].set(i)
+    if not quant:
+        return (k_pool.astype(dtype), v_pool.astype(dtype), None, None, pos,
+                k_rows, v_rows)
+    kq_pool, ks_pool = kv_quantize(k_pool)
+    vq_pool, vs_pool = kv_quantize(v_pool)
+    return kq_pool, vq_pool, ks_pool, vs_pool, pos, k_rows, v_rows
+
+
+def _oracle(q, k_pool, v_pool, ks, vs, tables, pos, q_positions, dtype):
+    """The gather path, element for element: clamp the table, gather the
+    linear view, sentinel-mask the positions, bias, xla_attention."""
+    B = q.shape[0]
+    tbl = jnp.where(tables >= 0, tables, 0)
+    k_all = k_pool[tbl].reshape(B, -1, k_pool.shape[-2], k_pool.shape[-1])
+    v_all = v_pool[tbl].reshape(B, -1, v_pool.shape[-2], v_pool.shape[-1])
+    if ks is not None:
+        k_all = kv_dequantize(k_all, ks[tbl].reshape(B, -1, ks.shape[-1]),
+                              dtype)
+        v_all = kv_dequantize(v_all, vs[tbl].reshape(B, -1, vs.shape[-1]),
+                              dtype)
+    else:
+        k_all, v_all = k_all.astype(dtype), v_all.astype(dtype)
+    kv_pos = pos[tbl]  # [B, nbps, BS]
+    kv_pos = jnp.where((tables >= 0)[:, :, None], kv_pos, POS_SENTINEL)
+    kv_pos = kv_pos.reshape(B, -1)
+    bias = make_causal_bias(q_positions[:, None], kv_pos)
+    return xla_attention(q[:, None].astype(dtype), k_all, v_all, bias)[:, 0]
+
+
+def _run(B=2, NB=8, nbps=3, KV=2, G=2, d=16, lens=(17, 5), dtype=jnp.float32,
+         quant=False, tables=None, seed=0):
+    H = KV * G
+    key = jax.random.PRNGKey(seed)
+    if tables is None:
+        rows = []
+        nxt = 0
+        for b in range(B):
+            need = -(-int(lens[b]) // BS)
+            row = list(range(nxt, nxt + need)) + [-1] * (nbps - need)
+            nxt += need
+            rows.append(row)
+        tables = jnp.asarray(rows, jnp.int32)
+    kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, NB, KV, d, lens, tables,
+                                           dtype=dtype, quant=quant)
+    q = jax.random.normal(jax.random.fold_in(key, 99),
+                          (B, H, d)).astype(dtype)
+    q_positions = jnp.asarray([int(x) - 1 for x in lens], jnp.int32)
+    got = paged_decode_attention(q, kp, vp, ks, vs, tables, pos, q_positions)
+    want = _oracle(q, kp, vp, ks, vs, tables, pos, q_positions, dtype)
+    assert got.dtype == q.dtype
+    return np.asarray(got, np.float32), np.asarray(want, np.float32)
+
+
+def test_block_table_walk_matches_gather_f32():
+    got, want = _run()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_lens_and_sentinel_entries():
+    """Slots at different depths, tables padded with -1: unallocated entries
+    contribute nothing, mid-block raggedness masks by pos sentinel."""
+    got, want = _run(B=3, NB=10, nbps=4, lens=(25, 9, 1))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_single_block_and_full_table_slots():
+    # slot 0: exactly one block; slot 1: every table entry live
+    got, want = _run(B=2, NB=8, nbps=3, lens=(BS, 3 * BS))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gqa_head_mapping():
+    """H = KV * G with G > 1: each query-head group must read ITS kv head —
+    a mapping bug would still produce plausible numbers, so compare against
+    the oracle with distinctly-keyed heads."""
+    got, want = _run(KV=4, G=3, d=8, lens=(11, 20), nbps=3, NB=8)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_no_gqa_single_group():
+    got, want = _run(KV=2, G=1, lens=(13, 6))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_int8_dequant_inside_kernel():
+    got, want = _run(quant=True, dtype=jnp.float32, lens=(19, 7))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_pools_match_oracle_bitwise():
+    """bf16 is the serving dtype: the kernel's phase-1 probs quantization
+    replicates xla_attention's probs.astype(bf16), so outputs round to the
+    SAME bf16 values (the engine token-parity guarantee)."""
+    got, want = _run(dtype=jnp.bfloat16, lens=(17, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bf16_int8_pools_match_oracle_bitwise():
+    got, want = _run(dtype=jnp.bfloat16, quant=True, lens=(12, 23))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bf16_nonpow2_head_dim_matches_oracle_bitwise():
+    """d=96: 1/sqrt(d) is where python-double vs f32 scale arithmetic
+    diverges by an ulp — the kernel must use the oracle's f32 formula."""
+    got, want = _run(d=96, dtype=jnp.bfloat16, lens=(17, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_empty_slot_yields_finite_output():
+    """A slot with no valid block (all -1): the kernel returns zeros, never
+    NaN — the engine's emit mask discards the row either way, but NaNs must
+    not leak into the batch."""
+    tables = jnp.asarray([[0, 1, -1], [-1, -1, -1]], jnp.int32)
+    got, _ = _run(B=2, NB=4, nbps=3, lens=(10, 0), tables=tables)
+    assert np.isfinite(got).all()
+    np.testing.assert_array_equal(got[1], 0.0)
+
+
+def test_decode_step_wrapper_shape():
+    from datatunerx_tpu.ops.pallas_paged_attention import (
+        paged_attention_decode_step,
+    )
+
+    B, KV, G, d, nbps, NB = 2, 2, 2, 8, 2, 4
+    H = KV * G
+    key = jax.random.PRNGKey(3)
+    tables = jnp.asarray([[0, 1], [2, -1]], jnp.int32)
+    kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, NB, KV, d, (9, 4), tables)
+    q = jax.random.normal(key, (B, 1, H, d))
+    cache = {"block_tables": tables}
+    out = paged_attention_decode_step(
+        q, kp, vp, None, None, cache, pos, jnp.asarray([[8], [3]], jnp.int32))
+    assert out.shape == (B, 1, H, d)
+    with pytest.raises(AssertionError):
+        paged_attention_decode_step(
+            jax.random.normal(key, (B, 2, H, d)), kp, vp, None, None, cache,
+            pos, jnp.asarray([[8, 9], [3, 4]], jnp.int32))
