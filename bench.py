@@ -362,6 +362,200 @@ def child_serve(preflight=None):
     print(json.dumps(line), flush=True)
 
 
+def child_serve_spec(preflight=None):
+    """DTX_BENCH_SERVE_SPEC=1: speculative-decoding serve bench. The same
+    mixed greedy workload runs on TWIN engines — spec-on (take:N
+    self-speculative draft) vs spec-off — over the same model, twice:
+
+    - **aligned**: the target's post-draft layers' output projections are
+      scaled toward zero (residual passthrough), so the truncated draft is
+      a faithful approximation of the target — the trained-draft regime
+      where speculation pays. The line reports acceptance rate, mean
+      accepted length, and the TPOT p50/p95 delta vs the spec-off twin.
+    - **adversarial**: raw random deep layers — the draft is noise and
+      acceptance collapses. The run asserts the adaptive-k controller
+      demonstrably DISABLES speculation (plain pending-form fallback) so
+      TPOT cannot regress vs spec-off.
+
+    Before the clock starts, the spec-on engine's greedy outputs are
+    asserted token-identical to the spec-off twin (the PR 13 kernel-gate
+    pattern): a fast-but-wrong number must be unreportable. The JSON line
+    carries ``spec_mode``/``spec_draft``/``decode_path`` provenance next to
+    ``platform``/``cpu_fallback``. CPU numbers are smoke-only.
+    """
+    import dataclasses
+
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    from datatunerx_tpu.models.config import PRESETS
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    layers = int(os.environ.get("DTX_BENCH_SPEC_LAYERS", "6"))
+    take = int(os.environ.get("DTX_BENCH_SPEC_TAKE", "1"))
+    k = int(os.environ.get("DTX_BENCH_SPEC_K", "4"))
+    slots = int(os.environ.get("DTX_BENCH_SERVE_SLOTS", "4"))
+    block = int(os.environ.get("DTX_BENCH_BLOCK_SIZE", "16"))
+    max_seq, short_new, long_new = 256, 24, 16
+    n_short, n_long = 6, 2
+    if "bench-spec" not in PRESETS:
+        PRESETS["bench-spec"] = dataclasses.replace(
+            PRESETS["debug"], name="bench-spec", num_layers=layers)
+    engine_kw = dict(
+        template="vanilla", max_seq_len=max_seq, slots=slots,
+        decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK", "8")),
+        kv_block_size=block)
+
+    def align_params(params):
+        """Scale post-draft layers' OUTPUT projections toward zero: the
+        residual stream passes through them near-unchanged, so take:N
+        approximates the full target while the target still pays every
+        layer's compute. Layers < take are untouched, so the draft (sliced
+        at engine construction) stays numerically identical to the
+        target's early layers."""
+        alpha = 1e-3
+        layers_t = dict(params["layers"])
+        for name in ("o_proj", "down_proj"):
+            sub = dict(layers_t[name])
+            sub["kernel"] = sub["kernel"].at[take:].multiply(alpha)
+            layers_t[name] = sub
+        out = dict(params)
+        out["layers"] = layers_t
+        return out
+
+    pct = lambda xs, q: (sorted(xs)[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
+                         if xs else 0.0)
+
+    def run_workload(eng):
+        tok = eng.tokenizer
+        short_ids = tok.encode("a quick question about the weather today")
+        long_ids = tok.encode("background context " * (max_seq // 8))
+        lock = threading.Lock()
+        per_req = []
+
+        def consume(req, t0):
+            stamps = []
+            while True:
+                t = req.stream.get()
+                if t is None:
+                    break
+                stamps.append(time.perf_counter())
+            with lock:
+                per_req.append((t0, stamps, req.error))
+
+        workload = []
+        li = si = 0
+        while li < n_long or si < n_short:
+            if si < n_short:
+                workload.append((short_ids, short_new)); si += 1
+            if si % 2 == 0 and li < n_long:
+                workload.append((long_ids, long_new)); li += 1
+        threads = []
+        wall0 = time.perf_counter()
+        for ids, max_new in workload:
+            t0 = time.perf_counter()
+            req = eng.submit(ids, max_new_tokens=max_new)
+            th = threading.Thread(target=consume, args=(req, t0), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - wall0
+        tokens = sum(len(s) for _, s, _ in per_req)
+        errors = [e for _, _, e in per_req if e]
+        tpots = [(s[-1] - s[0]) / (len(s) - 1)
+                 for _, s, e in per_req if len(s) > 1 and not e]
+        return {
+            "requests": len(per_req), "errors": len(errors),
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else 0.0,
+            "tpot_ms_p50": round(pct(tpots, 0.5) * 1e3, 2),
+            "tpot_ms_p95": round(pct(tpots, 0.95) * 1e3, 2),
+        }
+
+    def run_pair(aligned):
+        off = BatchedEngine("preset:bench-spec", **engine_kw)
+        on = BatchedEngine("preset:bench-spec", spec_draft=f"take:{take}",
+                           spec_k=k, spec_mode="auto", **engine_kw)
+        try:
+            if aligned:
+                off.params = align_params(off.params)
+                on.params = align_params(on.params)
+            tok = off.tokenizer
+            probes = [tok.encode("a quick question about the weather today"),
+                      tok.encode("tell me something entirely different")]
+            # pre-clock token-parity gate (greedy): the spec engine's
+            # output must be IDENTICAL to the non-spec twin before any
+            # number it produces is reportable
+            for ids in probes:
+                want = off.generate(ids, max_new_tokens=12)
+                got = on.generate(ids, max_new_tokens=12)
+                assert got == want, (
+                    f"spec-on diverged from spec-off twin: {got} != {want}")
+            off_stats = run_workload(off)
+            on_stats = run_workload(on)
+            info = on.spec_info() or {}
+            proposed = info.get("proposed", 0)
+            accepted = info.get("accepted", 0)
+            row_steps = info.get("row_steps", 0)
+            out = {
+                "parity_checked": True,
+                "accept_rate": (round(accepted / proposed, 3)
+                                if proposed else None),
+                # true mean accepted length per verify event — robust to
+                # the controller shrinking k mid-run (proposed tracks the
+                # ACTUAL per-step k, so accepted*k/proposed would inflate)
+                "mean_accept_len": (round(accepted / row_steps, 2)
+                                    if row_steps else None),
+                "spec_steps": info.get("spec_steps", 0),
+                "plain_steps": info.get("plain_steps", 0),
+                "controller_active": bool(info.get("active")),
+                "disabled_events": info.get("disabled_events", 0),
+                "on": on_stats, "off": off_stats,
+                "tpot_p50_ratio": (
+                    round(on_stats["tpot_ms_p50"] / off_stats["tpot_ms_p50"],
+                          3) if off_stats["tpot_ms_p50"] else None),
+            }
+            return out, on.decode_path
+        finally:
+            off.close()
+            on.close()
+
+    aligned, decode_path = run_pair(aligned=True)
+    adversarial, _ = run_pair(aligned=False)
+    # the adaptive controller's contract: on the adversarial workload
+    # speculation must demonstrably stand down (plain fallback carries the
+    # traffic), so its TPOT cannot drift from the spec-off twin's
+    assert adversarial["plain_steps"] >= adversarial["spec_steps"], (
+        "adaptive-k controller failed to disable spec on the adversarial "
+        f"workload: {adversarial}")
+    adversarial["controller_disabled"] = True
+    tag = (f"bench-spec,L{layers},take{take},k{k},slots{slots},bs{block}")
+    line = {
+        "metric": f"serve_spec_tokens_per_sec[{tag}]",
+        "value": aligned["on"]["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
+        # provenance: which decode path (spec verify runs the multi-token
+        # gather path even under --paged_kernel; the Pallas kernel is the
+        # T=1 non-spec fast path) and which draft produced these numbers
+        "decode_path": decode_path,
+        "spec_mode": "auto",
+        "spec_draft": f"take:{take}",
+        "spec": {"k": k, "target_layers": layers, "draft_layers": take,
+                 "aligned": aligned, "adversarial": adversarial},
+    }
+    if preflight is not None:
+        line["preflight"] = preflight
+    print(json.dumps(line), flush=True)
+
+
 def child_replay(preflight=None):
     """DTX_BENCH_REPLAY=1: the trace-driven load-replay + chaos harness
     (datatunerx_tpu/loadgen/) against a 2-replica in-process fleet of REAL
@@ -769,6 +963,10 @@ if __name__ == "__main__":
         # replay mode: loadgen harness against an in-process fleet, with
         # the same per-phase pre-flight diagnosis on its line
         child_replay(preflight=_preflight_probe())
+    elif os.environ.get("DTX_BENCH_SERVE_SPEC"):
+        # speculative-decoding twin-engine serve bench (spec-on vs spec-off,
+        # aligned + adversarial) with the same pre-flight diagnosis
+        child_serve_spec(preflight=_preflight_probe())
     elif os.environ.get("DTX_BENCH_SERVE"):
         # serve mode is its own entry (no orchestrator): probe first so the
         # serve line carries the same per-phase pre-flight diagnosis

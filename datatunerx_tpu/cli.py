@@ -230,6 +230,9 @@ def cmd_serve(args):
             "--kv_block_size", str(args.kv_block_size),
             "--kv_blocks", str(args.kv_blocks),
             "--paged_kernel", args.paged_kernel,
+            "--spec_draft_config", args.spec_draft_config,
+            "--spec_k", str(args.spec_k),
+            "--spec_mode", args.spec_mode,
             "--prefill_token_budget", str(args.prefill_token_budget),
             "--replicas", str(max(args.replicas, 1)),
             "--policy", args.policy,
@@ -255,6 +258,9 @@ def cmd_serve(args):
         "--kv_block_size", str(args.kv_block_size),
         "--kv_blocks", str(args.kv_blocks),
         "--paged_kernel", args.paged_kernel,
+        "--spec_draft_config", args.spec_draft_config,
+        "--spec_k", str(args.spec_k),
+        "--spec_mode", args.spec_mode,
         "--prefill_token_budget", str(args.prefill_token_budget),
     ]
     return serving_main(argv)
@@ -423,6 +429,16 @@ def main(argv=None):
                     help="Pallas in-place paged decode kernel: auto = "
                          "kernel on TPU / gather elsewhere, on = force "
                          "(interpret-mode on CPU), off = gather oracle")
+    vp.add_argument("--spec_draft_config", default="",
+                    help="speculative decoding draft: model path, "
+                         "preset:<name>, or take:N (target's first N "
+                         "layers); empty = off")
+    vp.add_argument("--spec_k", type=int, default=4,
+                    help="draft proposals per verify step")
+    vp.add_argument("--spec_mode", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="speculative decoding: auto = adaptive, on = "
+                         "pinned, off = plain decode")
     vp.add_argument("--prefill_token_budget", type=int, default=0,
                     help="prefill tokens per scheduler tick between decode "
                          "chunks (0 = unbounded)")

@@ -141,6 +141,7 @@ class StreamingBatchIterator:
         host_id: int = 0,
         num_hosts: int = 1,
         stage: str = "sft",  # sft = templated instruction pairs; pt = plain LM
+        read_ahead: Optional[int] = None,  # raw-record fetch depth; 0 = sync
     ):
         if global_batch % max(grad_accum, 1) != 0:
             raise ValueError("global_batch must be divisible by grad_accum")
@@ -159,6 +160,14 @@ class StreamingBatchIterator:
         self.host_id = host_id
         self.num_hosts = num_hosts
         self.stage = stage
+        # raw-record read-ahead (data/prefetch.ReadAheadIterator): fetch and
+        # encode are decoupled so a jittery remote read (gs:// line stream)
+        # overlaps encoding instead of stalling the HostPrefetcher. Depth 0
+        # = fully synchronous (the pre-read-ahead path, byte-identical
+        # batches either way — the reader preserves record order).
+        if read_ahead is None:
+            read_ahead = int(os.environ.get("DTX_STREAM_READAHEAD", "64"))
+        self.read_ahead = max(0, int(read_ahead))
         # per-thread tokenizer clones (see ensure_thread_safe_encoding)
         self._tls = threading.local()
         self._clone_encoders = False
@@ -199,19 +208,37 @@ class StreamingBatchIterator:
         from datatunerx_tpu.data.preprocess import preprocess_pretrain_records
 
         tokenizer = self._thread_tokenizer()  # one epoch runs on one thread
-        for rec in self.dataset:
-            if self.stage == "pt":
-                out = preprocess_pretrain_records(
-                    [rec], tokenizer,
-                    cutoff_len=self.cutoff_len, columns=self.dataset.columns,
-                )
-            else:
-                out = preprocess_records(
-                    [rec], self.template, tokenizer,
-                    cutoff_len=self.cutoff_len, columns=self.dataset.columns,
-                )
-            if out:
-                yield out[0]
+        source: Iterator = iter(self.dataset)
+        reader = None
+        if self.read_ahead > 0:
+            from datatunerx_tpu.data.prefetch import ReadAheadIterator
+
+            # raw fetch on its own thread; ENCODING stays on this thread
+            # (tokenizer thread-discipline unchanged — see
+            # ensure_thread_safe_encoding)
+            reader = ReadAheadIterator(self.dataset, depth=self.read_ahead)
+            source = reader
+        try:
+            for rec in source:
+                if self.stage == "pt":
+                    out = preprocess_pretrain_records(
+                        [rec], tokenizer,
+                        cutoff_len=self.cutoff_len,
+                        columns=self.dataset.columns,
+                    )
+                else:
+                    out = preprocess_records(
+                        [rec], self.template, tokenizer,
+                        cutoff_len=self.cutoff_len,
+                        columns=self.dataset.columns,
+                    )
+                if out:
+                    yield out[0]
+        finally:
+            # early epoch exit (max_steps) must stop the reader thread —
+            # it would otherwise block forever on the bounded queue
+            if reader is not None:
+                reader.close()
 
     def epoch(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
         rng = np.random.default_rng(self.seed + epoch)

@@ -84,6 +84,87 @@ class PipelineStats:
         return out
 
 
+class ReadAheadIterator:
+    """Bounded background read-ahead over a record iterable — the raw-fetch
+    half of the streaming input path, split off from encoding.
+
+    A ``gs://`` line stream pays its network latency inside ``readline``,
+    which previously ran inline with tokenizer encoding on the
+    HostPrefetcher's worker: one slow object-store read stalled batch
+    assembly and, ``depth`` batches later, the train step. Here a reader
+    thread pulls RAW records into a bounded queue while the consumer
+    encodes, so network jitter overlaps encode/assembly instead of adding
+    to it. Single producer + FIFO queue → order (and therefore batch
+    content) is byte-identical to the synchronous path; source exceptions
+    re-raise at the consumer. ``close()`` (or early generator exit)
+    stops the reader promptly — it never blocks forever on a full queue.
+    """
+
+    _DONE = object()
+
+    def __init__(self, records: Iterable, depth: int = 64):
+        if depth < 1:
+            raise ValueError(f"read-ahead depth must be >= 1, got {depth}")
+        self._records = records
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="dtx-readahead")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for rec in self._records:
+                if not self._put(rec):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            self._exc = e
+        self._put(self._DONE)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    # reader died without posting DONE (should not happen;
+                    # belt-and-braces against a silent thread loss)
+                    if self._exc is not None:
+                        raise self._exc
+                    raise StopIteration
+                continue
+            if item is self._DONE:
+                if self._exc is not None:
+                    raise self._exc
+                raise StopIteration
+            return item
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class HostPrefetcher:
     """Runs a batch-producing iterator in a daemon thread behind a bounded
     queue.

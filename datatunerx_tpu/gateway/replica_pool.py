@@ -222,13 +222,16 @@ class Replica:
     def stats(self) -> dict:
         """{"slots_busy": int, "slots_total": int, "kv_blocks_free": int,
         "kv_blocks_total": int, "adapters": set|None,
-        "resident_adapters": set|None}.
+        "resident_adapters": set|None, "spec_enabled": bool,
+        "spec_accept_rate": float|None}.
         kv_blocks_total 0 means the replica runs a dense cache (no block
         signal); adapters=None means unknown — the router treats it as
         capable of anything (load-on-demand fallback). resident_adapters
         is the subset already materialised in the replica's pool (static
         stacks: everything it knows) — the router's cache-locality
-        preference; None = no residency signal."""
+        preference; None = no residency signal. spec_enabled/_accept_rate
+        carry the speculative-decode plane's signal for the router's
+        spec-friendly preference (rate None = no observations yet)."""
         raise NotImplementedError
 
     def stats_snapshot(self) -> dict:
@@ -481,6 +484,11 @@ class InProcessReplica(Replica):
             resident = set(resident)
         elif adapter_ids is not None:
             resident = set(adapter_ids)
+        spec_fn = getattr(self.engine, "spec_info", None)
+        try:
+            spec_doc = spec_fn() if callable(spec_fn) else None
+        except Exception:  # noqa: BLE001 — stats are advisory
+            spec_doc = None
         return {
             "slots_busy": busy,
             "slots_total": getattr(self.engine, "slots", 0),
@@ -488,6 +496,10 @@ class InProcessReplica(Replica):
             "kv_blocks_total": getattr(self.engine, "total_kv_blocks", None) or 0,
             "adapters": set(adapter_ids) if adapter_ids is not None else None,
             "resident_adapters": resident,
+            # speculative decoding: the router's spec-friendly preference
+            # and the gateway's per-replica acceptance gauge read these
+            "spec_enabled": bool(spec_doc),
+            "spec_accept_rate": (spec_doc or {}).get("accept_rate"),
         }
 
     def close(self):
@@ -733,7 +745,8 @@ class HTTPReplica(Replica):
             return self._stats_cache
         out = {"slots_busy": 0, "slots_total": 0,
                "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
-               "resident_adapters": None}
+               "resident_adapters": None,
+               "spec_enabled": False, "spec_accept_rate": None}
         try:
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
@@ -753,6 +766,10 @@ class HTTPReplica(Replica):
                     elif line.startswith(("dtx_serving_kv_blocks_capacity ",
                                           "dtx_serving_kv_blocks_total ")):
                         out["kv_blocks_total"] = int(float(line.split()[-1]))
+                    elif line.startswith("dtx_serving_spec_enabled "):
+                        out["spec_enabled"] = float(line.split()[-1]) > 0
+                    elif line.startswith("dtx_serving_spec_accept_rate "):
+                        out["spec_accept_rate"] = float(line.split()[-1])
                     else:
                         # residency/capability sets from the labeled gauges
                         # (absent series = no signal, stays None)
@@ -779,7 +796,8 @@ class HTTPReplica(Replica):
             return self._stats_cache
         return {"slots_busy": 0, "slots_total": 0,
                 "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
-                "resident_adapters": None}
+                "resident_adapters": None,
+                "spec_enabled": False, "spec_accept_rate": None}
 
 
 class ReplicaPool:

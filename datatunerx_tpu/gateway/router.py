@@ -79,10 +79,20 @@ class Router:
         # without bound.
         self.adapter_requests: dict = {}
         self._adapter_requests_cap = 1024
+        # spec-routing outcomes: how often spec-friendly (greedy) traffic
+        # found a healthy speculative-decode replica to prefer
+        self.spec_routes = {"preferred": 0, "blind": 0}
+        # replicas whose acceptance EMA sits below this report as spec-
+        # enabled but are NOT preferred — their controller has effectively
+        # disabled drafting, so there is no TPOT win to chase there.
+        # Matches AdaptiveK's stand-down floor (serving/speculative.py),
+        # kept as a literal so the router stays importable without jax.
+        self.spec_accept_floor = 0.35
 
     def route(self, messages: Optional[List[dict]] = None,
               adapter: str = "", session_id: Optional[str] = None,
-              exclude: Optional[set] = None, on_event=None) -> Replica:
+              exclude: Optional[set] = None, on_event=None,
+              prefer_spec: bool = False) -> Replica:
         """Pick a replica. ``exclude`` names replicas already tried for this
         request (failover must not retry the replica that just died).
         ``on_event(name, **detail)`` receives routing decisions — the
@@ -105,6 +115,8 @@ class Router:
         if adapter:
             candidates = self._adapter_candidates(adapter, candidates,
                                                   on_event)
+        if prefer_spec:
+            candidates = self._spec_candidates(candidates, on_event)
 
         key = session_key(messages or [], session_id)
         if key:
@@ -164,6 +176,37 @@ class Router:
                      resident=[r.name for r in resident_set],
                      candidates=len(picked))
         return picked
+
+    def _spec_candidates(self, candidates: List[Replica], on_event) -> list:
+        """Spec-friendly traffic (greedy/low-temperature — the workloads
+        whose drafts verify best) PREFERS replicas running speculative
+        decoding with a healthy acceptance rate, read from replica stats
+        (``dtx_serving_spec_enabled`` / ``_accept_rate`` on remote
+        replicas). A preference, never a filter — a fleet with no spec
+        replica, or one whose acceptance collapsed below the floor, routes
+        exactly as before."""
+        preferred: List[Replica] = []
+        for r in candidates:
+            try:
+                st = r.stats_snapshot()
+            except Exception:  # noqa: BLE001 — stats are advisory
+                continue
+            if not st.get("spec_enabled"):
+                continue
+            rate = st.get("spec_accept_rate")
+            if rate is None or rate >= self.spec_accept_floor:
+                preferred.append(r)
+        with self._lock:
+            if preferred and len(preferred) < len(candidates):
+                self.spec_routes["preferred"] += 1
+            else:
+                self.spec_routes["blind"] += 1
+        if preferred and len(preferred) < len(candidates):
+            if on_event is not None:
+                on_event("spec_route", outcome="preferred",
+                         replicas=[r.name for r in preferred])
+            return preferred
+        return candidates
 
     def _pick(self, candidates: List[Replica]) -> Replica:
         weights = {r.name: max(0.0, getattr(r, "weight", 1.0))

@@ -214,10 +214,18 @@ class Gateway:
         return adapter
 
     def _route(self, messages, adapter, session_id, tried,
-               on_event=None) -> Replica:
+               on_event=None, prefer_spec: bool = False) -> Replica:
         return self.router.route(messages=messages, adapter=adapter,
                                  session_id=session_id, exclude=tried,
-                                 on_event=on_event)
+                                 on_event=on_event, prefer_spec=prefer_spec)
+
+    @staticmethod
+    def _spec_friendly(kwargs: dict) -> bool:
+        """Greedy requests are the spec-friendliest traffic (deterministic
+        proposals verify best and the guarantee is token-exactness, not
+        just distribution-exactness) — prefer replicas whose speculative
+        plane is live for them."""
+        return float(kwargs.get("temperature", 0.0) or 0.0) <= 0.0
 
     def _replica_failed(self, replica: Replica):
         replica.breaker.record_failure()
@@ -283,8 +291,10 @@ class Gateway:
                                  attempts=attempt + 1, handoff=True)
                         self._finish_request_span(root)
                         return text
-                    replica = self._route(messages, adapter, session_id,
-                                          tried, on_event=root.event)
+                    replica = self._route(
+                        messages, adapter, session_id, tried,
+                        on_event=root.event,
+                        prefer_spec=self._spec_friendly(kwargs))
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -378,8 +388,10 @@ class Gateway:
                                  handoff=True)
                         self._finish_request_span(root)
                         return
-                    replica = self._route(messages, adapter, session_id,
-                                          tried, on_event=root.event)
+                    replica = self._route(
+                        messages, adapter, session_id, tried,
+                        on_event=root.event,
+                        prefer_spec=self._spec_friendly(kwargs))
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -778,6 +790,17 @@ class Gateway:
         a_resident = g("dtx_gateway_adapter_resident_replicas",
                        "Replicas whose pool currently holds each adapter "
                        "(from replica stats snapshots).")
+        # speculative decoding: the per-replica acceptance-rate gauge the
+        # spec-friendly routing preference reads, plus preference outcomes
+        spec_rate = g("dtx_gateway_replica_spec_accept_rate",
+                      "Per-replica speculative-decode acceptance-rate EMA "
+                      "(labels absent on replicas without a draft model "
+                      "or with no observations yet).")
+        spec_routes = self.registry.counter(
+            "dtx_gateway_spec_routes_total",
+            "Spec-friendly (greedy) routing outcomes: preferred = "
+            "narrowed to spec-enabled replicas, blind = no narrowing "
+            "possible (none or all candidates run spec).")
         circuit.clear()
         up.clear()
         busy.clear()
@@ -787,9 +810,14 @@ class Gateway:
         a_routes.clear()
         a_reqs.clear()
         a_resident.clear()
+        spec_rate.clear()
+        spec_routes.clear()
         with self.router._lock:
             routes = dict(self.router.adapter_routes)
             per_adapter = dict(self.router.adapter_requests)
+            s_routes = dict(getattr(self.router, "spec_routes", {}))
+        for outcome, n in sorted(s_routes.items()):
+            spec_routes.set(n, {"outcome": outcome})
         for outcome, n in sorted(routes.items()):
             a_routes.set(n, {"outcome": outcome})
         for name, n in sorted(per_adapter.items()):
@@ -814,6 +842,10 @@ class Gateway:
             for a in st.get("resident_adapters") or ():
                 if a:
                     residency[a] = residency.get(a, 0) + 1
+            if st.get("spec_enabled") and \
+                    st.get("spec_accept_rate") is not None:
+                spec_rate.set(round(st["spec_accept_rate"], 4),
+                              {"replica": r.name})
             weight.set(round(getattr(r, "weight", 1.0), 6),
                        {"replica": r.name})
             out = r.outcome_stats()
@@ -1461,6 +1493,10 @@ def main(argv=None):
     p.add_argument("--prefix_cache", type=int, default=0)
     p.add_argument("--kv_block_size", type=int, default=0)
     p.add_argument("--kv_blocks", type=int, default=0)
+    p.add_argument("--spec_draft_config", default="")
+    p.add_argument("--spec_k", type=int, default=4)
+    p.add_argument("--spec_mode", default="auto",
+                   choices=["auto", "on", "off"])
     p.add_argument("--paged_kernel", default="auto",
                    choices=["auto", "on", "off"])
     p.add_argument("--prefill_chunk", type=int, default=256)
@@ -1518,6 +1554,9 @@ def main(argv=None):
                        "--kv_block_size", str(args.kv_block_size),
                        "--kv_blocks", str(args.kv_blocks),
                        "--paged_kernel", args.paged_kernel,
+                       "--spec_draft_config", args.spec_draft_config,
+                       "--spec_k", str(args.spec_k),
+                       "--spec_mode", args.spec_mode,
                        "--prefill_chunk", str(args.prefill_chunk),
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]

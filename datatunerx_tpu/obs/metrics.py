@@ -344,6 +344,20 @@ def adapter_load_histogram(registry: Registry) -> Histogram:
         buckets=MS_BUCKETS)
 
 
+def spec_accept_len_histogram(registry: Registry) -> Histogram:
+    """Accepted-draft-length histogram of the speculative decode plane
+    (``dtx_serving_spec_accept_len``): one observation per drafting row per
+    verify step, value = tokens of the proposal prefix the target accepted
+    (0..k). Declared once here — the engine observes into it and the
+    serving server pre-declares it at scrape time — like
+    ``serving_latency_histograms``. Buckets are token counts, not time, so
+    no unit suffix."""
+    return registry.histogram(
+        "dtx_serving_spec_accept_len",
+        "Draft tokens accepted per verify-k step (before the corrected/"
+        "bonus token).", buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
+
 # ------------------------------------------------------------ process plumbing
 
 _PROCESS_START = time.monotonic()

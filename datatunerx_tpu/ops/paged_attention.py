@@ -112,6 +112,26 @@ class BlockAllocator:
             self._free_set.update(ids)
 
 
+def blocks_for_depth(depth: int, block_size: int, overshoot: int = 0,
+                     cap_depth: Optional[int] = None) -> int:
+    """Blocks a slot must reserve to hold ``depth`` tokens of KV plus
+    ``overshoot`` scratch tokens — the admission reserve math.
+
+    ``overshoot`` exists for speculative decoding: a verify-k forward writes
+    up to ``k + 1`` tokens beyond the row's live cursor (the pending token
+    plus k proposals), and while accepted tokens always land within the
+    plain ``depth`` extent, reserving the overshoot keeps REJECTED-lane
+    writes physical too — no verify distribution is ever computed over a
+    dropped write, and the slot's blocks tell the whole story when
+    debugging. ``cap_depth`` (normally ``max_seq_len``) bounds the reserve
+    at the block-table width so overshoot can never demand more blocks than
+    a table row can hold."""
+    total = depth + max(0, overshoot)
+    if cap_depth is not None:
+        total = min(total, cap_depth)
+    return -(-total // block_size)
+
+
 def init_paged_cache(cfg, slots: int, num_blocks: int, block_size: int,
                      blocks_per_slot: int, dtype=jnp.bfloat16,
                      quantize: Optional[str] = None) -> Dict:

@@ -504,6 +504,9 @@ class BatchedEngine:
         kv_block_size: int = 0,  # >0: paged block-pool cache (elastic HBM)
         kv_blocks: Optional[int] = None,  # pool size; default = dense parity
         paged_kernel: str = "auto",  # Pallas in-place decode: auto|on|off
+        spec_draft: Optional[str] = None,  # draft model: path|preset:|take:N
+        spec_k: int = 4,  # proposals per verify step (adaptive ceiling)
+        spec_mode: str = "auto",  # auto (adaptive) | on (pinned) | off
         prefill_chunk: int = 256,  # chunked-prefill program length (paged)
         prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
         registry: Optional[Registry] = None,  # shared /metrics registry
@@ -641,6 +644,54 @@ class BatchedEngine:
         self._stops = jnp.full((slots, MAX_STOP), -1, jnp.int32)
         self._adapter_idx = jnp.zeros((slots,), jnp.int32)
 
+        # ---- speculative decoding (serving/speculative.py): a draft model
+        # proposes k tokens, one verify-k target forward accepts a prefix.
+        # No draft configured → every spec structure stays None and the
+        # scheduler takes the exact pre-spec decode path (--spec_mode off
+        # is byte-identical to not having the feature).
+        smode = (spec_mode or "auto").strip().lower()
+        if smode not in ("auto", "on", "off"):
+            raise ValueError(f"spec_mode must be auto|on|off, got {spec_mode!r}")
+        if smode == "on" and not spec_draft:
+            raise ValueError("--spec_mode on requires --spec_draft_config")
+        self.spec_mode = smode
+        self.spec_k = max(1, int(spec_k))
+        self.spec = None
+        # verify-k writes up to spec_k+1 tokens past a row's cursor; paged
+        # admission reserves that overshoot so every verify write stays
+        # physical (ops.paged_attention.blocks_for_depth caps at the table
+        # width). 0 when spec is off — reserve math byte-identical to today.
+        self._spec_overshoot = 0
+        if spec_draft and smode != "off":
+            from datatunerx_tpu.serving import speculative as spec_mod
+
+            dcfg, dparams = spec_mod.build_draft(spec_draft, self.cfg,
+                                                 self.params)
+            self.spec = {
+                "draft": spec_draft,
+                "dcfg": dcfg,
+                "dparams": dparams,
+                # compact per-slot dense cache for the draft — rides the
+                # same ops/attention.py cache interface as the target's
+                "dcache": init_cache(dcfg, slots, self.max_seq_len,
+                                     dtype=jnp.bfloat16, per_slot=True),
+                "programs": spec_mod.spec_programs(
+                    self.cfg, dcfg, self.max_seq_len, self.kv_quant),
+            }
+            self.spec_ctrl = spec_mod.AdaptiveK(self.spec_k, mode=smode)
+            self._spec_overshoot = self.spec_k + 1
+            self._spec_pending = jnp.zeros((slots,), jnp.int32)
+            self._spec_form = [False] * slots   # slot is in pending form
+            self._spec_primed = [False] * slots  # draft row holds the context
+            # counters behind dtx_serving_spec_{proposed,accepted}_total and
+            # the step-mix; written by the scheduler thread only
+            self.spec_stats = {"proposed": 0, "accepted": 0,
+                               "row_steps": 0,  # per-row verify events
+                               "spec_steps": 0, "plain_steps": 0}
+            # per-adapter acceptance EMA ('' = base) for /metrics + routing
+            self._spec_adapter_ema: Dict[str, float] = {}
+            self._h_accept_len = None  # bound after the registry exists
+
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         # dynamic mode: the adapter NAME each slot pins (released with the
@@ -722,6 +773,10 @@ class BatchedEngine:
         (self._h_ttft, self._h_tpot,
          self._h_prefill_chunk) = serving_latency_histograms(self.registry)
         self._h_adapter_load = adapter_load_histogram(self.registry)
+        if self.spec is not None:
+            from datatunerx_tpu.obs.metrics import spec_accept_len_histogram
+
+            self._h_accept_len = spec_accept_len_histogram(self.registry)
         # Per-request span timelines (the PR 5 sched_trace deque, promoted):
         # completed requests land in a bounded trace ring keyed by trace id,
         # served by GET /debug/trace/<id> on the serving server and merged
@@ -1051,6 +1106,9 @@ class BatchedEngine:
             req.prompt_ids, self.tokenizer.eos_token_id,
             self.max_seq_len, req.max_new_tokens,
         )
+        # the real (un-padded) kept prompt: what the draft model prefills
+        # when this slot later joins speculative decoding
+        req.spec_prime_ids = ids[plen - n_prompt:]
         akey = self._adapter_cache_key(req)
         if not self.paged:
             row_logits, row_cache, cursor = self._prefill_row(
@@ -1136,7 +1194,14 @@ class BatchedEngine:
         return True
 
     def _alloc_blocks(self, depth: int) -> Optional[List[int]]:
-        return self._allocator.alloc(-(-depth // self.block_size))
+        from datatunerx_tpu.ops.paged_attention import blocks_for_depth
+
+        # spec engines reserve the verify-k write overshoot (spec_k + 1
+        # tokens) on top of the request's own depth, capped at the block
+        # table's width — see blocks_for_depth for the rationale
+        return self._allocator.alloc(blocks_for_depth(
+            depth, self.block_size, overshoot=self._spec_overshoot,
+            cap_depth=self.max_seq_len))
 
     def _table_row(self, blocks: List[int]) -> jnp.ndarray:
         row = np.full((self.blocks_per_slot,), -1, np.int32)
@@ -1435,6 +1500,13 @@ class BatchedEngine:
                 self._count_mig("export", "skipped_prefill")
                 continue
             try:
+                # a spec-active slot first settles: its pending token's KV
+                # is written and next-token logits materialize, so the
+                # payload is the standard logits-form wire format any
+                # replica (spec or not) can import; the importer re-primes
+                # its own draft cache rather than shipping draft KV
+                if self.spec is not None and self._spec_form[slot]:
+                    self._spec_settle_slot(slot)
                 payload = self._export_slot(slot, req, cmd.get("wire"))
             except Exception as e:  # noqa: BLE001 — skip the slot, keep the rest
                 skipped.append({"slot": slot, "reason": str(e)})
@@ -1558,6 +1630,18 @@ class BatchedEngine:
                           or f"dtx-{uuid.uuid4().hex[:16]}"))
             req.tokens = payload["tokens"]
             req.resume_base = len(req.tokens)
+            if self.spec is not None:
+                # re-prime contract: the wire carries no draft-cache state;
+                # the slot joins speculative decoding after its draft row
+                # is re-prefilled from the payload's prompt + tail (the
+                # scheduler does this before the slot's first spec step —
+                # priming affects acceptance only, never output exactness)
+                from datatunerx_tpu.utils.decoding import prepare_prompt
+
+                p_ids, _, _, p_plen, p_n, _, _ = prepare_prompt(
+                    payload["prompt_ids"], self.tokenizer.eos_token_id,
+                    self.max_seq_len, payload["max_new_tokens"])
+                req.spec_prime_ids = p_ids[p_plen - p_n:]
             if self.paged:
                 (self._cache, self._logits, self._pos, self._remaining,
                  self._active, self._temps, self._top_ps, self._stops,
@@ -1611,6 +1695,10 @@ class BatchedEngine:
         self._slot_req[slot] = None
         self._pending.pop(slot, None)
         self._decode_ready[slot] = False
+        if self.spec is not None:
+            self._spec_form[slot] = False
+            self._spec_primed[slot] = False
+            self.spec_ctrl.reset_slot(slot)
         name, self._slot_adapter[slot] = self._slot_adapter[slot], None
         if name is not None and self.adapter_registry is not None:
             self.adapter_registry.release(name)
@@ -1621,6 +1709,188 @@ class BatchedEngine:
             self._cache["block_tables"] = \
                 self._cache["block_tables"].at[slot].set(-1)
             self._allocator.free(blocks)
+
+    # ------------------------------------------------ speculative decoding
+    def _spec_prime_slot(self, slot: int):
+        """Prefill the slot's context (kept prompt + settled emitted tokens)
+        through the DRAFT model into its per-slot draft cache row. Priming
+        affects only acceptance rate — verification guarantees output
+        exactness regardless — so an import re-primed from the payload's
+        prompt is correct by construction."""
+        req = self._slot_req[slot]
+        ids = list(getattr(req, "spec_prime_ids", None) or [])
+        if not ids:
+            ids = list(req.prompt_ids)[-self.max_seq_len:] or \
+                [self.tokenizer.eos_token_id or 0]
+        toks = ids + list(req.tokens)
+        n, W = len(toks), self.max_seq_len
+        if n > W:
+            # context can't be represented in the draft row: this slot
+            # rides the plain path for its lifetime (no re-prime loop)
+            self.spec_ctrl.force_off_slot(slot)
+            self._spec_primed[slot] = True
+            return
+        padded = min(-(-n // DECODE_BUCKET) * DECODE_BUCKET, W)
+        pad = padded - n
+        eos = self.tokenizer.eos_token_id or 0
+        sp = self.spec
+        sp["dcache"] = sp["programs"].prime(
+            sp["dparams"], sp["dcache"], jnp.asarray(slot, jnp.int32),
+            jnp.asarray([[eos] * pad + toks], jnp.int32),
+            jnp.asarray([[0] * pad + [1] * n], jnp.int32),
+            jnp.asarray([[0] * pad + list(range(n))], jnp.int32),
+            jnp.asarray(padded, jnp.int32))
+        self._spec_primed[slot] = True
+        self._trace("spec_prime", slot, n)
+
+    def _spec_settle_slot(self, slot: int):
+        """Write the slot's pending token through the target (one masked
+        single-token forward) so the slot returns to the standard
+        logits-form state — the KV-migration wire format's contract. Every
+        other row's cursor is restored inside the program."""
+        if self.spec is None or not self._spec_form[slot]:
+            return
+        onehot = np.zeros((self.slots,), bool)
+        onehot[slot] = True
+        sp = self.spec
+        row_logits, self._cache, self._pos = sp["programs"].settle(
+            self.params, self._lora_arg(), self._cache, self._spec_pending,
+            self._pos, self._adapter_idx, jnp.asarray(onehot))
+        self._logits = jnp.where(jnp.asarray(onehot)[:, None], row_logits,
+                                 self._logits)
+        self._spec_form[slot] = False
+        self._trace("spec_settle", slot)
+
+    def _spec_decode_tick(self):
+        """One speculative scheduler tick, replacing the plain decode chunk:
+        (1) freshly-ready slots get their draft row primed and transition to
+        pending form (their first token sampled exactly as the plain step
+        would); (2) if the adaptive controller approves, ONE draft-propose /
+        verify-k program emits up to k+1 tokens per drafting row with ragged
+        per-row advance, otherwise the pending-form plain chunk program runs
+        at identical per-token cost to the non-spec path. Returns
+        ``(emitted [n, S] np, active [S] np)`` for the shared push/finish
+        loop."""
+        sp = self.spec
+        progs = sp["programs"]
+        out_rows = []
+
+        fresh = [s for s in range(self.slots)
+                 if self._decode_ready[s] and self._slot_req[s] is not None
+                 and not self._spec_form[s]]
+        if fresh:
+            for slot in fresh:
+                if not self._spec_primed[slot]:
+                    self._spec_prime_slot(slot)
+            fresh_mask = np.zeros((self.slots,), bool)
+            fresh_mask[fresh] = True
+            (enter_emitted, self._spec_pending, self._remaining,
+             self._active, self._rng) = progs.enter(
+                self._logits, self._spec_pending, self._remaining,
+                self._active, self._rng, self._temps, self._top_ps,
+                self._stops, jnp.asarray(fresh_mask))
+            for slot in fresh:
+                self._spec_form[slot] = True
+            # first-token emissions stream ahead of this tick's chunk
+            out_rows.append(np.asarray(enter_emitted)[None, :])  # dtxlint: disable=DTX001
+
+        # tiny [S] scalars at the tick's designed sync point: which rows are
+        # worth drafting for (active, ≥2 budget left, acceptance healthy)
+        active_prev = np.asarray(self._active)  # dtxlint: disable=DTX001
+        rem_np = np.asarray(self._remaining)  # dtxlint: disable=DTX001
+        spec_rows = np.zeros((self.slots,), bool)
+        for s in range(self.slots):
+            spec_rows[s] = bool(
+                self._spec_form[s] and self._spec_primed[s]
+                and active_prev[s] and rem_np[s] >= 2
+                and self.spec_ctrl.slot_enabled(s))
+
+        if spec_rows.any() and self.spec_ctrl.use_spec():
+            k = self.spec_ctrl.current_k()
+            # static batch mode (bounded compiled variants): all-greedy
+            # batches verify by argmax alone — no distributions, no
+            # full-vocab sort; top_p-free sampled batches use plain
+            # softmax; only genuinely filtering batches pay the exact
+            # sorted top-p path
+            live = [r for r in self._slot_req if r is not None]
+            if all(r.temperature <= 0.0 for r in live):
+                mode = "greedy"
+            elif any(r.top_p < 1.0 and r.temperature > 0.0 for r in live):
+                mode = "topp"
+            else:
+                mode = "simple"
+            with jax.profiler.TraceAnnotation("dtx_engine_spec_step"):
+                (emitted, acc, self._cache, sp["dcache"],
+                 self._spec_pending, self._pos, self._remaining,
+                 self._active, self._rng) = progs.step(
+                    self.params, sp["dparams"], self._lora_arg(),
+                    self._cache, sp["dcache"], self._spec_pending,
+                    self._pos, self._remaining, self._active, self._rng,
+                    self._temps, self._top_ps, self._stops,
+                    self._adapter_idx, jnp.asarray(spec_rows), k=k,
+                    mode=mode)
+            out_rows.append(np.asarray(emitted).T)  # [k+1, S]  # dtxlint: disable=DTX001
+            acc_np = np.asarray(acc)  # dtxlint: disable=DTX001
+            # acc_np is host numpy already — no device sync here
+            obs = [(s, int(acc_np[s]), k) for s in range(self.slots)  # dtxlint: disable=DTX001
+                   if spec_rows[s] and active_prev[s]]
+            self.spec_ctrl.observe(obs)
+            self.spec_stats["spec_steps"] += 1
+            self.spec_stats["row_steps"] += len(obs)
+            for s, a, kk in obs:
+                self.spec_stats["proposed"] += kk
+                self.spec_stats["accepted"] += a
+                if self._h_accept_len is not None:
+                    self._h_accept_len.observe(a)
+                req = self._slot_req[s]
+                name = req.adapter_name if req is not None else ""
+                ema = self._spec_adapter_ema.get(name)
+                rate = a / kk
+                # same smoothing as the controller's EMAs, so the adapter
+                # gauge and the global/slot gauges agree on shared traffic
+                alpha = self.spec_ctrl.alpha
+                self._spec_adapter_ema[name] = (
+                    rate if ema is None else ema + alpha * (rate - ema))
+            self._trace("spec", k, len(obs))
+        else:
+            with jax.profiler.TraceAnnotation("dtx_engine_decode"):
+                (emitted, self._cache, self._spec_pending, self._pos,
+                 self._remaining, self._active, self._rng) = progs.decode(
+                    self.params, self._lora_arg(), self._cache,
+                    self._spec_pending, self._pos, self._remaining,
+                    self._active, self._rng, self._temps, self._top_ps,
+                    self._stops, self._adapter_idx, K=self.chunk)
+            out_rows.append(np.asarray(emitted))  # [K, S]  # dtxlint: disable=DTX001
+            self.spec_stats["plain_steps"] += 1
+            self.spec_ctrl.note_plain_step()
+            self._trace("decode", self.chunk)
+
+        active_np = np.asarray(self._active)  # dtxlint: disable=DTX001
+        return np.concatenate(out_rows, axis=0), active_np
+
+    def spec_info(self) -> Optional[dict]:
+        """Speculative-decode observability document for stats()//metrics;
+        None when no draft is configured."""
+        if self.spec is None:
+            return None
+        snap = self.spec_ctrl.snapshot()
+        info = {
+            "enabled": True,
+            "mode": self.spec_mode,
+            "draft": self.spec["draft"],
+            "k_max": self.spec_k,
+            "k": snap["k"],
+            "accept_rate": (round(snap["global_ema"], 4)
+                            if snap["global_ema"] is not None else None),
+            "adapter_accept_rate": {n: round(v, 4) for n, v in
+                                    dict(self._spec_adapter_ema).items()},
+            "slot_accept_rate": snap["slots"],
+            "slots_off": snap["slots_off"],
+            "active": self.spec_ctrl.use_spec(),
+            "disabled_events": snap["disabled_events"],
+        }
+        info.update(self.spec_stats)
+        return info
 
     def _scheduler(self):
         while not self._shutdown.is_set():
@@ -1639,20 +1909,24 @@ class BatchedEngine:
                 continue
 
             try:
-                with jax.profiler.TraceAnnotation("dtx_engine_decode"):
-                    (emitted, self._logits, self._cache, self._pos,
-                     self._remaining, self._active, self._rng) = self._decode(
-                        self.params, self._lora_arg(), self._cache,
-                        self._logits, self._pos,
-                        self._remaining, self._active, self._rng, self._temps,
-                        self._top_ps, self._stops, self._adapter_idx,
-                        K=self.chunk,
-                    )
-                self._trace("decode", self.chunk)
-                # the decode loop's ONE designed sync point: K tokens per
-                # chunk cross to host here so req.push can stream them
-                emitted_np = np.asarray(emitted)  # [K, S]  # dtxlint: disable=DTX001
-                active_np = np.asarray(self._active)  # [S]  # dtxlint: disable=DTX001
+                if self.spec is not None:
+                    emitted_np, active_np = self._spec_decode_tick()
+                else:
+                    with jax.profiler.TraceAnnotation("dtx_engine_decode"):
+                        (emitted, self._logits, self._cache, self._pos,
+                         self._remaining, self._active, self._rng) = \
+                            self._decode(
+                                self.params, self._lora_arg(), self._cache,
+                                self._logits, self._pos,
+                                self._remaining, self._active, self._rng,
+                                self._temps, self._top_ps, self._stops,
+                                self._adapter_idx, K=self.chunk,
+                            )
+                    self._trace("decode", self.chunk)
+                    # the decode loop's ONE designed sync point: K tokens per
+                    # chunk cross to host here so req.push can stream them
+                    emitted_np = np.asarray(emitted)  # [K, S]  # dtxlint: disable=DTX001
+                    active_np = np.asarray(self._active)  # [S]  # dtxlint: disable=DTX001
             except Exception as e:  # noqa: BLE001 — device fault: fail all in-flight
                 for slot, req in enumerate(self._slot_req):
                     if req is not None:

@@ -78,7 +78,8 @@ class LocalServingBackend:
             # and needs no spec entry)
             for key in ("kv_block_size", "kv_blocks", "prefill_chunk",
                         "prefill_token_budget", "adapter_pool",
-                        "adapter_rank_max", "paged_kernel"):
+                        "adapter_rank_max", "paged_kernel",
+                        "spec_draft_config", "spec_k", "spec_mode"):
                 if spec.get(key):
                     argv += [f"--{key}", str(spec[key])]
             from datatunerx_tpu.operator.backends import _pkg_root

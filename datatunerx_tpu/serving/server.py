@@ -25,6 +25,7 @@ from datatunerx_tpu.obs.metrics import (
     adapter_load_histogram,
     exemplars_requested,
     serving_latency_histograms,
+    spec_accept_len_histogram,
     set_build_info,
     set_uptime,
 )
@@ -189,6 +190,55 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
         a_evict.set(occ.get("evictions", 0))
         a_hits.set(occ.get("hits", 0))
         a_miss.set(occ.get("misses", 0))
+    # speculative decoding: proposal/acceptance counters + the acceptance-
+    # rate EMAs (global, per adapter, per slot) the gateway's spec-friendly
+    # routing reads. Declared every scrape (stable zero series on non-spec
+    # engines), restated from the engine's spec_info document.
+    spec_accept_len_histogram(reg)  # engine observes into this same object
+    sp_enabled = reg.gauge("dtx_serving_spec_enabled",
+                           "1 when speculative decoding is configured "
+                           "(a draft model is loaded).")
+    sp_active = reg.gauge("dtx_serving_spec_active",
+                          "1 while the adaptive controller is actually "
+                          "drafting (0 = fallen back to plain decode).")
+    sp_k = reg.gauge("dtx_serving_spec_k",
+                     "Current proposal depth k (adaptive, <= --spec_k).")
+    sp_rate = reg.gauge("dtx_serving_spec_accept_rate",
+                        "Global acceptance-rate EMA (accepted/proposed "
+                        "per verify step).")
+    sp_rate_adapter = reg.gauge("dtx_serving_spec_adapter_accept_rate",
+                                "Acceptance-rate EMA per adapter name "
+                                "('' = base model).")
+    sp_rate_slot = reg.gauge("dtx_serving_spec_slot_accept_rate",
+                             "Acceptance-rate EMA per live cache slot.")
+    sp_prop = reg.counter("dtx_serving_spec_proposed_total",
+                          "Draft tokens proposed to the verifier.")
+    sp_acc = reg.counter("dtx_serving_spec_accepted_total",
+                         "Proposed tokens the target accepted.")
+    sp_steps = reg.counter("dtx_serving_spec_steps_total",
+                           "Decode programs run by path (spec = draft/"
+                           "verify, plain = pending-form fallback).")
+    for m in (sp_enabled, sp_active, sp_k, sp_rate, sp_rate_adapter,
+              sp_rate_slot, sp_prop, sp_acc, sp_steps):
+        m.clear()
+    spec_fn = getattr(eng, "spec_info", None)
+    spec_doc = spec_fn() if callable(spec_fn) else None
+    sp_enabled.set(1 if spec_doc else 0)
+    if spec_doc:
+        sp_active.set(1 if spec_doc.get("active") else 0)
+        sp_k.set(spec_doc.get("k", 0))
+        if spec_doc.get("accept_rate") is not None:
+            sp_rate.set(spec_doc["accept_rate"])
+        for name, v in sorted(
+                (spec_doc.get("adapter_accept_rate") or {}).items()):
+            sp_rate_adapter.set(v, {"adapter": name})
+        for slot, v in sorted(
+                (spec_doc.get("slot_accept_rate") or {}).items()):
+            sp_rate_slot.set(v, {"slot": str(slot)})
+        sp_prop.set(spec_doc.get("proposed", 0))
+        sp_acc.set(spec_doc.get("accepted", 0))
+        sp_steps.set(spec_doc.get("spec_steps", 0), {"path": "spec"})
+        sp_steps.set(spec_doc.get("plain_steps", 0), {"path": "plain"})
     # KV migration fabric: session export/import outcomes (restated from
     # the engine's scheduler-thread counters, cleared first like the rest)
     s_exp = reg.counter("dtx_serving_session_export_total",
@@ -691,6 +741,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       adapter_targets=None, kv_quant=None, prefix_cache=0,
                       kv_block_size=0, kv_blocks=0, prefill_chunk=256,
                       prefill_token_budget=0, paged_kernel="auto",
+                      spec_draft=None, spec_k=4, spec_mode="auto",
                       trace_ring=256, trace_log_path=None):
     def _load():
         try:
@@ -706,7 +757,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               ("--kv_block_size", kv_block_size),
                               # only "on" demands the batched paged engine;
                               # "off"/"auto" are no-ops everywhere else
-                              ("--paged_kernel", paged_kernel == "on")):
+                              ("--paged_kernel", paged_kernel == "on"),
+                              ("--spec_draft_config", spec_draft)):
                 if val and not batched:
                     raise ValueError(
                         f"{flag} requires the batched engine "
@@ -725,6 +777,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     kv_quant=kv_quant or None, prefix_cache=prefix_cache,
                     kv_block_size=kv_block_size, kv_blocks=kv_blocks or None,
                     paged_kernel=paged_kernel or "auto",
+                    spec_draft=spec_draft or None,
+                    spec_k=spec_k, spec_mode=spec_mode or "auto",
                     prefill_chunk=prefill_chunk,
                     prefill_token_budget=prefill_token_budget,
                     # the server's registry: engine TTFT/TPOT/prefill-chunk
@@ -816,6 +870,20 @@ def main(argv=None):
                         "on = force the kernel (interpret-mode on CPU), "
                         "off = always the gather oracle; needs "
                         "--kv_block_size > 0 to engage")
+    p.add_argument("--spec_draft_config", default="",
+                   help="speculative decoding draft model: a model path, "
+                        "preset:<name> (same vocab as the target), or "
+                        "take:N (self-speculative — the target's first N "
+                        "layers). Empty = speculative decoding off")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="draft proposals per verify step (the adaptive "
+                        "controller's ceiling)")
+    p.add_argument("--spec_mode", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="speculative decoding: auto = adaptive (shrink k / "
+                        "fall back to plain decode when acceptance "
+                        "collapses), on = always draft, off = exactly "
+                        "today's decode path")
     p.add_argument("--prefill_chunk", type=int, default=256,
                    help="chunked-prefill program length in tokens (paged "
                         "engine); long prompts prefill in chunks "
@@ -864,6 +932,8 @@ def main(argv=None):
                       prefill_chunk=args.prefill_chunk,
                       prefill_token_budget=args.prefill_token_budget,
                       paged_kernel=args.paged_kernel,
+                      spec_draft=args.spec_draft_config,
+                      spec_k=args.spec_k, spec_mode=args.spec_mode,
                       trace_ring=args.trace_ring,
                       trace_log_path=args.trace_log)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)

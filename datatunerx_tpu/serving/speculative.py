@@ -1,0 +1,573 @@
+"""Speculative decoding for the batched serving engine (ISSUE 14).
+
+PR 13's Pallas kernel made each decode step cheap on HBM; this module makes
+each TARGET step emit more than one token. A small draft model proposes ``k``
+tokens autoregressively, the target model runs ONE verify-k forward over the
+proposed positions, and a batched rejection/residual acceptance rule keeps the
+longest agreeing prefix plus one corrected token — so a target forward
+amortizes over ``1 + accepted`` emitted tokens while staying
+**distribution-exact**:
+
+- greedy (``temperature <= 0``): a proposal is accepted iff it equals the
+  target argmax at its position, and the corrected token IS the target
+  argmax — the emitted stream is token-identical to vanilla greedy decode;
+- sampled: the standard speculative scheme (Leviathan et al. / Chen et al.):
+  accept ``d_j`` with prob ``min(1, p_j(d_j)/q_j(d_j))``; on first rejection
+  sample from the residual ``norm(max(p_j - q_j, 0))``; on full acceptance
+  sample the bonus token from ``p_k``. Every emitted token is marginally
+  distributed exactly as a sample from the target distribution, driven by the
+  slot's live PRNG key (the same first-class key the KV-migration payload
+  carries).
+
+The engine-side state machine (``BatchedEngine._spec_decode_tick``) keeps
+slots in **pending-token form**: the most recently emitted token's KV is not
+yet written; each step feeds ``[pending, d_0..d_{k-1}]`` through the target so
+the bonus/corrected token needs no extra forward. ``SpecPrograms`` below holds
+the jitted device programs (process-memoized like the engine's ``_Programs``);
+the acceptance math is pure and unit-testable.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.models.llama import forward, init_cache
+from datatunerx_tpu.serving.engine import _sample_jit
+
+SPEC_MODES = ("auto", "on", "off")
+
+
+# ------------------------------------------------------------- sampling math
+def sampling_probs(logits: jnp.ndarray, temperature, top_p,
+                   exact_topp: bool = True) -> jnp.ndarray:
+    """The probability vector ``_sample_jit`` samples from ([V] float32).
+
+    Greedy (``temperature <= 0``) is a one-hot argmax; otherwise the top-p
+    truncated, renormalized softmax of ``logits / temperature`` — computed in
+    the same sorted space as ``_sample_jit`` so the two agree exactly (the
+    categorical over ``filtered`` logits IS the renormalized kept mass).
+    The acceptance rule must divide/subtract these, so they are materialized
+    here instead of re-deriving the filter at every use site.
+
+    ``exact_topp=False`` is a STATIC fast path for batches where no live row
+    actually filters (every ``top_p >= 1``): the cut never triggers, so the
+    distribution is plain ``softmax(logits/t)`` and the full-vocab sort —
+    the single most expensive op in the verify program — never compiles.
+    The caller asserts the batch property; passing a filtering row through
+    the fast path would be WRONG, not just slow."""
+    V = logits.shape[-1]
+    greedy = jax.nn.one_hot(jnp.argmax(logits), V, dtype=jnp.float32)
+
+    t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t
+    if exact_topp:
+        sorted_idx = jnp.argsort(-scaled)
+        sorted_logits = scaled[sorted_idx]
+        probs = jax.nn.softmax(sorted_logits)
+        cum = jnp.cumsum(probs)
+        cut = (cum - probs > top_p) & (top_p < 1.0)
+        kept = jnp.where(cut, 0.0, probs)
+        kept = kept / jnp.maximum(kept.sum(), 1e-30)
+        sampled = jnp.zeros((V,), jnp.float32).at[sorted_idx].set(kept)
+    else:
+        sampled = jax.nn.softmax(scaled)
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def accept_tokens(p_probs: jnp.ndarray, q_probs: jnp.ndarray,
+                  draft_toks: jnp.ndarray, temperature, rng,
+                  spec_on) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One row's rejection/residual acceptance (traceable; vmapped by the
+    verify program, unit-tested directly).
+
+    ``p_probs`` [k+1, V]: target distributions at the k proposed positions
+    plus the bonus position; ``q_probs`` [k, V]: the draft distributions each
+    proposal was sampled from; ``draft_toks`` [k]. Returns ``(n_accept,
+    extra_token, new_rng)`` — the row emits ``draft_toks[:n_accept]`` then
+    ``extra_token`` (subject to the engine's stop/budget truncation).
+
+    ``spec_on=False`` rows force zero acceptances AND a zero draft
+    distribution, so the "residual" degenerates to the plain target
+    distribution ``p_0`` — the row takes an ordinary single-token step
+    inside the same program."""
+    k = draft_toks.shape[0]
+    rng, u_key, x_key = jax.random.split(rng, 3)
+    us = jax.random.uniform(u_key, (k,))
+    idx = jnp.arange(k)
+    p_at = p_probs[idx, draft_toks]
+    q_at = q_probs[idx, draft_toks]
+    greedy = temperature <= 0.0
+    tgt_argmax = jnp.argmax(p_probs, axis=-1)  # [k+1]
+    # u < min(1, p/q) in the division-free form; the q_at > 0 guard is
+    # belt-and-braces (the draft sampled the token FROM q, so q_at > 0 in
+    # any real flow) and applies to the ratio test only — greedy acceptance
+    # is pure argmax comparison and never consults q
+    ok_sampled = (us * q_at <= p_at) & (q_at > 0.0)
+    ok_greedy = draft_toks == tgt_argmax[:k]
+    ok = jnp.where(greedy, ok_greedy, ok_sampled) & spec_on
+    acc_prefix = jnp.cumprod(ok.astype(jnp.int32))
+    a = jnp.sum(acc_prefix).astype(jnp.int32)  # 0..k, first rejection stops
+
+    p_a = p_probs[a]
+    q_pad = jnp.concatenate([q_probs, jnp.zeros_like(q_probs[:1])], axis=0)
+    q_a = jnp.where(spec_on, q_pad[a], jnp.zeros_like(p_a))
+    resid = jnp.clip(p_a - q_a, 0.0, None)
+    tot = resid.sum()
+    # numerically-empty residual (p ≈ q): any sample from p_a is correct
+    resid = jnp.where(tot > 0.0, resid / jnp.maximum(tot, 1e-30), p_a)
+    extra_sampled = jax.random.categorical(
+        x_key, jnp.log(jnp.maximum(resid, 1e-30))).astype(jnp.int32)
+    extra = jnp.where(greedy, tgt_argmax[a], extra_sampled).astype(jnp.int32)
+    return a, extra, rng
+
+
+# --------------------------------------------------------------- draft model
+def build_draft(spec_draft: str, target_cfg, target_params,
+                target_vocab: Optional[int] = None):
+    """Resolve ``--spec_draft_config`` into ``(draft_cfg, draft_params)``.
+
+    - ``take:N`` — self-speculative layer truncation (Draft & Verify): the
+      draft is the target's FIRST N transformer blocks with the target's own
+      embedding, final norm and unembedding (shared device buffers — zero
+      extra HBM for those leaves). Same tokenizer/vocab by construction.
+    - anything else — a model path / ``preset:`` spec loaded via the normal
+      model loader; its vocab must match the target's (the acceptance rule
+      compares distributions over one vocabulary).
+    """
+    if spec_draft.startswith("take:"):
+        n = int(spec_draft.split(":", 1)[1])
+        if not 1 <= n <= target_cfg.num_layers:
+            raise ValueError(
+                f"spec draft take:{n} out of range for a "
+                f"{target_cfg.num_layers}-layer target")
+        dcfg = dataclasses.replace(
+            target_cfg, num_layers=n, name=f"{target_cfg.name}-take{n}",
+            paged_kernel=False)
+        layers = {
+            name: {leaf: arr[:n] for leaf, arr in sub.items()}
+            for name, sub in target_params["layers"].items()
+        }
+        dparams = dict(target_params)
+        dparams["layers"] = layers
+        return dcfg, dparams
+    from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
+
+    dcfg, dparams, _ = load_model_and_tokenizer(spec_draft,
+                                                dtype=jnp.bfloat16)
+    want = target_vocab or target_cfg.vocab_size
+    if dcfg.vocab_size != want:
+        raise ValueError(
+            f"spec draft vocab {dcfg.vocab_size} != target vocab {want}; "
+            "speculative verification needs one shared vocabulary")
+    if getattr(dcfg, "paged_kernel", False):
+        dcfg = dataclasses.replace(dcfg, paged_kernel=False)
+    return dcfg, dparams
+
+
+# ---------------------------------------------------------------- controller
+class AdaptiveK:
+    """Host-side acceptance-rate controller: per-slot EMAs gate individual
+    rows out of drafting, the global EMA shrinks ``k`` and (``mode="auto"``)
+    falls back to the plain pending-form decode program entirely — spec must
+    never be slower than the non-spec path it replaces. Disabled state
+    re-probes every ``probe_every`` plain steps so a workload shift can win
+    spec back.
+
+    Thread-safety: observed from the scheduler thread only; read (stats,
+    /metrics) from HTTP threads — the lock keeps the tiny dicts consistent.
+    """
+
+    def __init__(self, k_max: int, mode: str = "auto", floor: float = 0.35,
+                 alpha: float = 0.25, min_obs: int = 4,
+                 probe_every: int = 64):
+        if k_max < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k_max}")
+        self.k_max = int(k_max)
+        self.mode = mode
+        self.floor = float(floor)
+        self.alpha = float(alpha)
+        self.min_obs = int(min_obs)
+        self.probe_every = int(probe_every)
+        self.global_ema: Optional[float] = None
+        self._slot_ema: Dict[int, Tuple[float, int]] = {}
+        self._slot_off: Dict[int, bool] = {}
+        self._plain_streak = 0
+        self.disabled_events = 0
+        self._lock = threading.Lock()
+
+    # ---- scheduler-side
+    def observe(self, rows: List[Tuple[int, int, int]]):
+        """``rows`` = [(slot, accepted, k)] for every row that drafted this
+        step."""
+        with self._lock:
+            for slot, accepted, k in rows:
+                rate = accepted / k if k else 0.0
+                ema, n = self._slot_ema.get(slot, (rate, 0))
+                ema = ema + self.alpha * (rate - ema)
+                self._slot_ema[slot] = (ema, n + 1)
+                if n + 1 >= self.min_obs and ema < self.floor:
+                    if not self._slot_off.get(slot):
+                        self.disabled_events += 1
+                    self._slot_off[slot] = True
+                g = self.global_ema if self.global_ema is not None else rate
+                self.global_ema = g + self.alpha * (rate - g)
+            if rows:
+                self._plain_streak = 0
+
+    def note_plain_step(self):
+        with self._lock:
+            self._plain_streak += 1
+
+    def reset_slot(self, slot: int):
+        """A finished request releases its slot; the next tenant starts with
+        a clean acceptance history (spec re-enabled)."""
+        with self._lock:
+            self._slot_ema.pop(slot, None)
+            self._slot_off.pop(slot, None)
+
+    def force_off_slot(self, slot: int):
+        """Hard per-slot disable (e.g. the draft could not be primed)."""
+        with self._lock:
+            self._slot_off[slot] = True
+            self._slot_ema[slot] = (0.0, self.min_obs)
+
+    # ---- decisions
+    def slot_enabled(self, slot: int) -> bool:
+        with self._lock:
+            return not self._slot_off.get(slot, False)
+
+    def current_k(self) -> int:
+        """Shrink the proposal depth as global acceptance collapses: full k
+        while acceptance holds, half on mediocre acceptance, 1 near the
+        floor. Bounded set of distinct k values = bounded set of compiled
+        verify programs."""
+        with self._lock:
+            return self.current_k_locked()
+
+    def use_spec(self) -> bool:
+        """Whether this tick runs the draft/verify program at all. ``on``
+        pins it; ``auto`` backs off to the plain pending-form program when
+        the global EMA sits under the floor (with periodic probes)."""
+        if self.mode == "on":
+            return True
+        with self._lock:
+            g = self.global_ema
+            streak = self._plain_streak
+        if g is None or g >= self.floor:
+            return True
+        return streak >= self.probe_every  # probe: one spec step, re-measure
+
+    # ---- observability
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "k": self.current_k_locked(),
+                "global_ema": self.global_ema,
+                "slots": {s: round(e, 4)
+                          for s, (e, _) in self._slot_ema.items()},
+                "slots_off": sorted(s for s, off in self._slot_off.items()
+                                    if off),
+                "disabled_events": self.disabled_events,
+            }
+
+    def current_k_locked(self) -> int:
+        g = self.global_ema
+        if g is None or g >= 0.6:
+            return self.k_max
+        if g >= 0.3:
+            return max(1, self.k_max // 2)
+        return 1
+
+
+# ------------------------------------------------------------ device programs
+# Bounded process-wide memo, the engine _Programs pattern: twin engines
+# (bench spec-on/off, parity tests) built from equal (target cfg, draft cfg,
+# max_seq_len, kv_quant) share one set of jitted spec programs — draft and
+# target params, caches and per-slot state all arrive as ARGUMENTS.
+_SPEC_MEMO: "collections.OrderedDict" = collections.OrderedDict()
+_SPEC_MEMO_MAX = 8
+
+
+def spec_programs(tcfg, dcfg, max_seq_len: int, kv_quant) -> "SpecPrograms":
+    try:
+        key = (repr(tcfg), repr(dcfg), int(max_seq_len), kv_quant)
+    except Exception:  # noqa: BLE001 — memoization is best-effort
+        key = None
+    progs = None if key is None else _SPEC_MEMO.get(key)
+    if progs is None:
+        progs = SpecPrograms(tcfg, dcfg, max_seq_len, kv_quant)
+        if key is not None:
+            _SPEC_MEMO[key] = progs
+            while len(_SPEC_MEMO) > _SPEC_MEMO_MAX:
+                _SPEC_MEMO.popitem(last=False)
+    else:
+        _SPEC_MEMO.move_to_end(key)
+    return progs
+
+
+class SpecPrograms:
+    """Jitted programs of the speculative state machine. All slots live in
+    PENDING-TOKEN form while spec is enabled: the last emitted token's KV is
+    not yet written, so a verify forward of ``[pending, d_0..d_{k-1}]``
+    yields target distributions for positions ``pos+1..pos+k+1`` in one shot
+    and the corrected/bonus token becomes the next pending — no second
+    target forward per step.
+
+    Ragged per-row advance: the verify forward writes ``k+1`` tokens for
+    every row and rolls each row's cursor back to ``old + 1 + accepted``.
+    Rejected-lane KV/positions are stale but sit at cursors strictly beyond
+    every live write head, where monotonic rope positions + the causal check
+    mask them until the next contiguous write overwrites them — the same
+    argument that already covers recycled blocks. Paged rows reserve
+    ``spec_k + 1`` tokens of block overshoot at admission
+    (``ops.paged_attention.blocks_for_depth``) so verify writes stay
+    physical; dense rows rely on the scatter's drop-OOB mode exactly like
+    the existing decode program."""
+
+    def __init__(self, tcfg, dcfg, max_seq_len: int, kv_quant):
+        self.tcfg = tcfg
+        self.dcfg = dcfg
+        self.max_seq_len = max_seq_len
+        self.kv_quant = kv_quant
+        self.enter = jax.jit(self._enter_impl)
+        self.prime = jax.jit(self._prime_impl)
+        self.step = jax.jit(self._step_impl, static_argnames=("k", "mode"))
+        self.decode = jax.jit(self._decode_pending_impl,
+                              static_argnames=("K",))
+        self.settle = jax.jit(self._settle_impl)
+
+    # ---- logits-form → pending-form transition (first emitted token)
+    def _enter_impl(self, logits, pending, remaining, active, rng,
+                    temps, top_ps, stops, fresh):
+        """Sample one token from each fresh row's held logits (the same
+        split-then-sample the plain decode step would do), emit it, and make
+        it the row's pending token. Cache and cursor untouched — the token's
+        KV is written by the row's first verify/pending forward."""
+        split = jax.vmap(jax.random.split)(rng)
+        rng2, sub = split[:, 0], split[:, 1]
+        nxt = jax.vmap(_sample_jit)(logits, temps, top_ps, sub)
+        is_stop = jnp.any(nxt[:, None] == stops, axis=1)
+        emit = fresh & active & ~is_stop & (remaining > 0)
+        emitted = jnp.where(emit, nxt, -1)
+        new_active = jnp.where(fresh, emit & (remaining > 1), active)
+        remaining = remaining - emit.astype(jnp.int32)
+        pending = jnp.where(emit, nxt, pending)
+        rng = jnp.where(fresh[:, None], rng2, rng)
+        return emitted, pending, remaining, new_active, rng
+
+    # ---- draft prefill of one slot's context row
+    def _prime_impl(self, dparams, dcache, slot, tokens, mask, positions,
+                    prime_len):
+        """Prefill ``tokens`` (left-pad-bucketed prompt + settled emitted
+        tokens) through the DRAFT into a fresh full-width row, then install
+        it as ``slot``'s row of the per-slot draft cache. Priming feeds only
+        acceptance quality — verification guarantees exactness regardless —
+        so an approximate re-primed context after import is correct by
+        construction."""
+        W = dcache["k"].shape[2]
+        row = init_cache(self.dcfg, 1, W, dtype=jnp.bfloat16)
+        _, row = forward(
+            dparams, tokens, self.dcfg, positions=positions,
+            attention_mask=mask, cache=row, compute_dtype=jnp.bfloat16,
+        )
+        out = dict(dcache)
+        out["k"] = jax.lax.dynamic_update_slice(
+            dcache["k"], row["k"], (0, slot, 0, 0, 0))
+        out["v"] = jax.lax.dynamic_update_slice(
+            dcache["v"], row["v"], (0, slot, 0, 0, 0))
+        out["pos"] = jax.lax.dynamic_update_slice(
+            dcache["pos"], row["pos"], (slot, 0))
+        out["len"] = dcache["len"].at[slot].set(prime_len)
+        return out
+
+    # ---- the speculative super-step: propose k, verify once, accept
+    def _step_impl(self, tparams, dparams, lora, tcache, dcache,
+                   pending, pos, remaining, active, rng, temps, top_ps,
+                   stops, adapter_idx, spec_on, *, k: int,
+                   mode: str = "topp"):
+        """``mode`` is a STATIC batch property the engine derives from its
+        live requests each tick (bounded set of compiled variants):
+
+        - ``"greedy"`` — every drafting row has ``temperature <= 0``:
+          acceptance is pure argmax comparison, so no distribution (and no
+          full-vocab sort) is ever materialized;
+        - ``"simple"`` — sampled rows exist but none filters
+          (``top_p >= 1``): distributions are plain softmax;
+        - ``"topp"`` — the fully general sorted top-p path.
+        Each is exact for the batches it is selected for; greedy rows
+        inside a sampled batch still resolve exactly via the traced
+        ``temperature <= 0`` selects."""
+        S = pending.shape[0]
+        participate = active
+        drow = participate & spec_on
+
+        # draft propose: k+1 single-token forwards in one scan. Iteration i
+        # feeds the previous token (pending at i=0) at rope position pos+i
+        # and samples proposal d_i from the draft's distribution q_i. The
+        # (k+1)-th iteration's sample is discarded — it runs only to write
+        # d_{k-1}'s KV so a fully-accepted row's draft cache stays complete.
+        d_len0 = dcache["len"]
+
+        def dstep(carry, i):
+            cur, dc, r = carry
+            dlogits, dc = forward(
+                dparams, cur[:, None], self.dcfg,
+                positions=(pos + i)[:, None],
+                attention_mask=drow[:, None].astype(jnp.int32),
+                cache=dc, compute_dtype=jnp.bfloat16,
+            )
+            last = dlogits[:, -1]
+            if mode == "greedy":
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                q = jnp.zeros((S, 1), jnp.float32)  # placeholder, unused
+            else:
+                split = jax.vmap(jax.random.split)(r)
+                r, sub = split[:, 0], split[:, 1]
+                nxt = jax.vmap(_sample_jit)(last, temps, top_ps, sub)
+                q = jax.vmap(
+                    lambda lg, t, tp: sampling_probs(
+                        lg, t, tp, exact_topp=(mode == "topp"))
+                )(last, temps, top_ps)
+            return (nxt, dc, r), (nxt, q)
+
+        (_, dcache, rng), (d_all, q_all) = jax.lax.scan(
+            dstep, (pending, dcache, rng),
+            jnp.arange(k + 1, dtype=jnp.int32))
+        d_toks = jnp.transpose(d_all[:k])              # [S, k]
+
+        # verify: ONE target forward over [pending, d_0..d_{k-1}] — the
+        # chunked-prefill/extend machinery's multi-token path, so the paged
+        # cache, pooled LoRA adapters and int8 kv_quant all keep working.
+        # Rows not drafting mask out the proposal columns and take a plain
+        # single-token step on column 0.
+        t_len0 = tcache["len"]
+        vtoks = jnp.concatenate([pending[:, None], d_toks], axis=1)
+        vpos = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        vmask = jnp.concatenate(
+            [participate[:, None],
+             jnp.broadcast_to(drow[:, None], (S, k))], axis=1)
+        vlogits, tcache = forward(
+            tparams, vtoks, self.tcfg, positions=vpos,
+            attention_mask=vmask.astype(jnp.int32), cache=tcache, lora=lora,
+            lora_adapter_idx=(adapter_idx if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
+        )
+        if mode == "greedy":
+            # acceptance without distributions: a proposal survives iff it
+            # IS the target argmax at its position, and the corrected/bonus
+            # token is the argmax at the first divergence — token-identical
+            # to sequential greedy decode by construction
+            tgt_argmax = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            ok = (d_toks == tgt_argmax[:, :k]) & drow[:, None]
+            acc_prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+            a = jnp.sum(acc_prefix, axis=1).astype(jnp.int32)
+            extra = jnp.take_along_axis(
+                tgt_argmax, a[:, None], axis=1)[:, 0]
+        else:
+            q_dists = jnp.transpose(q_all[:k], (1, 0, 2))  # [S, k, V]
+            p_dists = jax.vmap(
+                lambda row_logits, t, tp: jax.vmap(
+                    lambda lg: sampling_probs(
+                        lg, t, tp, exact_topp=(mode == "topp")))(row_logits)
+            )(vlogits, temps, top_ps)  # [S, k+1, V]
+            a, extra, rng = jax.vmap(accept_tokens)(
+                p_dists, q_dists, d_toks, temps, rng, drow)
+        a = jnp.where(participate, a, 0)
+
+        # emission: accepted prefix + corrected/bonus token, truncated by
+        # the row's stop set and token budget exactly as the sequential
+        # decode loop would have (a stop token is never emitted; the budget
+        # bounds emitted count; either truncation deactivates the row)
+        idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        d_ext = jnp.concatenate(
+            [d_toks, jnp.full((S, 1), -1, jnp.int32)], axis=1)
+        cand = jnp.where(idx < a[:, None], d_ext,
+                         jnp.where(idx == a[:, None], extra[:, None], -1))
+        is_stop = jnp.any(cand[:, :, None] == stops[:, None, :], axis=2) \
+            & (cand >= 0)
+        navail = a + 1
+        stop_idx = jnp.min(jnp.where(is_stop, idx, k + 2), axis=1)
+        n_emit = jnp.minimum(jnp.minimum(navail, stop_idx), remaining)
+        n_emit = jnp.where(participate, n_emit, 0)
+        emitted = jnp.where(idx < n_emit[:, None], cand, -1)
+        new_remaining = remaining - n_emit
+        new_active = participate & (n_emit == navail) & (new_remaining > 0)
+        pending = jnp.where(new_active, extra, pending)
+
+        # ragged advance: each row's cursor moves by 1 + accepted (the old
+        # pending plus the kept proposals); rejected-lane writes beyond the
+        # new cursor are dead — masked by causal position until overwritten
+        adv = jnp.where(participate, 1 + a, 0)
+        pos = pos + adv
+        tcache = dict(tcache)
+        tcache["len"] = t_len0 + adv
+        dcache = dict(dcache)
+        dcache["len"] = d_len0 + jnp.where(drow, adv, 0)
+        return (emitted, a, tcache, dcache, pending, pos, new_remaining,
+                new_active, rng)
+
+    # ---- plain decode in pending form (the never-slower fallback)
+    def _decode_pending_impl(self, tparams, lora, tcache, pending, pos,
+                             remaining, active, rng, temps, top_ps, stops,
+                             adapter_idx, *, K: int):
+        """K-token chunked decode over pending-form slots: forward the
+        pending token, sample its successor from the resulting logits, make
+        that the new pending. Per-token cost identical to the non-spec
+        ``_decode_impl`` (one forward + one sample), so the adaptive
+        controller's fallback never costs more than spec-off decode."""
+        def step(carry, _):
+            pending, tcache, pos, remaining, active, rng = carry
+            prev_len = tcache["len"]
+            logits, tcache = forward(
+                tparams, pending[:, None], self.tcfg,
+                positions=pos[:, None],
+                attention_mask=active[:, None].astype(jnp.int32),
+                cache=tcache, lora=lora,
+                lora_adapter_idx=(adapter_idx if lora is not None else None),
+                compute_dtype=jnp.bfloat16,
+            )
+            tcache = dict(tcache)
+            tcache["len"] = prev_len + active.astype(jnp.int32)
+            pos = pos + active.astype(jnp.int32)
+            split = jax.vmap(jax.random.split)(rng)
+            rng, sub = split[:, 0], split[:, 1]
+            nxt = jax.vmap(_sample_jit)(logits[:, -1], temps, top_ps, sub)
+            is_stop = jnp.any(nxt[:, None] == stops, axis=1)
+            emit = active & ~is_stop & (remaining > 0)
+            emitted = jnp.where(emit, nxt, -1)
+            new_active = emit & (remaining > 1)
+            remaining = remaining - emit.astype(jnp.int32)
+            pending = jnp.where(emit, nxt, pending)
+            return (pending, tcache, pos, remaining, new_active, rng), emitted
+
+        (pending, tcache, pos, remaining, active, rng), emitted = \
+            jax.lax.scan(step, (pending, tcache, pos, remaining, active, rng),
+                         None, length=K)
+        return emitted, tcache, pending, pos, remaining, active, rng
+
+    # ---- pending-form → logits-form (export/migration)
+    def _settle_impl(self, tparams, lora, tcache, pending, pos, adapter_idx,
+                     onehot):
+        """Write ONE slot's pending token through the target (mask one-hot;
+        every other row's cursor restored) and return the resulting
+        next-token logits — the slot is then in the standard logits-form
+        state the KV-migration wire format expects."""
+        prev_len = tcache["len"]
+        logits, tcache = forward(
+            tparams, pending[:, None], self.tcfg, positions=pos[:, None],
+            attention_mask=onehot[:, None].astype(jnp.int32), cache=tcache,
+            lora=lora,
+            lora_adapter_idx=(adapter_idx if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
+        )
+        tcache = dict(tcache)
+        tcache["len"] = prev_len + onehot.astype(jnp.int32)
+        pos = pos + onehot.astype(jnp.int32)
+        return logits[:, -1], tcache, pos

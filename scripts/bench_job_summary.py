@@ -83,6 +83,34 @@ def main() -> int:
                         f"evictions={ad.get('evictions')} · "
                         f"load p50={ad.get('load_ms_p50')}ms "
                         f"p95={ad.get('load_ms_p95')}ms")
+        # speculative-decoding twin bench: acceptance + the TPOT delta vs
+        # the spec-off twin are the headline; the adversarial sub-run's
+        # controller verdict proves the never-slower contract
+        sp = last.get("spec")
+        if isinstance(sp, dict):
+            al = sp.get("aligned") or {}
+            adv = sp.get("adversarial") or {}
+            on_s, off_s = al.get("on") or {}, al.get("off") or {}
+            row += ("\n  - spec (aligned): "
+                    f"accept={al.get('accept_rate')} "
+                    f"mean_len={al.get('mean_accept_len')}/{sp.get('k')} · "
+                    f"tpot p50 {on_s.get('tpot_ms_p50')}ms vs "
+                    f"{off_s.get('tpot_ms_p50')}ms off "
+                    f"(ratio {al.get('tpot_p50_ratio')}) · "
+                    f"{on_s.get('tokens_per_sec')} vs "
+                    f"{off_s.get('tokens_per_sec')} tok/s")
+            if al.get("parity_checked"):
+                row += " · spec-vs-off parity: checked"
+            adv_on = (adv.get("on") or {})
+            adv_off = (adv.get("off") or {})
+            row += ("\n  - spec (adversarial): "
+                    f"accept={adv.get('accept_rate')} · controller "
+                    + ("**disabled spec** " if adv.get("controller_disabled")
+                       else "STILL ACTIVE ")
+                    + f"(spec_steps={adv.get('spec_steps')} "
+                      f"plain_steps={adv.get('plain_steps')}) · "
+                      f"tpot p50 {adv_on.get('tpot_ms_p50')}ms vs "
+                      f"{adv_off.get('tpot_ms_p50')}ms off")
         # load-replay mode: the SLO verdict IS the headline — a chaos run
         # whose objectives held, or the violated objectives by name
         rp = last.get("replay")

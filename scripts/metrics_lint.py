@@ -104,6 +104,18 @@ class _StatsEngine:
                 "registered_adapters": ["tenant-a", "tenant-b"],
                 "load_ms": [12.5], "requests": dict(self.adapter_requests)}
 
+    def spec_info(self):
+        # speculative-decoding document: builds every dtx_serving_spec_*
+        # series (incl. the per-adapter/per-slot EMA gauges) AND feeds the
+        # gateway's per-replica acceptance gauge through replica stats
+        return {"enabled": True, "mode": "auto", "draft": "take:2",
+                "k_max": 4, "k": 2, "accept_rate": 0.62,
+                "adapter_accept_rate": {"": 0.7, "tenant-a": 0.5},
+                "slot_accept_rate": {0: 0.62}, "slots_off": [],
+                "active": True, "disabled_events": 1,
+                "proposed": 40, "accepted": 25, "row_steps": 10,
+                "spec_steps": 10, "plain_steps": 3}
+
     def chat(self, messages, **kw):
         return "ok"
 

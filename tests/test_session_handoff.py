@@ -108,6 +108,65 @@ def test_export_import_sampled_parity(paged_pair):
         assert handle.tokens == want, (seed, handle.tokens, want)
 
 
+def test_export_import_spec_active_session(paged_pair):
+    """A SPEC-ACTIVE session (pending-token form, draft cache live) exports
+    cleanly: the engine settles the pending token so the payload is the
+    standard logits-form wire format, the importer re-primes its own draft
+    cache from the payload's prompt + tail, and the greedy continuation is
+    token-exact vs an undisturbed non-spec run — both into a spec engine
+    and into a plain engine (the wire carries no spec state at all)."""
+    ref, plain_dst = paged_pair
+    src = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16,
+                        spec_draft="take:2", spec_k=3, spec_mode="on")
+    dst = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16,
+                        spec_draft="take:2", spec_k=3, spec_mode="on")
+    try:
+        prompt = src.tokenizer.encode("speculative sessions migrate too")
+        want = ref.generate(prompt, max_new_tokens=24)
+
+        def export_mid_spec(target_dst):
+            # throttle the SPEC tick (the spec engine never runs _decode)
+            orig = src._spec_decode_tick
+
+            def slow(*a, **k):
+                time.sleep(0.04)
+                return orig(*a, **k)
+
+            src._spec_decode_tick = slow
+            try:
+                req = src.submit(prompt, max_new_tokens=24)
+                deadline = time.monotonic() + 30
+                while len(req.tokens) < 3 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert len(req.tokens) >= 3
+                doc = src.export_sessions()
+            finally:
+                src._spec_decode_tick = orig
+            assert len(doc["sessions"]) == 1, doc
+            assert req.done.wait(10)
+            # the settle wrote the pending token: payload cursor covers
+            # every emitted token and carries next-token logits
+            payload = doc["sessions"][0]
+            assert any(ev[0] == "spec_settle" for ev in src.sched_trace)
+            handle, _ = _import_and_wait(target_dst, payload)
+            return handle
+
+        handle = export_mid_spec(dst)
+        assert handle.tokens == want, (handle.tokens, want)
+        # the spec importer RE-PRIMED its draft (re-prime contract: no
+        # draft KV on the wire) and kept speculating after the import
+        assert any(ev[0] == "spec_prime" for ev in dst.sched_trace)
+        assert (dst.spec_info() or {}).get("proposed", 0) > 0
+
+        handle2 = export_mid_spec(plain_dst)  # spec → non-spec replica
+        assert handle2.tokens == want, (handle2.tokens, want)
+    finally:
+        src.close()
+        dst.close()
+
+
 def test_export_import_int8_kv_parity():
     """int8 kv_quant engines ship their cache's own int8+scale bytes —
     the 'int8 over the wire' path is EXACT for them, greedy and sampled."""

@@ -1,0 +1,424 @@
+"""Speculative decoding (serving/speculative.py + BatchedEngine spec tick).
+
+The correctness bar has two layers:
+
+- the ACCEPTANCE MATH: greedy acceptance reproduces sequential argmax decode
+  token-for-token by construction, and the sampled rejection/residual scheme
+  emits tokens whose marginal distribution is EXACTLY the target's (the
+  Leviathan/Chen guarantee) — verified analytically against empirical
+  frequencies over many PRNG keys;
+- the ENGINE: spec-on greedy output is token-identical to spec-off across
+  dense + paged caches, concurrent ragged batches, stop tokens, pooled
+  mixed-rank adapters, and the adaptive-k controller's shrink/disable paths —
+  while ``--spec_mode off`` leaves the engine byte-identical to before.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from datatunerx_tpu.ops.paged_attention import blocks_for_depth
+from datatunerx_tpu.serving.batched_engine import BatchedEngine
+from datatunerx_tpu.serving.speculative import (
+    AdaptiveK,
+    accept_tokens,
+    build_draft,
+    sampling_probs,
+)
+
+MODEL = "preset:debug"
+
+
+# ------------------------------------------------------------ fixtures
+
+@pytest.fixture(scope="module")
+def dense_pair():
+    """Spec-off / spec-on twins over a dense per-slot cache. The draft is
+    take:2 — ALL of the 2-layer debug model, i.e. a perfect draft — so the
+    all-accept path is exercised."""
+    off = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=3, decode_chunk=4)
+    on = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                       slots=3, decode_chunk=4,
+                       spec_draft="take:2", spec_k=3, spec_mode="on")
+    yield off, on
+    off.close()
+    on.close()
+
+
+@pytest.fixture(scope="module")
+def paged_pair():
+    """Paged twins with a WEAK draft (take:1 of a random 2-layer model —
+    near-zero acceptance), so rejection, residual correction and ragged
+    per-row advance over block tables all run for real."""
+    off = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=3, decode_chunk=4, kv_block_size=16)
+    on = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                       slots=3, decode_chunk=4, kv_block_size=16,
+                       spec_draft="take:1", spec_k=3, spec_mode="on")
+    yield off, on
+    off.close()
+    on.close()
+
+
+# ------------------------------------------------- acceptance-rule units
+
+def test_sampling_probs_matches_sample_jit_semantics():
+    logits = jnp.asarray([2.0, 1.0, 0.5, -1.0])
+    # greedy: one-hot argmax
+    p = sampling_probs(logits, 0.0, 1.0)
+    np.testing.assert_array_equal(np.asarray(p), [1.0, 0.0, 0.0, 0.0])
+    # top_p = 1: plain softmax of logits/t, fast path == exact path
+    t = 0.7
+    exact = np.asarray(sampling_probs(logits, t, 1.0))
+    fast = np.asarray(sampling_probs(logits, t, 1.0, exact_topp=False))
+    want = np.asarray(jax.nn.softmax(logits / t))
+    np.testing.assert_allclose(exact, want, rtol=1e-5)
+    np.testing.assert_allclose(fast, want, rtol=1e-5)
+    # top_p < 1: the tail is cut and the kept mass renormalized. softmax
+    # here is [.609, .224, .136, .030]: the nucleus rule keeps a token
+    # while the mass BEFORE it is <= top_p, so 0.7 keeps exactly two.
+    p = np.asarray(sampling_probs(logits, 1.0, 0.7))
+    soft = np.asarray(jax.nn.softmax(logits))
+    assert p[3] == 0.0 and p[2] == 0.0  # tail outside the 0.7 nucleus
+    np.testing.assert_allclose(p[:2], soft[:2] / soft[:2].sum(), rtol=1e-5)
+    assert abs(p.sum() - 1.0) < 1e-5
+
+
+def test_accept_greedy_is_argmax_comparison():
+    V, k = 6, 3
+    p = np.zeros((k + 1, V), np.float32)
+    p[0, 2] = p[1, 4] = p[2, 1] = p[3, 5] = 1.0  # target argmax: 2,4,1,5
+    q = np.zeros((k, V), np.float32)
+    q[:, 0] = 1.0
+    rng = jax.random.PRNGKey(0)
+    # drafts agree at 0 and 1, diverge at 2 → accept 2, correct to argmax
+    a, extra, _ = accept_tokens(jnp.asarray(p), jnp.asarray(q),
+                                jnp.asarray([2, 4, 0]), 0.0, rng, True)
+    assert int(a) == 2 and int(extra) == 1
+    # full agreement → accept all, bonus = argmax of the k-th dist
+    a, extra, _ = accept_tokens(jnp.asarray(p), jnp.asarray(q),
+                                jnp.asarray([2, 4, 1]), 0.0, rng, True)
+    assert int(a) == 3 and int(extra) == 5
+    # immediate divergence → accept none, correct to argmax of p_0
+    a, extra, _ = accept_tokens(jnp.asarray(p), jnp.asarray(q),
+                                jnp.asarray([0, 0, 0]), 0.0, rng, True)
+    assert int(a) == 0 and int(extra) == 2
+    # spec_on=False: forced plain step regardless of agreement
+    a, extra, _ = accept_tokens(jnp.asarray(p), jnp.asarray(q),
+                                jnp.asarray([2, 4, 1]), 0.0, rng, False)
+    assert int(a) == 0 and int(extra) == 2
+
+
+def test_accept_all_accept_and_all_reject_edges():
+    V, k = 4, 2
+    rng = jax.random.PRNGKey(1)
+    # q == p → ratio 1 → every proposal accepted (sampled mode)
+    p = np.asarray([[0.4, 0.3, 0.2, 0.1]] * (k + 1), np.float32)
+    q = p[:k]
+    for seed in range(8):
+        a, _, _ = accept_tokens(
+            jnp.asarray(p), jnp.asarray(q), jnp.asarray([0, 1]),
+            1.0, jax.random.PRNGKey(seed), True)
+        assert int(a) == k
+    # draft proposes a token with ZERO target mass → always rejected,
+    # and the residual (= p with q's mass removed) never re-emits it
+    p0 = np.asarray([[0.0, 0.5, 0.5, 0.0]] * (k + 1), np.float32)
+    q0 = np.zeros((k, V), np.float32)
+    q0[:, 0] = 1.0
+    for seed in range(16):
+        a, extra, _ = accept_tokens(
+            jnp.asarray(p0), jnp.asarray(q0), jnp.asarray([0, 0]),
+            1.0, jax.random.PRNGKey(seed), True)
+        assert int(a) == 0 and int(extra) in (1, 2)
+    del rng
+
+
+def test_residual_scheme_is_distribution_exact():
+    """The Leviathan guarantee, checked empirically: with draft dist q and
+    target dist p over a tiny vocab, the emitted FIRST token's frequency
+    over many keys matches p — even though q is badly mismatched."""
+    V, k = 4, 1
+    p = np.asarray([0.5, 0.25, 0.15, 0.1], np.float32)
+    q = np.asarray([0.05, 0.05, 0.45, 0.45], np.float32)
+    p_full = jnp.asarray(np.stack([p] * (k + 1)))
+    q_full = jnp.asarray(q[None, :])
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    # the draft samples d_0 ~ q with its own keys; acceptance consumes the
+    # slot key — exactly the program's split discipline
+    dkeys = jax.random.split(jax.random.PRNGKey(7), n)
+    d0 = jax.vmap(
+        lambda kk: jax.random.categorical(kk, jnp.log(q_full[0])))(dkeys)
+
+    def one(key, d):
+        a, extra, _ = accept_tokens(p_full, q_full, d[None], 1.0, key, True)
+        return jnp.where(a > 0, d, extra)
+
+    toks = np.asarray(jax.jit(jax.vmap(one))(keys, d0.astype(jnp.int32)))
+    freq = np.bincount(toks, minlength=V) / n
+    # 4000 samples: generous 4-sigma-ish tolerance, deterministic seeds
+    np.testing.assert_allclose(freq, p, atol=0.04)
+
+
+def test_blocks_for_depth_reserve_math():
+    assert blocks_for_depth(32, 16) == 2
+    assert blocks_for_depth(33, 16) == 3
+    # spec overshoot rides on top…
+    assert blocks_for_depth(32, 16, overshoot=5) == 3
+    # …but never past the table width (cap = max_seq_len)
+    assert blocks_for_depth(250, 16, overshoot=16, cap_depth=256) == 16
+    assert blocks_for_depth(256, 16, overshoot=5, cap_depth=256) == 16
+
+
+# ------------------------------------------------------ controller units
+
+def test_adaptive_k_shrinks_and_disables():
+    ctrl = AdaptiveK(k_max=4, mode="auto", floor=0.35, min_obs=2,
+                     probe_every=3)
+    assert ctrl.current_k() == 4 and ctrl.use_spec()
+    # collapse acceptance on slot 0 → slot disabled, k shrinks, auto mode
+    # stands down globally
+    for _ in range(6):
+        ctrl.observe([(0, 0, 4)])
+    assert not ctrl.slot_enabled(0)
+    assert ctrl.current_k() == 1
+    assert not ctrl.use_spec()
+    assert ctrl.disabled_events >= 1
+    # plain fallback probes periodically so spec can win back
+    for _ in range(3):
+        ctrl.note_plain_step()
+    assert ctrl.use_spec()  # the probe step
+    # healthy acceptance restores full k; a released slot starts clean
+    ctrl.reset_slot(0)
+    assert ctrl.slot_enabled(0)
+    for _ in range(30):
+        ctrl.observe([(1, 4, 4)])
+    assert ctrl.current_k() == 4 and ctrl.use_spec()
+    # mode=on never stands down globally (per-slot gating still applies)
+    pinned = AdaptiveK(k_max=2, mode="on", floor=0.5, min_obs=1)
+    pinned.observe([(0, 0, 2)] * 8)
+    assert pinned.use_spec()
+
+
+def test_build_draft_take_and_validation():
+    cfg, params, _ = __import__(
+        "datatunerx_tpu.utils.model_loader",
+        fromlist=["load_model_and_tokenizer"],
+    ).load_model_and_tokenizer(MODEL)
+    dcfg, dparams = build_draft("take:1", cfg, params)
+    assert dcfg.num_layers == 1
+    # early layers + embedding/unembedding are the target's own arrays
+    assert dparams["embed_tokens"]["embedding"] is \
+        params["embed_tokens"]["embedding"]
+    np.testing.assert_array_equal(
+        np.asarray(dparams["layers"]["q_proj"]["kernel"][0]),
+        np.asarray(params["layers"]["q_proj"]["kernel"][0]))
+    with pytest.raises(ValueError, match="out of range"):
+        build_draft("take:9", cfg, params)
+    # vocab mismatch is refused (acceptance compares one vocabulary)
+    with pytest.raises(ValueError, match="vocab"):
+        build_draft("preset:tinyllama-1.1b", cfg, params)
+
+
+# -------------------------------------------------- engine-level parity
+
+def test_spec_greedy_token_exact_dense_all_accept(dense_pair):
+    off, on = dense_pair
+    tok = off.tokenizer
+    for text in ("the quick brown fox", "a completely different prompt"):
+        ids = tok.encode(text)
+        want = off.generate(ids, max_new_tokens=16)
+        got = on.generate(ids, max_new_tokens=16)
+        assert got == want, (text, got, want)
+    info = on.spec_info()
+    assert info["enabled"] and info["proposed"] > 0
+    # a perfect (full self) draft must accept everything
+    assert info["accept_rate"] == 1.0
+
+
+def test_spec_greedy_token_exact_paged_rejections(paged_pair):
+    off, on = paged_pair
+    tok = off.tokenizer
+    for text in ("hello world this is serving", "short"):
+        ids = tok.encode(text)
+        want = off.generate(ids, max_new_tokens=16)
+        got = on.generate(ids, max_new_tokens=16)
+        assert got == want, (text, got, want)
+    info = on.spec_info()
+    # the weak draft must have been REJECTED sometimes — the correction
+    # path ran, and output still matched exactly
+    assert info["accepted"] < info["proposed"]
+
+
+def test_spec_concurrent_ragged_advance_paged(paged_pair):
+    """Concurrent requests of different lengths advance raggedly inside one
+    verify program (per-row accepted lengths differ); every stream must
+    match its spec-off twin and every block must return to the free list."""
+    off, on = paged_pair
+    tok = off.tokenizer
+    free0 = on.free_kv_blocks
+    prompts = [tok.encode("first request about weather"),
+               tok.encode("second one"),
+               tok.encode("third request that is somewhat longer than both")]
+    want = [off.submit(p, max_new_tokens=8 + 4 * i)
+            for i, p in enumerate(prompts)]
+    got = [on.submit(p, max_new_tokens=8 + 4 * i)
+           for i, p in enumerate(prompts)]
+    for w, g in zip(want, got):
+        assert w.done.wait(120) and g.done.wait(120)
+        assert g.tokens == w.tokens, (g.tokens, w.tokens)
+    deadline = time.monotonic() + 10
+    while on.free_kv_blocks != free0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert on.free_kv_blocks == free0  # ragged release leaked nothing
+
+
+def test_spec_stop_token_truncates_identically(paged_pair):
+    off, on = paged_pair
+    tok = off.tokenizer
+    ids = tok.encode("the quick brown fox")
+    base = off.generate(ids, max_new_tokens=12)
+    stop = {base[4]}  # a token greedy decode WILL emit mid-stream
+    want = off.generate(ids, max_new_tokens=12, stop_ids=stop)
+    got = on.generate(ids, max_new_tokens=12, stop_ids=stop)
+    assert want == base[:4]  # sanity: the stop actually truncated
+    assert got == want
+
+
+def test_spec_sampled_runs_and_respects_budget(paged_pair):
+    """Sampled spec decode is distribution-exact (proved at the math layer);
+    at the engine layer it must run the topp/simple program variants,
+    respect max_new_tokens, and differ per seed like any sampler."""
+    _, on = paged_pair
+    tok = on.tokenizer
+    ids = tok.encode("sampling prompt")
+    outs = {tuple(on.generate(ids, max_new_tokens=10, temperature=0.9,
+                              top_p=0.8, seed=s)) for s in range(3)}
+    assert all(len(o) <= 10 for o in outs)
+    assert len(outs) > 1  # different seeds explore
+    simple = on.generate(ids, max_new_tokens=10, temperature=0.9, seed=0)
+    assert len(simple) <= 10
+
+
+def test_spec_mixed_rank_pooled_adapters_in_verify_batch(tmp_path):
+    """Pooled LoRA adapters stay program ARGUMENTS through the verify
+    forward: mixed-rank adapters decoding concurrently under spec match
+    their spec-off twin token-for-token."""
+    from datatunerx_tpu.serving.adapters import make_adapter_sweep
+
+    ckpts = make_adapter_sweep(str(tmp_path), MODEL, 2)  # ranks differ
+    kw = dict(template="vanilla", max_seq_len=256, slots=3, decode_chunk=4,
+              kv_block_size=16, adapter_pool=2, adapter_rank_max=16)
+    off = BatchedEngine(MODEL, adapters=ckpts, **kw)
+    on = BatchedEngine(MODEL, adapters=ckpts, spec_draft="take:2",
+                       spec_k=3, spec_mode="on", **kw)
+    try:
+        tok = off.tokenizer
+        names = ["", *sorted(ckpts)]
+        prompts = [tok.encode(f"adapter request {i}") for i in range(3)]
+        want = [off.submit(p, max_new_tokens=10, adapter=a)
+                for p, a in zip(prompts, names)]
+        got = [on.submit(p, max_new_tokens=10, adapter=a)
+               for p, a in zip(prompts, names)]
+        for w, g in zip(want, got):
+            assert w.done.wait(180) and g.done.wait(180)
+            assert g.tokens == w.tokens, (g.tokens, w.tokens)
+        info = on.spec_info()
+        assert set(info["adapter_accept_rate"]) >= set(names)
+    finally:
+        off.close()
+        on.close()
+
+
+def test_spec_mode_off_is_byte_identical(paged_pair):
+    """--spec_mode off must leave the engine exactly as before: no spec
+    structures, no draft load, the pre-spec decode program path."""
+    off, _ = paged_pair
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=3, decode_chunk=4, kv_block_size=16,
+                        spec_draft="take:1", spec_mode="off")
+    try:
+        assert eng.spec is None and eng._spec_overshoot == 0
+        ids = eng.tokenizer.encode("off mode prompt")
+        assert eng.generate(ids, max_new_tokens=8) == \
+            off.generate(ids, max_new_tokens=8)
+    finally:
+        eng.close()
+    with pytest.raises(ValueError, match="spec_draft_config"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                      spec_mode="on")
+
+
+def test_spec_adaptive_auto_falls_back_and_stays_exact():
+    """spec_mode=auto with a hopeless draft: the controller must stand down
+    to the plain pending-form program (never-slower contract) and output
+    must STILL be token-exact — the fallback is the same decode math."""
+    off = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16)
+    on = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                       slots=2, decode_chunk=4, kv_block_size=16,
+                       spec_draft="take:1", spec_k=4, spec_mode="auto")
+    try:
+        ids = off.tokenizer.encode("adversarial workload prompt")
+        want = off.generate(ids, max_new_tokens=48)
+        got = on.generate(ids, max_new_tokens=48)
+        assert got == want
+        info = on.spec_info()
+        assert info["plain_steps"] > 0, info  # the fallback actually ran
+        assert info["k"] <= 2  # collapsed acceptance shrank k
+    finally:
+        off.close()
+        on.close()
+
+
+def test_spec_metrics_and_replica_stats(paged_pair):
+    _, on = paged_pair
+    from datatunerx_tpu.gateway.replica_pool import InProcessReplica
+
+    st = InProcessReplica("r0", on).stats()
+    assert st["spec_enabled"] is True
+    assert st["spec_accept_rate"] is not None
+    info = on.spec_info()
+    for key in ("proposed", "accepted", "spec_steps", "plain_steps", "k",
+                "mode", "draft"):
+        assert key in info
+
+
+def test_router_prefers_spec_replicas():
+    """Greedy (spec-friendly) traffic narrows to spec-enabled replicas with
+    healthy acceptance; sampled traffic and spec-less fleets are untouched."""
+    from datatunerx_tpu.gateway.replica_pool import Replica, ReplicaPool
+    from datatunerx_tpu.gateway.router import Router
+
+    class FakeReplica(Replica):
+        def __init__(self, name, spec_enabled, rate):
+            super().__init__(name)
+            self._st = {"slots_busy": 0, "slots_total": 4,
+                        "kv_blocks_free": 64, "kv_blocks_total": 64,
+                        "adapters": None, "resident_adapters": None,
+                        "spec_enabled": spec_enabled,
+                        "spec_accept_rate": rate}
+
+        def probe_health(self):
+            return True
+
+        def stats(self):
+            return self._st
+
+    specful = FakeReplica("spec", True, 0.9)
+    specless = FakeReplica("plain", False, None)
+    collapsed = FakeReplica("collapsed", True, 0.05)
+    pool = ReplicaPool([specful, specless, collapsed])
+    for r in (specful, specless, collapsed):
+        r.healthy = True
+    router = Router(pool, policy="round_robin")
+    picks = {router.route(prefer_spec=True).name for _ in range(6)}
+    assert picks == {"spec"}  # healthy-acceptance spec replica wins
+    picks = {router.route(prefer_spec=False).name for _ in range(6)}
+    assert picks == {"spec", "plain", "collapsed"}  # non-spec-friendly: all
+    assert router.spec_routes["preferred"] > 0

@@ -198,3 +198,96 @@ def test_thread_safe_encoding_falls_back_when_not_clonable(tmp_path, tok):
     )
     assert it.ensure_thread_safe_encoding() is False
     assert it._thread_tokenizer() is bad  # unchanged: shared original
+
+
+# ---------------------------------------------------------------- read-ahead
+
+class _JitteryDataset(StreamingCsvDataset):
+    """A record stream whose per-record latency jumps around — the shape of
+    a gs:// line iterator under network jitter."""
+
+    def __init__(self, path, sleep_scale=0.002, seed=7):
+        super().__init__(path)
+        self._sleep_scale = sleep_scale
+        self._seed = seed
+
+    def __iter__(self):
+        import random
+        import time
+
+        rnd = random.Random(self._seed)
+        for rec in super().__iter__():
+            time.sleep(rnd.random() * self._sleep_scale)
+            yield rec
+
+
+def test_read_ahead_iterator_preserves_order_and_errors():
+    from datatunerx_tpu.data.prefetch import ReadAheadIterator
+
+    got = list(ReadAheadIterator(iter(range(100)), depth=4))
+    assert got == list(range(100))
+
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("remote read died")
+
+    it = ReadAheadIterator(boom(), depth=2)
+    assert next(it) == 1 and next(it) == 2
+    with pytest.raises(RuntimeError, match="remote read died"):
+        next(it)
+
+
+def test_read_ahead_matches_sync_under_jitter(tmp_path, tok):
+    """The read-ahead path must be a pure latency optimization: batches are
+    byte-identical to the synchronous path even when the raw reader's
+    latency jitters (record order is preserved by the FIFO handoff)."""
+    p = _write_jsonl(tmp_path / "d.jsonl", 41)
+    tpl = get_template("vanilla", tok)
+
+    def run(read_ahead):
+        it = StreamingBatchIterator(
+            _JitteryDataset(p), tpl, tok,
+            global_batch=8, block_size=64, pad_id=0, buffer_size=16, seed=5,
+            read_ahead=read_ahead,
+        )
+        return list(it.epoch(0))
+
+    sync_batches = run(0)
+    ra_batches = run(8)
+    assert len(sync_batches) == len(ra_batches) == 41 // 8
+    for a, b in zip(sync_batches, ra_batches):
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+        np.testing.assert_array_equal(a["labels"], b["labels"])
+
+
+def test_read_ahead_early_exit_stops_reader(tmp_path, tok):
+    """Abandoning an epoch mid-stream (max_steps) must stop the reader
+    thread promptly — a blocked put on the bounded queue would otherwise
+    leak one thread per abandoned epoch."""
+    import threading
+
+    p = _write_jsonl(tmp_path / "d.jsonl", 64)
+    tpl = get_template("vanilla", tok)
+    before = threading.active_count()
+    it = StreamingBatchIterator(
+        StreamingCsvDataset(p), tpl, tok,
+        global_batch=4, block_size=64, pad_id=0, buffer_size=4, seed=0,
+        read_ahead=2,
+    )
+    gen = it.epoch(0)
+    next(gen)  # consume one batch, then abandon the epoch
+    gen.close()
+    # the generator's finally closed the ReadAheadIterator; its thread
+    # (daemon "dtx-readahead") must wind down
+    deadline = 50
+    while deadline and any(
+            t.name == "dtx-readahead" and t.is_alive()
+            for t in threading.enumerate()):
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert not any(t.name == "dtx-readahead" and t.is_alive()
+                   for t in threading.enumerate())
+    assert threading.active_count() <= before + 1
