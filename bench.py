@@ -166,6 +166,13 @@ def child_tinyllama():
     print(json.dumps(line))
 
 
+def _pct(xs, q):
+    """Nearest-sample percentile over an (un)sorted list — the one
+    implementation every bench mode's p50/p95/p99 shares."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+
+
 def child_serve(preflight=None):
     """DTX_BENCH_SERVE=1: continuous-batching serve bench. A mixed long/short
     chat workload runs through one BatchedEngine (paged KV cache + chunked
@@ -305,8 +312,7 @@ def child_serve(preflight=None):
                    for _, s, e in per_req if len(s) > 1 and not e)
     total_tokens = sum(len(s) for _, s, _ in per_req)
     mean = lambda xs: sum(xs) / len(xs) if xs else 0.0
-    pct = lambda xs, q: (xs[min(len(xs) - 1, int(q * len(xs)))]
-                         if xs else 0.0)
+    pct = _pct
     decode_path = eng.decode_path
     tag = (f"{model},slots{slots}," +
            (f"paged,bs{block},budget{budget}" if paged else "dense") +
@@ -357,6 +363,171 @@ def child_serve(preflight=None):
             "load_ms_p50": round(pct(load_ms, 0.5), 1),
             "load_ms_p95": round(pct(load_ms, 0.95), 1),
         }
+    if preflight is not None:
+        line["preflight"] = preflight
+    print(json.dumps(line), flush=True)
+
+
+def child_serve_capacity(preflight=None):
+    """DTX_BENCH_SERVE_CAPACITY=1: KV-overcommit capacity twin bench. The
+    same reservation-heavy mixed workload (short prompts with generous
+    ``max_new`` budgets — the shape where eager reserve strands the most
+    blocks — interleaved with longer prompts) runs on TWIN engines over
+    ONE block budget: eager reserve (``kv_overcommit off``, today's
+    ceil((prompt+max_new)/bs) admission) vs overcommit (lazy reserve +
+    on-demand growth + youngest-first preemption). The scoreboard is MAX
+    CONCURRENT IN-FLIGHT SESSIONS at token parity, plus blocks-per-session
+    p50/p95, preemption/resume counts, and tokens/s.
+
+    Before the clock starts, the overcommit twin's outputs are asserted
+    token-identical (greedy AND fixed-seed sampled) against the eager twin
+    — preemption/growth must be invisible in the tokens, or the capacity
+    number is unreportable. The run also asserts the acceptance bar: the
+    overcommit twin admits >= 1.5x the eager twin's peak concurrent
+    sessions on the same pool, with zero errors (no preemption deadlock).
+    CPU numbers are smoke-only, like the serve bench."""
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, max_seq, short_new, long_new = "tinyllama-1.1b", 1024, 192, 32
+        n_short, n_long = 10, 3
+    else:
+        model, max_seq, short_new, long_new = "debug", 256, 64, 16
+        n_short, n_long = 6, 2
+    slots = int(os.environ.get("DTX_BENCH_SERVE_SLOTS", "4"))
+    block = int(os.environ.get("DTX_BENCH_BLOCK_SIZE", "16"))
+    # a pool sized so EAGER reserve is the binding constraint: roughly two
+    # short sessions' eager reserve, while lazy reserve fits all `slots`
+    blocks = int(os.environ.get(
+        "DTX_BENCH_KV_BLOCKS",
+        str(2 * (-(-(64 + short_new) // block)) + 4 if not on_tpu else
+            2 * (-(-(256 + short_new) // block)) + 4)))
+    engine_kw = dict(
+        template="vanilla", max_seq_len=max_seq, slots=slots,
+        decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK", "8")),
+        kv_block_size=block, kv_blocks=blocks)
+    pct = _pct
+
+    def run_workload(eng):
+        tok = eng.tokenizer
+        short_ids = tok.encode("a quick question about the weather today")
+        long_ids = tok.encode("background context " * (max_seq // 8))
+        lock = threading.Lock()
+        per_req = []
+
+        def consume(req, t0):
+            stamps = []
+            while True:
+                t = req.stream.get()
+                if t is None:
+                    break
+                stamps.append(time.perf_counter())
+            with lock:
+                per_req.append((t0, stamps, req.error))
+
+        workload = []
+        li = si = 0
+        while li < n_long or si < n_short:
+            if si < n_short:
+                workload.append((short_ids, short_new)); si += 1
+            # longs arrive after the first slot-filling wave of shorts, so
+            # the peak-concurrency comparison measures RESERVE pessimism
+            # (the thing overcommit removes), not long-prompt FIFO waits
+            if (si % 5 == 0 or si >= n_short) and li < n_long:
+                workload.append((long_ids, long_new)); li += 1
+        threads = []
+        wall0 = time.perf_counter()
+        for ids, max_new in workload:
+            t0 = time.perf_counter()
+            req = eng.submit(ids, max_new_tokens=max_new)
+            th = threading.Thread(target=consume, args=(req, t0), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - wall0
+        # the LIVENESS gate proper: a deadlocked session would hang its
+        # consumer past the join timeout and silently vanish from per_req —
+        # every submitted request must have terminated, or the capacity
+        # number is unreportable
+        assert len(per_req) == len(workload) and \
+            not any(th.is_alive() for th in threads), (
+            f"{len(workload) - len(per_req)} session(s) never terminated "
+            "— preemption deadlock")
+        tokens = sum(len(s) for _, s, _ in per_req)
+        errors = [e for _, _, e in per_req if e]
+        sess_blocks = sorted(eng.kv_stats["session_blocks"])
+        preempts = dict(eng.preempt_stats)
+        return {
+            "requests": len(per_req), "errors": len(errors),
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else 0.0,
+            "peak_sessions": eng.kv_stats["peak_sessions"],
+            "blocks_per_session_p50": pct(sess_blocks, 0.5),
+            "blocks_per_session_p95": pct(sess_blocks, 0.95),
+            "preemptions": preempts.get("exported", 0)
+            + preempts.get("requeued_prefill", 0),
+            "resumes": preempts.get("resumed", 0),
+            "overcommit_peak_ratio": None,
+        }
+
+    eager = BatchedEngine(f"preset:{model}", kv_overcommit="off",
+                          **engine_kw)
+    over = BatchedEngine(f"preset:{model}", kv_overcommit="on",
+                         **engine_kw)
+    try:
+        tok = eager.tokenizer
+        probes = [tok.encode("a quick question about the weather today"),
+                  tok.encode("tell me something entirely different")]
+        # pre-clock token-parity gate: growth + preemption must be
+        # invisible in the tokens before any capacity number is reportable
+        for ids in probes:
+            for kw in ({}, {"temperature": 0.8, "top_p": 0.9, "seed": 11}):
+                want = eager.generate(ids, max_new_tokens=12, **kw)
+                got = over.generate(ids, max_new_tokens=12, **kw)
+                assert got == want, (
+                    f"overcommit diverged from the eager twin (kw={kw}): "
+                    f"{got} != {want}")
+        eager_stats = run_workload(eager)
+        over_stats = run_workload(over)
+    finally:
+        eager.close()
+        over.close()
+
+    assert over_stats["errors"] == 0 and eager_stats["errors"] == 0, (
+        "capacity workload dropped sessions (preemption deadlock?): "
+        f"{over_stats} vs {eager_stats}")
+    ratio = (over_stats["peak_sessions"]
+             / max(1, eager_stats["peak_sessions"]))
+    over_stats["overcommit_peak_ratio"] = round(ratio, 2)
+    assert ratio >= 1.5, (
+        "overcommit admitted no more concurrent sessions than eager "
+        f"reserve on the same pool: {over_stats['peak_sessions']} vs "
+        f"{eager_stats['peak_sessions']} (ratio {ratio:.2f} < 1.5)")
+    tag = f"{model},slots{slots},bs{block},blocks{blocks}"
+    line = {
+        "metric": f"serve_capacity_sessions[{tag}]",
+        "value": over_stats["peak_sessions"],
+        "unit": "sessions",
+        "vs_baseline": None,
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
+        "decode_path": over.decode_path,
+        "capacity": {
+            "parity_checked": True,
+            "kv_blocks": blocks, "block_size": block, "slots": slots,
+            "peak_ratio": round(ratio, 2),
+            "overcommit": over_stats,
+            "eager": eager_stats,
+        },
+    }
     if preflight is not None:
         line["preflight"] = preflight
     print(json.dumps(line), flush=True)
@@ -427,8 +598,7 @@ def child_serve_spec(preflight=None):
         out["layers"] = layers_t
         return out
 
-    pct = lambda xs, q: (sorted(xs)[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
-                         if xs else 0.0)
+    pct = _pct
 
     def run_workload(eng):
         tok = eng.tokenizer
@@ -963,6 +1133,10 @@ if __name__ == "__main__":
         # replay mode: loadgen harness against an in-process fleet, with
         # the same per-phase pre-flight diagnosis on its line
         child_replay(preflight=_preflight_probe())
+    elif os.environ.get("DTX_BENCH_SERVE_CAPACITY"):
+        # KV-overcommit capacity twin bench (eager reserve vs overcommit
+        # over one block budget) with the same pre-flight diagnosis
+        child_serve_capacity(preflight=_preflight_probe())
     elif os.environ.get("DTX_BENCH_SERVE_SPEC"):
         # speculative-decoding twin-engine serve bench (spec-on vs spec-off,
         # aligned + adversarial) with the same pre-flight diagnosis

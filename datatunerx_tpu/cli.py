@@ -229,6 +229,7 @@ def cmd_serve(args):
             "--adapter_rank_max", str(args.adapter_rank_max),
             "--kv_block_size", str(args.kv_block_size),
             "--kv_blocks", str(args.kv_blocks),
+            "--kv_overcommit", args.kv_overcommit,
             "--paged_kernel", args.paged_kernel,
             "--spec_draft_config", args.spec_draft_config,
             "--spec_k", str(args.spec_k),
@@ -257,6 +258,7 @@ def cmd_serve(args):
         "--adapter_rank_max", str(args.adapter_rank_max),
         "--kv_block_size", str(args.kv_block_size),
         "--kv_blocks", str(args.kv_blocks),
+        "--kv_overcommit", args.kv_overcommit,
         "--paged_kernel", args.paged_kernel,
         "--spec_draft_config", args.spec_draft_config,
         "--spec_k", str(args.spec_k),
@@ -424,6 +426,12 @@ def main(argv=None):
                     help="paged KV cache block size in tokens (0 = dense)")
     vp.add_argument("--kv_blocks", type=int, default=0,
                     help="paged pool size in blocks (default: dense parity)")
+    vp.add_argument("--kv_overcommit", default="off",
+                    choices=["off", "on"],
+                    help="on: lazy block reserve + on-demand growth + COW "
+                         "prefix blocks + youngest-first preemption (more "
+                         "concurrent sessions per chip); off = eager "
+                         "reserve, byte-identical to the classic engine")
     vp.add_argument("--paged_kernel", default="auto",
                     choices=["auto", "on", "off"],
                     help="Pallas in-place paged decode kernel: auto = "

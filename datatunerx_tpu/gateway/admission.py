@@ -1,4 +1,5 @@
-"""Admission control: bounded queue + prefill-token budget backpressure.
+"""Admission control: bounded queue + prefill-token budget backpressure,
+plus FLEET-TRUE block admission when the replicas report a paged KV pool.
 
 Overload on a TPU replica is not graceful: an unbounded admission queue
 turns into unbounded prefill work and eventually an HBM OOM that kills every
@@ -8,9 +9,23 @@ with 429 + Retry-After, so clients back off and in-flight requests finish
 untouched (the degradation mode Ray Serve's max_concurrent_queries provides
 in the reference).
 
+Fleet-true mode (``fleet_blocks_fn`` wired by the gateway): the static
+token budget is a calibration guess, but paged replicas publish their LIVE
+free-block sum — the resource that actually caps concurrent sessions. Each
+admit is priced in blocks (tokenized-prompt estimate + a decode headroom,
+the overcommit-aware blocks-per-admit: engines running ``--kv_overcommit
+on`` grow past the headroom on demand, so pricing the full ``max_tokens``
+here would re-create the eager pessimism server-side), and admission sheds
+when the price exceeds what the fleet has free, net of admits so recent the
+replicas' gauges cannot reflect them yet. Dense fleets (or missing stats)
+return no block signal and the static budget remains the only gate.
+
 Retry-After is derived from observed drain throughput (EWMA of completed
 prefill tokens/s), so a shed client waits roughly one queue-drain, not a
-fixed guess.
+fixed guess. ``calibrate()`` lets the gateway feed REAL replica-side
+tokenized prompt counts back (the serving response's ``usage``), so the
+chars-per-token heuristic converges on the deployment's actual ratio when
+no local tokenizer is available.
 """
 
 from __future__ import annotations
@@ -79,13 +94,27 @@ class AdmissionController:
     def __init__(self, max_queue: int = 64, token_budget: int = 32768,
                  min_retry_after_s: int = 1, max_retry_after_s: int = 30,
                  chars_per_token: float = 4.0,
-                 count_tokens: Optional[Callable[[str], int]] = None):
+                 count_tokens: Optional[Callable[[str], int]] = None,
+                 fleet_blocks_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 decode_headroom_tokens: int = 64,
+                 pending_window_s: float = 2.0):
         self.max_queue = max_queue
         self.token_budget = token_budget
         self.min_retry_after_s = min_retry_after_s
         self.max_retry_after_s = max_retry_after_s
         self.chars_per_token = chars_per_token
         self.count_tokens = count_tokens
+        # fleet-true block admission: () -> {"free", "total", "block_size"}
+        # summed over available paged replicas, or None (no block signal)
+        self.fleet_blocks_fn = fleet_blocks_fn
+        self.decode_headroom_tokens = decode_headroom_tokens
+        # admits so recent the replicas' scraped free-block gauges cannot
+        # reflect their engine-side reservation yet — counted against the
+        # fleet sum for one stats-refresh window, then auto-expired (the
+        # live gauge carries them from there; keeping the reserve for the
+        # whole request lifetime would double-count every running session)
+        self.pending_window_s = pending_window_s
+        self._pending_blocks: List[tuple] = []  # (t_admit, blocks)
         self._depth = 0
         self._tokens = 0
         self._shed = 0
@@ -100,9 +129,37 @@ class AdmissionController:
                                       chars_per_token=self.chars_per_token,
                                       count_tokens=self.count_tokens)
 
+    def calibrate(self, chars: int, tokens: int):
+        """Fold one observed (prompt chars, replica-side tokenized count)
+        pair into the chars-per-token estimate — truthful token counts
+        over the wire replace the static heuristic as traffic flows. A
+        wired ``count_tokens`` still wins at estimate time; this keeps the
+        fallback honest for gateways without the model's tokenizer."""
+        if tokens <= 0 or chars <= 0:
+            return
+        ratio = max(0.1, chars / tokens)
+        with self._lock:
+            self.chars_per_token = (0.8 * self.chars_per_token
+                                    + 0.2 * ratio)
+
+    def blocks_for_admit(self, tokens: int, block_size: int) -> int:
+        """Overcommit-aware blocks-per-admit estimate: the tokenized
+        prompt plus a decode headroom, in blocks — what one admission
+        costs an overcommitted engine up front (lazy growth covers the
+        rest; an eager fleet simply sheds a little later than its own
+        FIFO would queue)."""
+        bs = max(1, int(block_size))
+        return -(-(tokens + self.decode_headroom_tokens) // bs)
+
     def try_admit(self, messages: List[dict],
                   tokens: Optional[int] = None) -> Ticket:
         n = tokens if tokens is not None else self.estimate(messages)
+        fleet = None
+        if self.fleet_blocks_fn is not None:
+            try:
+                fleet = self.fleet_blocks_fn()
+            except Exception:  # noqa: BLE001 — a stats fault must not shed 500s
+                fleet = None
         with self._lock:
             if self._depth + 1 > self.max_queue:
                 self._shed += 1
@@ -115,6 +172,22 @@ class AdmissionController:
                     f"prefill token budget exhausted ({self._tokens}+{n}"
                     f">{self.token_budget})",
                     self._retry_after_locked())
+            if fleet and fleet.get("total"):
+                now = time.monotonic()
+                self._pending_blocks = [
+                    (t, b) for t, b in self._pending_blocks
+                    if now - t < self.pending_window_s]
+                pending = sum(b for _, b in self._pending_blocks)
+                need = self.blocks_for_admit(
+                    n, fleet.get("block_size") or 16)
+                free = int(fleet.get("free", 0))
+                if need + pending > free:
+                    self._shed += 1
+                    raise Overloaded(
+                        f"fleet KV blocks exhausted (need {need}, "
+                        f"free {free}, pending {pending})",
+                        self._retry_after_locked())
+                self._pending_blocks.append((now, need))
             self._depth += 1
             self._tokens += n
         return Ticket(self, n)

@@ -25,6 +25,8 @@ def autoscale_hint(
     queue_high_per_replica: int = 4,
     latency_target_s: float = 30.0,
     slo_burn: Optional[dict] = None,
+    fleet_blocks: Optional[dict] = None,
+    block_low_watermark: float = 0.1,
 ) -> dict:
     """Pure function of current observations → desired-replica hint.
 
@@ -45,6 +47,13 @@ def autoscale_hint(
     is the scaling contract an operator actually declared; a raw p95
     threshold is a guess about one. Without it (no ``--slo_config``), the
     p95 branch behaves exactly as before.
+
+    ``fleet_blocks`` (``{"free", "total"}`` — the fleet's live paged-KV
+    inventory) makes the hint derive from BLOCKS rather than slots: when
+    the free fraction drops below ``block_low_watermark`` the fleet is
+    about to shed/queue on KV capacity regardless of how latency looks,
+    so scale-up fires on the same signal admission sheds on. The block
+    numbers are echoed in the output either way.
     """
     n = max(1, replicas)
     desired = n
@@ -55,12 +64,21 @@ def autoscale_hint(
         reason = f"degraded: {available_replicas}/{n} replicas available"
     backlog_high = queue_high_per_replica * max(1, available_replicas)
     shedding = shed_count if shed_recent is None else shed_recent
+    blocks_low = (fleet_blocks is not None
+                  and fleet_blocks.get("total", 0) > 0
+                  and (fleet_blocks.get("free", 0)
+                       / fleet_blocks["total"]) < block_low_watermark)
     if shedding > 0 and queue_depth > 0:
         desired = n + 1
         reason = f"shedding load ({shedding} shed, queue={queue_depth})"
     elif queue_depth > backlog_high:
         desired = n + 1
         reason = f"queue depth {queue_depth} > {backlog_high}"
+    elif blocks_low:
+        desired = n + 1
+        reason = (f"fleet KV blocks low ({fleet_blocks.get('free', 0)}/"
+                  f"{fleet_blocks['total']} free < "
+                  f"{block_low_watermark:.0%})")
     elif slo_burn is not None:
         if slo_burn["burn_rate"] > 1.0:
             desired = n + 1
@@ -91,6 +109,9 @@ def autoscale_hint(
     if slo_burn is not None:
         out["sloBurnRate"] = slo_burn["burn_rate"]
         out["sloObjective"] = slo_burn["name"]
+    if fleet_blocks is not None:
+        out["fleetKvBlocksFree"] = int(fleet_blocks.get("free", 0))
+        out["fleetKvBlocksTotal"] = int(fleet_blocks.get("total", 0))
     return out
 
 

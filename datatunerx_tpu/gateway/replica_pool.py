@@ -182,6 +182,24 @@ class Replica:
         self.errors_total = 0
         self._latency_ms: List[float] = []
         self._outcome_lock = threading.Lock()
+        # last completed request's replica-side tokenized prompt length
+        # (the serving response's ``usage``): the gateway pops this after
+        # each success and calibrates its admission estimator with it
+        self._last_usage: Optional[dict] = None
+
+    def note_usage(self, prompt_chars: int, prompt_tokens: int):
+        """Record one request's REAL tokenized prompt length (replica-side
+        truth) next to the prompt's char count."""
+        self._last_usage = {"prompt_chars": int(prompt_chars),
+                            "prompt_tokens": int(prompt_tokens)}
+
+    def take_usage(self) -> Optional[dict]:
+        usage, self._last_usage = self._last_usage, None
+        return usage
+
+    @staticmethod
+    def _prompt_chars(messages) -> int:
+        return sum(len(str(m.get("content", ""))) for m in messages or [])
 
     def record_outcome(self, ok: bool, latency_ms: float):
         """One routed attempt's terminal outcome (gateway-side). Client
@@ -221,11 +239,14 @@ class Replica:
 
     def stats(self) -> dict:
         """{"slots_busy": int, "slots_total": int, "kv_blocks_free": int,
-        "kv_blocks_total": int, "adapters": set|None,
+        "kv_blocks_total": int, "kv_block_size": int,
+        "adapters": set|None,
         "resident_adapters": set|None, "spec_enabled": bool,
         "spec_accept_rate": float|None}.
         kv_blocks_total 0 means the replica runs a dense cache (no block
-        signal); adapters=None means unknown — the router treats it as
+        signal; kv_block_size is then 0 too — fleet-true admission prices
+        admits in blocks of kv_block_size tokens when present);
+        adapters=None means unknown — the router treats it as
         capable of anything (load-on-demand fallback). resident_adapters
         is the subset already materialised in the replica's pool (static
         stacks: everything it knows) — the router's cache-locality
@@ -342,10 +363,25 @@ class InProcessReplica(Replica):
             kwargs["trace_id"] = trace_id
         return kwargs
 
+    def _note_engine_usage(self, messages):
+        """Tokenize the prompt with the ENGINE's own tokenizer — the same
+        count the serving wire's ``usage`` carries — so in-process and
+        HTTP replicas feed admission calibration identically."""
+        enc = getattr(self.engine, "_encode_chat", None)
+        if not callable(enc):
+            return
+        try:
+            ids, _ = enc(messages)
+            self.note_usage(self._prompt_chars(messages), len(ids))
+        except Exception:  # noqa: BLE001 — usage is advisory
+            pass
+
     def chat(self, messages, **kwargs) -> str:
         kwargs = self._trace_kwargs(kwargs)
         try:
-            return self.engine.chat(messages, **kwargs)
+            text = self.engine.chat(messages, **kwargs)
+            self._note_engine_usage(messages)
+            return text
         except (ValueError, KeyError) as e:
             # the CLIENT's error (unknown adapter, over-length prompt, bad
             # params): same rule as HTTPReplica's 4xx mapping — the replica
@@ -363,9 +399,11 @@ class InProcessReplica(Replica):
         try:
             if stream_fn is None:
                 yield self.engine.chat(messages, **kwargs)
+                self._note_engine_usage(messages)
                 return
             for delta in stream_fn(messages, **kwargs):
                 yield delta
+            self._note_engine_usage(messages)
         except ReplicaError:
             raise
         except (ValueError, KeyError) as e:  # client error — no failover
@@ -494,6 +532,9 @@ class InProcessReplica(Replica):
             "slots_total": getattr(self.engine, "slots", 0),
             "kv_blocks_free": getattr(self.engine, "free_kv_blocks", None) or 0,
             "kv_blocks_total": getattr(self.engine, "total_kv_blocks", None) or 0,
+            # tokens per block — fleet-true admission prices an admit with
+            # this (0 = dense cache, no block signal)
+            "kv_block_size": getattr(self.engine, "block_size", 0) or 0,
             "adapters": set(adapter_ids) if adapter_ids is not None else None,
             "resident_adapters": resident,
             # speculative decoding: the router's spec-friendly preference
@@ -546,12 +587,26 @@ class HTTPReplica(Replica):
             payload["model"] = kwargs["adapter"]
         return payload
 
+    def _note_wire_usage(self, messages, usage):
+        """Feed the serving response's ``usage`` (replica-side tokenized
+        prompt length) back — the truthful count gateway admission
+        calibrates against instead of the chars-per-token heuristic."""
+        if not isinstance(usage, dict):
+            return
+        try:
+            tokens = int(usage.get("prompt_tokens") or 0)
+        except (TypeError, ValueError):
+            return
+        if tokens > 0:
+            self.note_usage(self._prompt_chars(messages), tokens)
+
     def chat(self, messages, **kwargs) -> str:
         trace_id = kwargs.pop("trace_id", "")
         try:
             with self._post("/chat/completions",
                             self._payload(messages, kwargs), trace_id) as r:
                 body = json.load(r)
+            self._note_wire_usage(messages, body.get("usage"))
             return body["choices"][0]["message"]["content"]
         except urllib.error.HTTPError as e:
             detail = _error_detail(e)
@@ -590,6 +645,8 @@ class HTTPReplica(Replica):
                     if "error" in evt:
                         raise ReplicaError(
                             f"{self.name}: {evt['error'].get('message')}")
+                    if "usage" in evt:  # terminal chunk's token truth
+                        self._note_wire_usage(messages, evt["usage"])
                     delta = evt["choices"][0]["delta"].get("content")
                     if delta:
                         yield delta
@@ -744,7 +801,8 @@ class HTTPReplica(Replica):
                 and now - self._stats_at < self.stats_ttl_s):
             return self._stats_cache
         out = {"slots_busy": 0, "slots_total": 0,
-               "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
+               "kv_blocks_free": 0, "kv_blocks_total": 0,
+               "kv_block_size": 0, "adapters": None,
                "resident_adapters": None,
                "spec_enabled": False, "spec_accept_rate": None}
         try:
@@ -766,6 +824,8 @@ class HTTPReplica(Replica):
                     elif line.startswith(("dtx_serving_kv_blocks_capacity ",
                                           "dtx_serving_kv_blocks_total ")):
                         out["kv_blocks_total"] = int(float(line.split()[-1]))
+                    elif line.startswith("dtx_serving_kv_block_size "):
+                        out["kv_block_size"] = int(float(line.split()[-1]))
                     elif line.startswith("dtx_serving_spec_enabled "):
                         out["spec_enabled"] = float(line.split()[-1]) > 0
                     elif line.startswith("dtx_serving_spec_accept_rate "):
@@ -795,7 +855,8 @@ class HTTPReplica(Replica):
         if self._stats_cache is not None:
             return self._stats_cache
         return {"slots_busy": 0, "slots_total": 0,
-                "kv_blocks_free": 0, "kv_blocks_total": 0, "adapters": None,
+                "kv_blocks_free": 0, "kv_blocks_total": 0,
+                "kv_block_size": 0, "adapters": None,
                 "resident_adapters": None,
                 "spec_enabled": False, "spec_accept_rate": None}
 

@@ -136,6 +136,14 @@ class Gateway:
         self.pool = pool
         self.router = Router(pool, policy=policy)
         self.admission = admission or AdmissionController()
+        # fleet-true admission: tie 429/Retry-After to the fleet's LIVE
+        # free-block sum whenever the replicas report a paged pool (dense
+        # fleets return None and the static token budget stays the gate).
+        # Only wired when the controller wasn't given its own source —
+        # tests injecting a custom fn keep it.
+        if getattr(self.admission, "fleet_blocks_fn", None) is None \
+                and hasattr(self.admission, "fleet_blocks_fn"):
+            self.admission.fleet_blocks_fn = self.fleet_kv_blocks
         self.max_attempts = max_attempts
         self.model_name = model_name
         self.registry = Registry()
@@ -308,6 +316,7 @@ class Gateway:
                         text = replica.chat(messages, trace_id=root.trace_id,
                                             **kwargs)
                         replica.breaker.record_success()
+                        self._calibrate_usage(replica)
                         replica.record_outcome(
                             True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0,
@@ -417,6 +426,7 @@ class Gateway:
                             emitted += delta
                             yield delta
                         replica.breaker.record_success()
+                        self._calibrate_usage(replica)
                         replica.record_outcome(
                             True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0,
@@ -685,6 +695,39 @@ class Gateway:
         return self.slo.report(plane="gateway")
 
     # -------------------------------------------------------------- reports
+    def fleet_kv_blocks(self) -> Optional[dict]:
+        """The fleet's live paged-KV inventory, summed over AVAILABLE
+        replicas: {"free", "total", "block_size"} — the signal fleet-true
+        admission and the /autoscale hint derive from. None when no
+        available replica reports a block pool (dense fleet / no stats):
+        callers fall back to their static heuristics."""
+        free = total = block_size = 0
+        for r in self.pool.available():
+            try:
+                st = r.stats()  # TTL-cached on HTTP replicas
+            except Exception:  # noqa: BLE001 — stats are advisory
+                continue
+            if st.get("kv_blocks_total"):
+                free += int(st.get("kv_blocks_free", 0))
+                total += int(st["kv_blocks_total"])
+                block_size = max(block_size,
+                                 int(st.get("kv_block_size", 0) or 0))
+        if total <= 0:
+            return None
+        return {"free": free, "total": total,
+                "block_size": block_size or 16}
+
+    def _calibrate_usage(self, replica: Replica):
+        """After a successful attempt, fold the replica-reported tokenized
+        prompt length into admission's chars-per-token estimate."""
+        take = getattr(replica, "take_usage", None)
+        cal = getattr(self.admission, "calibrate", None)
+        if not callable(take) or not callable(cal):
+            return
+        usage = take()
+        if usage:
+            cal(usage.get("prompt_chars", 0), usage.get("prompt_tokens", 0))
+
     def healthy(self) -> bool:
         return len(self.pool.available()) > 0
 
@@ -702,6 +745,9 @@ class Gateway:
             shed_recent=shed_recent,
             p95_latency_s=self._latency.percentile(0.95),
             slo_burn=self._slo_burn() if self.slo_configured else None,
+            # the hint derives from blocks, not slots: the same live
+            # free-block sum admission sheds on
+            fleet_blocks=self.fleet_kv_blocks(),
         )
 
     def _slo_burn(self) -> Optional[dict]:
@@ -768,6 +814,11 @@ class Gateway:
                         "Free paged KV-cache blocks per replica — the "
                         "admission headroom gauge (0 labels absent on "
                         "dense-cache replicas).")
+        blocks_reserved = g("dtx_gateway_replica_kv_blocks_reserved",
+                            "Reserved (allocated) paged KV-cache blocks "
+                            "per replica, restated from the same stats "
+                            "snapshot as the free gauge — together they "
+                            "are the fleet-true admission ledger.")
         weight = g("dtx_gateway_replica_weight",
                    "Traffic weight per replica (canary promotion: the "
                    "router's smooth-WRR share when weights are "
@@ -805,6 +856,7 @@ class Gateway:
         up.clear()
         busy.clear()
         blocks_free.clear()
+        blocks_reserved.clear()
         weight.clear()
         attempts.clear()
         a_routes.clear()
@@ -839,6 +891,9 @@ class Gateway:
             if st.get("kv_blocks_total"):
                 blocks_free.set(st.get("kv_blocks_free", 0),
                                 {"replica": r.name})
+                blocks_reserved.set(
+                    st["kv_blocks_total"] - st.get("kv_blocks_free", 0),
+                    {"replica": r.name})
             for a in st.get("resident_adapters") or ():
                 if a:
                     residency[a] = residency.get(a, 0) + 1
@@ -1493,6 +1548,8 @@ def main(argv=None):
     p.add_argument("--prefix_cache", type=int, default=0)
     p.add_argument("--kv_block_size", type=int, default=0)
     p.add_argument("--kv_blocks", type=int, default=0)
+    p.add_argument("--kv_overcommit", default="off",
+                   choices=["off", "on"])
     p.add_argument("--spec_draft_config", default="")
     p.add_argument("--spec_k", type=int, default=4)
     p.add_argument("--spec_mode", default="auto",
@@ -1553,6 +1610,7 @@ def main(argv=None):
                        "--prefix_cache", str(args.prefix_cache),
                        "--kv_block_size", str(args.kv_block_size),
                        "--kv_blocks", str(args.kv_blocks),
+                       "--kv_overcommit", args.kv_overcommit,
                        "--paged_kernel", args.paged_kernel,
                        "--spec_draft_config", args.spec_draft_config,
                        "--spec_k", str(args.spec_k),

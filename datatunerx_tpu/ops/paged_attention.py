@@ -55,21 +55,29 @@ class BlockAllocatorError(ValueError):
 
 
 class BlockAllocator:
-    """Host-side free-list over the physical block pool.
+    """Host-side REFCOUNTED free-list over the physical block pool.
 
     The scheduler thread is the only allocator writer, but gauges
     (``/metrics``, gateway stats) read ``free_count`` from HTTP threads —
     hence the lock. Blocks are handed out lowest-id-first and returned to
     the head of the free list, so tests can assert deterministic reuse.
-    ``free()`` validates ids against a shadow set of the free list and
-    raises BlockAllocatorError instead of admitting a corruption."""
+
+    Refcounts are the copy-on-write substrate: ``alloc`` hands blocks out
+    at refcount 1, ``incref`` lets a second owner (another slot's block
+    table, a prefix-cache entry) map the same physical block, and ``free``
+    DECREMENTS — a block only returns to the free list when its last owner
+    lets go. Every owner calls plain ``free`` on release, so the sharing is
+    invisible to release paths. ``free()``/``incref()`` validate against
+    the refcount table and raise BlockAllocatorError instead of admitting
+    a corruption: a double-freed id would get handed out twice and two
+    live slots would then scatter into the same physical block."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
-        self._free_set = set(self._free)
+        self._ref = [0] * num_blocks  # 0 = on the free list
         self._lock = threading.Lock()
 
     @property
@@ -77,39 +85,75 @@ class BlockAllocator:
         with self._lock:
             return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        """Owners of one block (0 = free) — tests and forensics."""
+        with self._lock:
+            return self._ref[int(block)]
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Reserve ``n`` blocks; None (and no change) when the pool can't
-        cover the request — the caller keeps the request queued."""
+        """Reserve ``n`` blocks at refcount 1; None (and no change) when
+        the pool can't cover the request — the caller keeps the request
+        queued."""
         if n <= 0:
             return []
         with self._lock:
             if n > len(self._free):
                 return None
             out, self._free = self._free[:n], self._free[n:]
-            self._free_set.difference_update(out)
+            for b in out:
+                self._ref[b] = 1
             return out
 
-    def free(self, blocks: List[int]):
+    def _validate(self, blocks: List[int], op: str) -> List[int]:
+        ids = [int(b) for b in blocks]
+        bad = [b for b in ids if not 0 <= b < self.num_blocks]
+        if bad:
+            raise BlockAllocatorError(
+                f"{op} of out-of-range block id(s) {bad} "
+                f"(pool has {self.num_blocks} blocks)")
+        if len(set(ids)) != len(ids):
+            dupes = sorted({b for b in ids if ids.count(b) > 1})
+            raise BlockAllocatorError(
+                f"{op} lists block id(s) {dupes} more than once")
+        return ids
+
+    def incref(self, blocks: List[int]):
+        """Add one owner to each LIVE block (copy-on-write sharing: a new
+        slot's table or a prefix-cache entry mapping blocks it did not
+        allocate). Increffing a free block is the same corruption class as
+        a double-free — rejected before any mutation."""
         if not blocks:
             return
         with self._lock:
-            ids = [int(b) for b in blocks]
-            bad = [b for b in ids if not 0 <= b < self.num_blocks]
-            if bad:
+            ids = self._validate(blocks, "incref()")
+            dead = sorted(b for b in ids if self._ref[b] == 0)
+            if dead:
                 raise BlockAllocatorError(
-                    f"free() of out-of-range block id(s) {bad} "
-                    f"(pool has {self.num_blocks} blocks)")
-            if len(set(ids)) != len(ids):
-                dupes = sorted({b for b in ids if ids.count(b) > 1})
-                raise BlockAllocatorError(
-                    f"free() lists block id(s) {dupes} more than once")
-            double = sorted(b for b in ids if b in self._free_set)
+                    f"incref() of free block id(s) {dead}: a shared "
+                    "mapping must target live blocks")
+            for b in ids:
+                self._ref[b] += 1
+
+    def free(self, blocks: List[int]):
+        """Drop one owner per block; blocks whose last owner left return
+        to the free list. Rejected (typed, pre-mutation) on out-of-range
+        ids, duplicates in one call, and frees of already-free blocks."""
+        if not blocks:
+            return
+        with self._lock:
+            ids = self._validate(blocks, "free()")
+            double = sorted(b for b in ids if self._ref[b] == 0)
             if double:
                 raise BlockAllocatorError(
                     f"double-free of block id(s) {double}: already on the "
                     "free list")
-            self._free = sorted(ids) + self._free
-            self._free_set.update(ids)
+            released = []
+            for b in ids:
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    released.append(b)
+            if released:
+                self._free = sorted(released) + self._free
 
 
 def blocks_for_depth(depth: int, block_size: int, overshoot: int = 0,
@@ -292,13 +336,45 @@ def row_trim(row: Dict, width: int) -> Dict:
     return out
 
 
-def paged_extract_row(cache: Dict, slot, cursor) -> Dict:
+def paged_copy_block(cache: Dict, src, dst, keep) -> Dict:
+    """Copy one physical block (K/V pools, int8 scales, pos row) onto
+    another — the copy-on-write primitive. Position lanes at offset >=
+    ``keep`` are scrubbed to POS_SENTINEL in the destination, so copying a
+    partially-written tail block never leaks the source's later tokens to
+    the new owner's attention (decode only ever appends at the cursor, so
+    this copy is the at-most-once COW event per shared tail block)."""
+    out = dict(cache)
+    block_size = cache["pos"].shape[1]
+    for key in ("k", "v"):
+        out[key] = cache[key].at[:, dst].set(cache[key][:, src])
+    if "k_scale" in cache:
+        out["k_scale"] = cache["k_scale"].at[:, dst].set(
+            cache["k_scale"][:, src])
+        out["v_scale"] = cache["v_scale"].at[:, dst].set(
+            cache["v_scale"][:, src])
+    row = jnp.where(jnp.arange(block_size, dtype=jnp.int32) < keep,
+                    cache["pos"][src], POS_SENTINEL)
+    out["pos"] = cache["pos"].at[dst].set(row)
+    return out
+
+
+def paged_extract_row(cache: Dict, slot, cursor, *,
+                      width: Optional[int] = None) -> Dict:
     """Gather a slot's blocks back into a dense single-row cache (the
-    prefix-cache storage format, width = blocks_per_slot × block_size =
-    max_seq_len). The inverse of ``paged_insert_row``; ``cursor`` becomes
-    the row's scalar write cursor so suffix extension picks up exactly where
-    the prompt ended."""
-    nbps = cache["block_tables"].shape[1]
+    prefix-cache / migration-wire storage format). The inverse of
+    ``paged_insert_row``; ``cursor`` becomes the row's scalar write cursor
+    so suffix extension picks up exactly where the prompt ended.
+
+    ``width`` (static under jit) trims the gather to the first
+    ``ceil(width / block_size)`` blocks — a short prefix then moves
+    ``width`` columns of HBM instead of a full ``max_seq_len`` row, which
+    is what the prefix-cache export and migration paths pay per session.
+    Default None keeps the full-table gather (width = blocks_per_slot ×
+    block_size = max_seq_len)."""
+    nbps_total = cache["block_tables"].shape[1]
+    block_size = cache["k"].shape[2]
+    nbps = nbps_total if width is None else max(
+        1, min(nbps_total, -(-int(width) // block_size)))
     table_row = jax.lax.dynamic_slice(
         cache["block_tables"], (slot, 0), (1, nbps))[0]
     tbl = _gather_tables(table_row)

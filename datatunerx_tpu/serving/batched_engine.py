@@ -34,6 +34,7 @@ generate.go:160-329 deploys LlamaDeployment replicas), rebuilt TPU-first:
 from __future__ import annotations
 
 import collections
+import itertools
 import queue
 import threading
 import time
@@ -56,7 +57,9 @@ from datatunerx_tpu.models.lora import LORA_TARGETS, lora_scaling
 from datatunerx_tpu.ops.paged_attention import (
     POS_SENTINEL,
     BlockAllocator,
+    blocks_for_depth,
     init_paged_cache,
+    paged_copy_block,
     paged_extract_row,
     paged_insert_row,
 )
@@ -65,6 +68,12 @@ from datatunerx_tpu.utils.decoding import DECODE_BUCKET
 from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
 
 MAX_STOP = 8  # static per-slot stop-token capacity
+
+# global arrival order: preemption fairness (never preempt the oldest,
+# resume strictly before admitting anything younger) needs a total order
+# across waiting, parked, and slot-holding requests; itertools.count is
+# C-level atomic, so concurrent submit() threads need no extra lock
+_REQ_SEQ = itertools.count()
 
 
 class _RetryLater(Exception):
@@ -90,12 +99,20 @@ class _PrefixCache:
     "cursor": cache write depth}. Stored row caches are immutable JAX
     arrays — inserting a row into a slot copies, and extension builds a new
     functional cache, so shared prefixes are safe.
+
+    COW mode (kv_overcommit engines) stores BLOCK entries instead:
+    {"blocks": [ids], "full": n, "rem": r, "cursor", "logits"} — refcounted
+    physical blocks a hit maps straight into the new slot's table, no dense
+    row anywhere. ``on_evict`` receives every entry leaving the cache
+    (capacity eviction, same-key replacement, drop_adapter) so the engine
+    can return block entries' refs to the allocator.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, on_evict=None):
         from collections import OrderedDict
 
         self.capacity = capacity
+        self._on_evict = on_evict
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
         # adapter -> trie root; node = [children {tok: node}, terminal key]
         self._roots: Dict[int, list] = {}
@@ -137,8 +154,13 @@ class _PrefixCache:
             return best_key, self._d[best_key]
 
     def put(self, key, ent):
+        dropped = []
         with self._lock:
             is_new = key not in self._d
+            if not is_new:
+                # same-key replacement: the old entry's resources (COW
+                # block refs) must be released like any other eviction
+                dropped.append(self._d[key])
             self._d[key] = ent
             self._d.move_to_end(key)
             if is_new:
@@ -148,9 +170,34 @@ class _PrefixCache:
                     node = node[0].setdefault(t, [{}, None])
                 node[1] = key
             while len(self._d) > self.capacity:
-                old_key, _ = self._d.popitem(last=False)
+                old_key, old_ent = self._d.popitem(last=False)
                 self._trie_remove(old_key)
                 self.evictions += 1
+                dropped.append(old_ent)
+        # outside the lock: the callback frees allocator blocks (its own
+        # lock) and must never nest under this one
+        self._notify_evicted(dropped)
+
+    def _notify_evicted(self, entries):
+        if self._on_evict is None:
+            return
+        for ent in entries:
+            self._on_evict(ent)
+
+    def pop_lru_block_entry(self):
+        """Evict (and return) the least-recently-used BLOCK entry — the
+        overcommit scheduler's first reclamation tier when growth finds
+        the pool empty: cached prefixes are a performance tier, live
+        sessions are the product. None when no block entries remain.
+        The caller owns the entry's block refs (on_evict is NOT called)."""
+        with self._lock:
+            for key, ent in self._d.items():
+                if ent.get("blocks"):
+                    del self._d[key]
+                    self._trie_remove(key)
+                    self.evictions += 1
+                    return ent
+        return None
 
     def drop_adapter(self, adapter):
         """Invalidate every entry cached under one adapter identity —
@@ -158,10 +205,12 @@ class _PrefixCache:
         (unload / re-register): cached KV rows were computed with the old
         weights and would silently poison the new binding. Called from
         admin threads; the lock covers the scheduler's concurrent use."""
+        dropped = []
         with self._lock:
             for key in [k for k in self._d if k[1] == adapter]:
-                del self._d[key]
+                dropped.append(self._d.pop(key))
                 self._trie_remove(key)
+        self._notify_evicted(dropped)
 
     def _trie_remove(self, key):
         ptoks, adapter = key
@@ -197,6 +246,10 @@ class Request:
         self.top_p = top_p
         self.seed = seed
         self.stop_ids = list(stop_ids)[:MAX_STOP]
+        # arrival order across every parked population (waiting queue,
+        # preemption parking, slots) — the preemption policy's fairness
+        # and never-preempt-the-oldest invariants compare these
+        self.seq = next(_REQ_SEQ)
         # device pool/stack index; in dynamic mode -1 until admission
         # resolves (and pins) the NAME to a pool slot via the registry
         self.adapter = adapter
@@ -239,6 +292,27 @@ class Request:
         self.error = error
         self.stream.put(None)
         self.done.set()
+
+
+def _pad_row(row: Dict, width: int) -> Dict:
+    """Sentinel-pad a cursor-trimmed dense row cache back to ``width``.
+    Stored prefix rows are trimmed to their live cursor (no full
+    ``max_seq_len`` gather per insert), but the extension program keeps ONE
+    compiled geometry — full width — so padding happens here, once per
+    extension, instead of a compile per stored prefix length."""
+    W = row["k"].shape[2]
+    if W >= width:
+        return row
+    out = dict(row)
+    pad5 = [(0, 0), (0, 0), (0, width - W), (0, 0), (0, 0)]
+    out["k"] = jnp.pad(row["k"], pad5)
+    out["v"] = jnp.pad(row["v"], pad5)
+    if "k_scale" in row:
+        out["k_scale"] = jnp.pad(row["k_scale"], pad5[:-1])
+        out["v_scale"] = jnp.pad(row["v_scale"], pad5[:-1])
+    out["pos"] = jnp.pad(row["pos"], [(0, 0), (0, width - W)],
+                         constant_values=POS_SENTINEL)
+    return out
 
 
 def load_checkpoint_state(checkpoint_path: str) -> dict:
@@ -320,7 +394,9 @@ class _Programs:
         self.activate = jax.jit(self._activate_impl)
         self.prefill_chunk = jax.jit(self._prefill_chunk_impl,
                                      static_argnames=("chunk_len",))
-        self.extract = jax.jit(paged_extract_row)
+        self.extract = jax.jit(paged_extract_row,
+                               static_argnames=("width",))
+        self.copy_block = jax.jit(paged_copy_block)
         self.decode = jax.jit(self._decode_impl, static_argnames=("K",))
 
     def _prefill_impl(self, params, lora, tokens, mask, positions,
@@ -503,6 +579,7 @@ class BatchedEngine:
         prefix_cache: int = 0,  # LRU entries of reusable prefilled prefixes
         kv_block_size: int = 0,  # >0: paged block-pool cache (elastic HBM)
         kv_blocks: Optional[int] = None,  # pool size; default = dense parity
+        kv_overcommit: str = "off",  # on: lazy block growth + COW + preempt
         paged_kernel: str = "auto",  # Pallas in-place decode: auto|on|off
         spec_draft: Optional[str] = None,  # draft model: path|preset:|take:N
         spec_k: int = 4,  # proposals per verify step (adaptive ceiling)
@@ -576,6 +653,24 @@ class BatchedEngine:
         self.kv_quant = kv_quant or None
         self.paged = kv_block_size > 0
         self.block_size = int(kv_block_size)
+        # KV overcommit plane: admission reserves only the prompt's blocks
+        # plus one tick's growth headroom, the scheduler appends blocks at
+        # each slot's cursor as decode advances, prefix-cache hits map
+        # SHARED refcounted blocks (copy-on-write tail), and exhaustion
+        # preempts youngest-first (sessions park host-side as dtx-kv-session
+        # payloads and resume token-exactly when blocks free). "off" is
+        # byte-identical to the eager-reserve engine.
+        oc_mode = (kv_overcommit if isinstance(kv_overcommit, str)
+                   else ("on" if kv_overcommit else "off"))
+        oc_mode = (oc_mode or "off").strip().lower()
+        if oc_mode not in ("on", "off"):
+            raise ValueError(
+                f"kv_overcommit must be on|off, got {kv_overcommit!r}")
+        if oc_mode == "on" and not self.paged:
+            raise ValueError(
+                "--kv_overcommit on requires the paged KV cache "
+                "(--kv_block_size > 0)")
+        self.overcommit = self.paged and oc_mode == "on"
         # Pallas in-place decode kernel (ops/pallas_paged_attention.py):
         # "auto" engages it on a real TPU backend and keeps the XLA gather
         # elsewhere (interpret-mode emulation would only slow CPU smoke
@@ -692,6 +787,33 @@ class BatchedEngine:
             self._spec_adapter_ema: Dict[str, float] = {}
             self._h_accept_len = None  # bound after the registry exists
 
+        # ---- overcommit scheduler state. _tick_advance = the most cache
+        # lanes one scheduler tick can consume per slot (a plain decode
+        # chunk, or a verify-k step), and growth must additionally keep the
+        # spec write overshoot physical — together the per-tick capacity
+        # target the grower maintains ahead of every cursor.
+        self._tick_advance = self.chunk
+        if self.spec is not None:
+            self._tick_advance = max(self.chunk, self.spec_k + 1)
+        # preempted sessions, parked host-side as dtx-kv-session payloads
+        # (raw-numpy bodies — no b64 for in-process parking), oldest first;
+        # owned by the scheduler thread
+        self._preempted: List[dict] = []
+        # dtx_serving_preemptions_total{outcome} source (scheduler-only
+        # writes; scraped racily like every other stats dict)
+        self.preempt_stats: Dict[str, int] = {}
+        # capacity observability for DTX_BENCH_SERVE_CAPACITY: the high-water
+        # mark of concurrently admitted sessions and each finished session's
+        # physical block footprint (== its peak: tables only ever grow)
+        self.kv_stats = {"peak_sessions": 0,
+                         "session_blocks": collections.deque(maxlen=4096)}
+        # per-slot EAGER-equivalent reserve (what the overcommit-off engine
+        # would hold) — the dtx_serving_kv_overcommit_ratio numerator
+        self._slot_demand: List[int] = [0] * slots
+        # chat-encode LRU (see _encode_chat): HTTP threads share it
+        self._encode_memo: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self._encode_memo_lock = threading.Lock()
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         # dynamic mode: the adapter NAME each slot pins (released with the
@@ -760,9 +882,16 @@ class BatchedEngine:
         self._activate = progs.activate
         self._prefill_chunk_fn = progs.prefill_chunk
         self._extract = progs.extract
+        self._copy_block = progs.copy_block
         self._decode = progs.decode
 
-        self._prefix = _PrefixCache(prefix_cache) if prefix_cache > 0 else None
+        self._prefix = _PrefixCache(
+            prefix_cache, on_evict=self._free_prefix_entry
+        ) if prefix_cache > 0 else None
+        # COW prefix blocks: overcommit engines with a prefix cache store
+        # refcounted BLOCK entries — hits map shared physical blocks into
+        # the new slot's table instead of the dense-row copy + re-insert
+        self.cow = self.overcommit and self._prefix is not None
         # observability: how admissions were served (tests + /metrics)
         self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
         # Shared-registry latency histograms. Recording is BUFFERED off the
@@ -804,6 +933,36 @@ class BatchedEngine:
     @property
     def free_kv_blocks(self) -> Optional[int]:
         return self._allocator.free_count if self._allocator else None
+
+    @property
+    def kv_blocks_reserved(self) -> Optional[int]:
+        if self._allocator is None:
+            return None
+        return self._allocator.num_blocks - self._allocator.free_count
+
+    @property
+    def kv_overcommit_ratio(self) -> Optional[float]:
+        """Live sessions' EAGER-equivalent block demand over the physical
+        pool: > 1.0 means the engine has admitted more logical reserve than
+        HBM holds — the whole point of on-demand growth. None on dense
+        engines (no block signal)."""
+        if self._allocator is None:
+            return None
+        demand = sum(self._slot_demand[s] for s in range(self.slots)
+                     if self._slot_req[s] is not None)
+        return round(demand / max(1, self._allocator.num_blocks), 4)
+
+    def _free_prefix_entry(self, ent: dict):
+        """Prefix-cache eviction hook: return a COW block entry's refs to
+        the allocator (dense-row entries hold no pool resources). Runs on
+        whichever thread evicted (scheduler put, admin drop_adapter) —
+        the allocator's own lock covers it."""
+        blocks = ent.get("blocks")
+        if blocks and self._allocator is not None:
+            self._allocator.free(blocks)
+
+    def _count_preempt(self, outcome: str):
+        self.preempt_stats[outcome] = self.preempt_stats.get(outcome, 0) + 1
 
     # ------------------------------------------------------------- adapters
     def _build_adapter_stack(self, named: Dict[str, str]):
@@ -988,7 +1147,8 @@ class BatchedEngine:
             cursor = pent["cursor"] + len(stoks)
             if self.max_seq_len - cursor >= need:
                 row_logits, row_cache = self._extend(
-                    self.params, self._lora_arg(), pent["cache"],
+                    self.params, self._lora_arg(),
+                    _pad_row(pent["cache"], self.max_seq_len),
                     jnp.asarray([stoks], jnp.int32),
                     jnp.asarray([smask], jnp.int32),
                     jnp.asarray([spos], jnp.int32),
@@ -1129,42 +1289,64 @@ class BatchedEngine:
             )
             self._slot_req[slot] = req
             self._decode_ready[slot] = True
+            self._note_admitted(slot)
             self._trace("admit", slot, plen, "dense")
             if self.tracing:
                 req.mark("admit", slot=slot, plen=plen, mode="dense")
             return True
 
-        hit = self._prefill_row_cached(ids, plen, n_prompt, req.adapter,
-                                       akey, budget_needed=max_new)
-        if hit is not None:
-            row_logits, row_cache, cursor = hit
-            max_new = max(1, min(max_new, self.max_seq_len - cursor))
-            blocks = self._alloc_blocks(cursor + max_new)
-            if blocks is None:
-                return False
-            try:
-                (self._cache, self._logits, self._pos, self._remaining,
-                 self._active, self._temps, self._top_ps, self._stops,
-                 self._adapter_idx, self._rng) = self._insert_paged(
-                    self._cache, self._logits, self._pos, self._remaining,
-                    self._active, self._temps, self._top_ps, self._stops,
-                    self._adapter_idx, self._rng,
-                    jnp.asarray(slot, jnp.int32), self._table_row(blocks),
-                    row_cache, row_logits, jnp.asarray(cursor, jnp.int32),
-                    *self._arm_args(req, n_prompt, max_new),
-                )
-            except Exception:
-                self._allocator.free(blocks)
-                raise
-            self._slot_blocks[slot] = blocks
-            self._slot_req[slot] = req
-            self._decode_ready[slot] = True
-            self._trace("admit", slot, plen, "cache")
-            if self.tracing:
-                req.mark("admit", slot=slot, plen=plen, mode="cache")
-            return True
+        if self.cow:
+            # COW prefix blocks: a cache hit maps SHARED physical blocks
+            # into this slot's table (copying only the partial tail block)
+            # instead of the dense-row copy + re-insert below. None = no
+            # usable entry — fall through to the cold chunked path.
+            handled = self._admit_cow(req, slot, ids, plen, n_prompt,
+                                      max_new, akey)
+            if handled is not None:
+                return handled
+        else:
+            hit = self._prefill_row_cached(ids, plen, n_prompt, req.adapter,
+                                           akey, budget_needed=max_new)
+            if hit is not None:
+                row_logits, row_cache, cursor = hit
+                max_new = max(1, min(max_new, self.max_seq_len - cursor))
+                blocks = self._alloc_blocks(
+                    self._reserve_depth(cursor, max_new))
+                if blocks is None:
+                    return False
+                try:
+                    # scrub first: stored rows are TRIMMED to their live
+                    # cursor now, so the insert no longer doubles as the
+                    # whole-table recycled-position scrub
+                    self._cache["pos"] = self._cache["pos"].at[
+                        jnp.asarray(blocks, jnp.int32)].set(POS_SENTINEL)
+                    (self._cache, self._logits, self._pos, self._remaining,
+                     self._active, self._temps, self._top_ps, self._stops,
+                     self._adapter_idx, self._rng) = self._insert_paged(
+                        self._cache, self._logits, self._pos,
+                        self._remaining, self._active, self._temps,
+                        self._top_ps, self._stops,
+                        self._adapter_idx, self._rng,
+                        jnp.asarray(slot, jnp.int32),
+                        self._table_row(blocks),
+                        row_cache, row_logits,
+                        jnp.asarray(cursor, jnp.int32),
+                        *self._arm_args(req, n_prompt, max_new),
+                    )
+                except Exception:
+                    self._allocator.free(blocks)
+                    raise
+                self._slot_blocks[slot] = blocks
+                self._slot_req[slot] = req
+                self._decode_ready[slot] = True
+                self._slot_demand[slot] = self._eager_demand(cursor, max_new)
+                self._note_admitted(slot)
+                self._trace("admit", slot, plen, "cache")
+                if self.tracing:
+                    req.mark("admit", slot=slot, plen=plen, mode="cache")
+                return True
 
-        blocks = self._alloc_blocks(plen + max_new)
+        blocks = self._alloc_blocks(self._reserve_depth(plen, max_new))
         if blocks is None:
             return False
         try:
@@ -1182,16 +1364,174 @@ class BatchedEngine:
         self._slot_blocks[slot] = blocks
         self._slot_req[slot] = req
         self._decode_ready[slot] = False
+        self._slot_demand[slot] = self._eager_demand(plen, max_new)
         self._pending[slot] = {
             "req": req, "ids": ids, "mask": mask, "positions": positions,
             "plen": plen, "n_prompt": n_prompt, "max_new": max_new,
-            "adapter": req.adapter, "done": 0,
+            "adapter": req.adapter, "done": 0, "base": 0,
             "key": self._prefix_key(ids, plen, n_prompt, akey),
         }
+        self._note_admitted(slot)
         self._trace("admit", slot, plen, "chunked")
         if self.tracing:
             req.mark("admit", slot=slot, plen=plen, mode="chunked")
         return True
+
+    def _reserve_depth(self, cursor: int, max_new: int) -> int:
+        """Token depth admission reserves blocks for: the full decode
+        extent eagerly, or just the context plus one scheduler tick's
+        advance when overcommitted (the grower keeps the table ahead of
+        the cursor from there; the spec overshoot rides on top inside
+        ``_alloc_blocks``)."""
+        if self.overcommit:
+            return cursor + min(max_new, self._tick_advance)
+        return cursor + max_new
+
+    def _eager_demand(self, cursor: int, max_new: int) -> int:
+        """Blocks the overcommit-OFF engine would reserve for this session
+        — the dtx_serving_kv_overcommit_ratio numerator."""
+        return blocks_for_depth(cursor + max_new, self.block_size,
+                                overshoot=self._spec_overshoot,
+                                cap_depth=self.max_seq_len)
+
+    def _note_admitted(self, slot: int):
+        live = sum(1 for r in self._slot_req if r is not None)
+        if live > self.kv_stats["peak_sessions"]:
+            self.kv_stats["peak_sessions"] = live
+
+    # ------------------------------------------------- COW prefix blocks
+    def _admit_cow(self, req: Request, slot: int, ids, plen: int,
+                   n_prompt: int, max_new: int, akey) -> Optional[bool]:
+        """Overcommit admission through the prefix cache: an exact hit
+        maps the entry's refcounted blocks into this slot's table and arms
+        decode directly (no prefill, no dense-row traffic); a strict-prefix
+        hit maps the shared prefix and chunk-prefills only the suffix in
+        place. Returns True (admitted) / False (blocks exhausted — the
+        FIFO head waits) / None (no usable entry — cold path). The same
+        decode-room gates as the dense-row path apply, so reuse never
+        shrinks the budget below what a cache-cold server would grant."""
+        used, _ = key = self._prefix_key(ids, plen, n_prompt, akey)
+        need = min(max_new, self.max_seq_len - plen)
+        ent = self._prefix.get(key)
+        if (ent is not None and ent.get("blocks") is not None
+                and self.max_seq_len - ent["cursor"] >= need):
+            m = max(1, min(max_new, self.max_seq_len - ent["cursor"]))
+            ok = self._cow_map(req, slot, ent, n_prompt, m,
+                               suffix=None, key=key)
+            if ok:
+                self.prefill_stats["reuse"] += 1
+                self._trace("admit", slot, plen, "cow")
+                if self.tracing:
+                    req.mark("admit", slot=slot, plen=plen, mode="cow")
+            return ok
+        pkey, pent = self._prefix.longest_prefix(used, akey)
+        if pent is not None and pent.get("blocks") is not None:
+            n_pref = len(pkey[0])
+            suffix = list(used[n_pref:])
+            pad = (-len(suffix)) % DECODE_BUCKET
+            eos = self.tokenizer.eos_token_id or 0
+            sfx = {"ids": [eos] * pad + suffix,
+                   "mask": [0] * pad + [1] * len(suffix),
+                   "positions": [0] * pad + list(range(n_pref, len(used)))}
+            cursor = pent["cursor"] + len(sfx["ids"])
+            if self.max_seq_len - cursor >= need:
+                ok = self._cow_map(req, slot, pent, n_prompt, max_new,
+                                   suffix=sfx, key=key)
+                if ok:
+                    self.prefill_stats["extend"] += 1
+                    self._trace("admit", slot, plen, "cow_extend")
+                    if self.tracing:
+                        req.mark("admit", slot=slot, plen=plen,
+                                 mode="cow_extend")
+                return ok
+        return None
+
+    def _cow_map(self, req: Request, slot: int, ent: dict, n_prompt: int,
+                 max_new: int, suffix: Optional[dict], key) -> bool:
+        """Install a prefix-cache BLOCK entry into ``slot``: incref and map
+        the entry's full blocks, copy its partial tail block (the at-most-
+        once COW event — decode only appends at the cursor, and the cursor
+        sits inside that block), allocate fresh blocks for the decode/
+        suffix extent, and either arm decode (exact hit) or register the
+        suffix for chunked prefill. False = pool can't cover the fresh
+        blocks; nothing held."""
+        base = ent["cursor"]  # host int: _cow_store stores python scalars
+        full, rem = ent["full"], ent["rem"]
+        shared = list(ent["blocks"][:full])
+        suffix_len = len(suffix["ids"]) if suffix else 0
+        final = base + suffix_len
+        target = blocks_for_depth(
+            self._reserve_depth(final, max_new), self.block_size,
+            overshoot=self._spec_overshoot, cap_depth=self.max_seq_len)
+        own = self._allocator.alloc(target - full)  # >= 1: max_new >= 1
+        if own is None:
+            return False
+        self._allocator.incref(shared)
+        blocks = shared + own
+        try:
+            self._cache["pos"] = self._cache["pos"].at[
+                jnp.asarray(own, jnp.int32)].set(POS_SENTINEL)
+            if rem:
+                self._cache = self._copy_block(
+                    self._cache, jnp.asarray(ent["blocks"][full], jnp.int32),
+                    jnp.asarray(own[0], jnp.int32),
+                    jnp.asarray(rem, jnp.int32))
+            self._cache["block_tables"] = self._cache["block_tables"].at[
+                slot].set(self._table_row(blocks))
+            self._cache["len"] = self._cache["len"].at[slot].set(base)
+            if suffix is None:
+                (self._logits, self._pos, self._remaining, self._active,
+                 self._temps, self._top_ps, self._stops, self._adapter_idx,
+                 self._rng) = self._activate(
+                    self._logits, self._pos, self._remaining, self._active,
+                    self._temps, self._top_ps, self._stops,
+                    self._adapter_idx, self._rng,
+                    jnp.asarray(slot, jnp.int32), ent["logits"],
+                    *self._arm_args(req, n_prompt, max_new),
+                )
+        except Exception:
+            self._allocator.free(blocks)
+            raise
+        self._slot_blocks[slot] = blocks
+        self._slot_req[slot] = req
+        self._slot_demand[slot] = self._eager_demand(final, max_new)
+        if suffix is None:
+            self._decode_ready[slot] = True
+        else:
+            self._decode_ready[slot] = False
+            self._pending[slot] = {
+                "req": req, "ids": suffix["ids"], "mask": suffix["mask"],
+                "positions": suffix["positions"],
+                "plen": len(suffix["ids"]), "n_prompt": n_prompt,
+                "max_new": max_new, "adapter": req.adapter, "done": 0,
+                "key": key, "base": base,
+            }
+        self._note_admitted(slot)
+        return True
+
+    def _cow_store(self, slot: int, key, cursor: int, row_logits):
+        """Publish a freshly-prefilled slot's prefix into the cache as a
+        refcounted BLOCK entry: full blocks are shared as-is (their content
+        and global pos-pool rows never change again — writes only happen
+        at and past the cursor), the partial tail block is copied once so
+        the donor's continued decode cannot leak into the entry. A pool
+        too tight for the tail copy skips caching: serving beats caching."""
+        full, rem = divmod(cursor, self.block_size)
+        blocks = self._slot_blocks[slot]
+        shared = list(blocks[:full])
+        ent_blocks = list(shared)
+        if rem:
+            tail = self._allocator.alloc(1)
+            if tail is None:
+                return
+            self._cache = self._copy_block(
+                self._cache, jnp.asarray(blocks[full], jnp.int32),
+                jnp.asarray(tail[0], jnp.int32), jnp.asarray(rem, jnp.int32))
+            ent_blocks = shared + tail
+        self._allocator.incref(shared)
+        self._prefix.put(key, {"blocks": ent_blocks, "full": full,
+                               "rem": rem, "cursor": cursor,
+                               "logits": row_logits})
 
     def _alloc_blocks(self, depth: int) -> Optional[List[int]]:
         from datatunerx_tpu.ops.paged_attention import blocks_for_depth
@@ -1266,6 +1606,14 @@ class BatchedEngine:
                 if req is None:
                     self._requeue_front(parked)
                     return
+                if (self._preempted
+                        and self._preempted[0]["req"].seq < req.seq):
+                    # strict FIFO across parked populations: a preempted
+                    # session older than this cold request resumes first —
+                    # admitting the younger one would hand it the very
+                    # blocks the parked head is waiting for
+                    self._requeue_front(parked + [req])
+                    return
                 try:
                     ok = self._admit(req, slot)
                 except Exception as e:  # noqa: BLE001 — fail request, not loop
@@ -1339,7 +1687,11 @@ class BatchedEngine:
     def _finish_prefill(self, slot: int, st: dict, row_logits):
         del self._pending[slot]
         req = st["req"]
-        max_new = max(1, min(st["max_new"], self.max_seq_len - st["plen"]))
+        # COW suffix prefills start at a shared-prefix base cursor; the
+        # decode extent is measured from the FINAL cursor, exactly like
+        # the dense extension path's clamp
+        cursor = st.get("base", 0) + st["plen"]
+        max_new = max(1, min(st["max_new"], self.max_seq_len - cursor))
         (self._logits, self._pos, self._remaining, self._active, self._temps,
          self._top_ps, self._stops, self._adapter_idx, self._rng) = \
             self._activate(
@@ -1349,14 +1701,26 @@ class BatchedEngine:
                 *self._arm_args(req, st["n_prompt"], max_new),
             )
         self._decode_ready[slot] = True
-        self.prefill_stats["full"] += 1
+        if not st.get("base"):
+            # suffix extensions already counted as "extend" at admission
+            self.prefill_stats["full"] += 1
         if self._prefix is not None:
-            # export the slot's blocks as a dense row so later prompts can
-            # reuse/extend this prefix exactly like in dense mode
-            row = self._extract(self._cache, jnp.asarray(slot, jnp.int32),
-                                jnp.asarray(st["plen"], jnp.int32))
-            self._prefix.put(st["key"], {"cache": row, "logits": row_logits,
-                                         "cursor": st["plen"]})
+            if self.cow:
+                # publish refcounted blocks — no dense-row materialisation
+                self._cow_store(slot, st["key"], cursor, row_logits)
+            else:
+                # export the slot's blocks as a dense row so later prompts
+                # can reuse/extend this prefix exactly like in dense mode —
+                # TRIMMED to the live cursor (PR 12 row_trim math inside
+                # paged_extract_row), so short prefixes stop paying a full
+                # max_seq_len gather per insert
+                row = self._extract(self._cache,
+                                    jnp.asarray(slot, jnp.int32),
+                                    jnp.asarray(cursor, jnp.int32),
+                                    width=cursor)
+                self._prefix.put(st["key"], {"cache": row,
+                                             "logits": row_logits,
+                                             "cursor": cursor})
         self._trace("activate", slot)
         if self.tracing:
             req.mark("activate", slot=slot)
@@ -1529,10 +1893,30 @@ class BatchedEngine:
             from datatunerx_tpu.serving.migration import MIGRATED_SESSION
 
             self._complete(req, error=f"{MIGRATED_SESSION}: slot exported")
+        if want is None and self._preempted:
+            # preemption-parked sessions are in flight too — a drain that
+            # missed them would strand their clients. Their payloads
+            # already exist (raw numpy bodies): re-encode for the wire,
+            # terminate with the migrated marker so the gateway splices.
+            from datatunerx_tpu.serving.migration import (
+                MIGRATED_SESSION,
+                encode_payload,
+            )
+
+            parked, self._preempted = self._preempted, []
+            for entry in parked:
+                req = entry["req"]
+                sessions.append(encode_payload(entry["payload"]))
+                self._count_mig("export", "ok")
+                self._trace("export_parked", req.seq)
+                if self.tracing:
+                    req.mark("export", parked=True)
+                self._complete(
+                    req, error=f"{MIGRATED_SESSION}: parked session exported")
         return {"sessions": sessions, "skipped": skipped}
 
     def _export_slot(self, slot: int, req: Request,
-                     wire: Optional[str]) -> dict:
+                     wire: Optional[str], b64: bool = True) -> dict:
         from datatunerx_tpu.serving import migration as mig
 
         # the migration path's designed sync point: the slot's scalar
@@ -1541,8 +1925,13 @@ class BatchedEngine:
             (self._cache["len"][slot], self._pos[slot],
              self._remaining[slot], self._rng[slot], self._logits[slot]))
         if self.paged:
+            # gather only the live prefix's blocks (bucket-rounded so the
+            # static-width program count stays bounded) — the wire pays
+            # cursor columns, not a max_seq_len row
+            w = min(-(-max(1, int(cursor)) // DECODE_BUCKET) * DECODE_BUCKET,  # dtxlint: disable=DTX001 — cursor is host (device_get above)
+                    self.max_seq_len)
             row = self._extract(self._cache, jnp.asarray(slot, jnp.int32),
-                                jnp.asarray(cursor, jnp.int32))
+                                jnp.asarray(cursor, jnp.int32), width=w)
         else:
             row = {"k": self._cache["k"][:, slot:slot + 1],
                    "v": self._cache["v"][:, slot:slot + 1],
@@ -1561,7 +1950,7 @@ class BatchedEngine:
                      "temperature": req.temperature, "top_p": req.top_p,
                      "seed": req.seed, "stop_ids": list(req.stop_ids)},
             row=row, cursor=cursor, pos=pos, remaining=remaining,
-            rng=rng, logits=logits, wire=wire)
+            rng=rng, logits=logits, wire=wire, b64=b64)
 
     def _do_import(self, cmd: dict) -> dict:
         from datatunerx_tpu.serving import migration as mig
@@ -1612,11 +2001,14 @@ class BatchedEngine:
         blocks: Optional[List[int]] = None
         try:
             if self.paged:
-                blocks = self._alloc_blocks(cursor + remaining)
+                # overcommit engines import lazily too: the grower extends
+                # the table as the resumed decode advances
+                depth = self._reserve_depth(cursor, remaining)
+                blocks = self._alloc_blocks(depth)
                 if blocks is None:
                     raise _RetryLater(
                         "kv blocks exhausted "
-                        f"(need {-(-(cursor + remaining) // self.block_size)}"
+                        f"(need {-(-depth // self.block_size)}"
                         f", free {self._allocator.free_count})")
             row = mig.unpack_kv_row(payload["kv"], full_width=W,
                                     quantize=self.kv_quant)
@@ -1679,6 +2071,9 @@ class BatchedEngine:
         self._slot_blocks[slot] = blocks or []
         self._slot_req[slot] = req
         self._decode_ready[slot] = True
+        if self.paged:
+            self._slot_demand[slot] = self._eager_demand(cursor, remaining)
+        self._note_admitted(slot)
         self._count_mig("import", "ok")
         self._trace("import", slot, cursor)
         if self.tracing:
@@ -1691,10 +2086,11 @@ class BatchedEngine:
                 "remaining": remaining, "adapter": name,
                 "text_so_far": text, "_request": req}
 
-    def _release_slot(self, slot: int):
+    def _release_slot(self, slot: int, note_session: bool = True):
         self._slot_req[slot] = None
         self._pending.pop(slot, None)
         self._decode_ready[slot] = False
+        self._slot_demand[slot] = 0
         if self.spec is not None:
             self._spec_form[slot] = False
             self._spec_primed[slot] = False
@@ -1704,11 +2100,236 @@ class BatchedEngine:
             self.adapter_registry.release(name)
         blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
         if blocks:
+            if note_session:
+                # tables only grow, so the count at release IS the
+                # session's peak physical footprint (bench p50/p95 source);
+                # preemptions pass False — the session isn't over
+                self.kv_stats["session_blocks"].append(len(blocks))
             # clear the table FIRST: a masked decode write from this slot
             # must never land in a block the allocator has already re-issued
             self._cache["block_tables"] = \
                 self._cache["block_tables"].at[slot].set(-1)
             self._allocator.free(blocks)
+
+    # --------------------------------------------- overcommit: grow/preempt
+    def _grow_tick(self):
+        """On-demand block growth, run between prefill and decode: keep
+        every decode-ready slot's table covering the lanes the next tick
+        can write (cursor + one chunk/verify advance + the spec write
+        overshoot). A slot the pool cannot serve — even after reclaiming
+        prefix-cache entries and preempting younger sessions — parks
+        ITSELF host-side, unless it is the oldest live session: the oldest
+        is never preempted and always claims what reclamation frees, which
+        is the forward-progress guarantee."""
+        if not self.overcommit:
+            return
+        ready = [s for s in range(self.slots)
+                 if self._decode_ready[s] and self._slot_req[s] is not None]
+        if not ready:
+            return
+        # tiny [S]-int32 reads at the tick's designed sync point
+        lens = np.asarray(self._cache["len"])  # dtxlint: disable=DTX001
+        rem = np.asarray(self._remaining)  # dtxlint: disable=DTX001
+        ready.sort(key=lambda s: self._slot_req[s].seq)
+        for slot in ready:
+            req = self._slot_req[slot]
+            if req is None:
+                continue  # preempted by an older slot's reclaim this pass
+            advance = min(self._tick_advance, max(1, int(rem[slot])))  # dtxlint: disable=DTX001 — host numpy from this tick's sync point
+            depth = min(int(lens[slot]) + advance + self._spec_overshoot,  # dtxlint: disable=DTX001 — host numpy from this tick's sync point
+                        self.max_seq_len)
+            need = (blocks_for_depth(depth, self.block_size)
+                    - len(self._slot_blocks[slot]))
+            while need > 0:
+                got = self._allocator.alloc(need)
+                if got is not None:
+                    self._install_growth(slot, got)
+                    break
+                if self._reclaim_for(req):
+                    continue
+                if not self._is_oldest_live(req):
+                    self._preempt_slot(slot)
+                break
+
+    def _is_oldest_live(self, req: Request) -> bool:
+        seqs = [r.seq for r in self._slot_req if r is not None]
+        return bool(seqs) and req.seq == min(seqs)
+
+    def _reclaim_for(self, req: Request) -> bool:
+        """Free blocks for ``req``'s growth, cheapest casualty first:
+        (1) drop an LRU prefix-cache block entry (a performance tier, not
+        a session), (2) preempt the youngest strictly-younger decode
+        session (it parks host-side and resumes token-exactly), (3)
+        un-admit the youngest strictly-younger chunk-prefilling request
+        (incomplete KV cannot export — it re-queues cold). False = nothing
+        strictly younger left to give."""
+        if self._prefix is not None:
+            ent = self._prefix.pop_lru_block_entry()
+            if ent is not None:
+                self._allocator.free(ent["blocks"])
+                return True
+        victims = [s for s in range(self.slots)
+                   if self._decode_ready[s]
+                   and self._slot_req[s] is not None
+                   and self._slot_req[s].seq > req.seq]
+        if victims:
+            self._preempt_slot(
+                max(victims, key=lambda s: self._slot_req[s].seq))
+            return True
+        pend = [s for s in list(self._pending)
+                if self._pending[s]["req"].seq > req.seq]
+        if pend:
+            self._unadmit_pending(
+                max(pend, key=lambda s: self._pending[s]["req"].seq))
+            return True
+        return False
+
+    def _install_growth(self, slot: int, new_blocks: List[int]):
+        blocks = self._slot_blocks[slot]
+        blocks.extend(new_blocks)
+        arr = jnp.asarray(new_blocks, jnp.int32)
+        # scrub the recycled blocks' positions BEFORE the table reveals
+        # them to attention (same contract as cold admission)
+        self._cache["pos"] = self._cache["pos"].at[arr].set(POS_SENTINEL)
+        self._cache["block_tables"] = self._cache["block_tables"].at[
+            slot].set(self._table_row(blocks))
+        self._trace("grow", slot, len(new_blocks))
+
+    def _preempt_slot(self, slot: int):
+        """Park a decode session host-side: settle (spec), export its
+        dtx-kv-session payload (raw numpy bodies — no base64 for
+        in-process parking), deactivate the slot ON DEVICE, and release
+        everything it held. The Request object stays live (same stream
+        queue, same done event): resume re-installs the KV into a fresh
+        slot and keeps pushing tokens to the same consumer, so the client
+        never observes the preemption — zero re-prefill, zero drop."""
+        req = self._slot_req[slot]
+        if self.spec is not None and self._spec_form[slot]:
+            self._spec_settle_slot(slot)
+        payload = self._export_slot(slot, req, None, b64=False)
+        self._release_slot(slot, note_session=False)
+        # the slot is still ACTIVE on device (only the decode kernel
+        # deactivates slots itself) — clear the mask and budget NOW, or an
+        # interleaved chunk would keep sampling it and write a stale token
+        # through the next tenant's freshly-installed table
+        self._active = self._active.at[slot].set(False)
+        self._remaining = self._remaining.at[slot].set(0)
+        self._preempted.append({"payload": payload, "req": req})
+        self._preempted.sort(key=lambda e: e["req"].seq)
+        self._count_preempt("exported")
+        self._trace("preempt", slot, req.seq)
+        if self.tracing:
+            req.mark("preempt", slot=slot)
+
+    def _unadmit_pending(self, slot: int):
+        """Roll a chunk-prefilling admission back to the cold queue: its
+        KV is incomplete so it cannot export; blocks and adapter pin are
+        released and the request re-queues at its FIFO position (seq
+        order). It re-prefills on readmission — the only preemption
+        outcome that repays work, reachable only when nothing younger is
+        decoding."""
+        req = self._pending[slot]["req"]
+        self._release_slot(slot, note_session=False)
+        self._waiting_front = collections.deque(
+            sorted([*self._waiting_front, req], key=lambda r: r.seq))
+        self._count_preempt("requeued_prefill")
+        self._trace("preempt_prefill", slot, req.seq)
+        if self.tracing:
+            req.mark("preempt", slot=slot, kind="prefill")
+
+    def _resume_preempted_tick(self):
+        """Re-admit preemption-parked sessions, oldest first, ahead of the
+        cold queue (the admission gate keeps anything younger waiting, so
+        strict FIFO fairness is preserved across the park). A head that
+        cannot resume yet (no free slot / blocks / adapter mid-load) parks
+        everything behind it until the next tick."""
+        while self._preempted:
+            entry = self._preempted[0]
+            try:
+                ok = self._resume_one(entry)
+            except Exception as e:  # noqa: BLE001 — fail the session, not the loop
+                self._preempted.pop(0)
+                self._count_preempt("error")
+                self._complete(entry["req"], error=str(e))
+                continue
+            if not ok:
+                return
+            self._preempted.pop(0)
+
+    def _resume_one(self, entry: dict) -> bool:
+        from datatunerx_tpu.serving import migration as mig
+
+        req = entry["req"]
+        payload = entry["payload"]
+        slot = next((i for i in range(self.slots)
+                     if self._slot_req[i] is None), None)
+        if slot is None:
+            return False
+        name = req.adapter_name
+        idx, pinned = 0, False
+        if name:
+            if self.adapter_registry is not None:
+                acquired = self.adapter_registry.acquire(name,
+                                                         count_hit=False)
+                if acquired is None:
+                    return False  # mid-load / pool pinned: retry next tick
+                idx, pinned = acquired, True
+            else:
+                idx = self._static_adapter_ids.get(name, req.adapter)
+        cursor = int(payload["cursor"])  # dtxlint: disable=DTX001 — parked payloads carry host scalars
+        remaining = int(payload["remaining"])  # dtxlint: disable=DTX001 — parked payloads carry host scalars
+        blocks = None
+        try:
+            blocks = self._alloc_blocks(
+                self._reserve_depth(cursor, remaining))
+            if blocks is None and self._prefix is not None:
+                # prefix-cache entries are the cheapest reclaim here too
+                ent = self._prefix.pop_lru_block_entry()
+                if ent is not None:
+                    self._allocator.free(ent["blocks"])
+                    blocks = self._alloc_blocks(
+                        self._reserve_depth(cursor, remaining))
+            if blocks is None:
+                if pinned:
+                    self.adapter_registry.release(name)
+                return False
+            row = mig.unpack_kv_row(payload["kv"],
+                                    full_width=self.max_seq_len,
+                                    quantize=self.kv_quant)
+            row_logits = mig.unpack_logits(payload, self.cfg.vocab_size)
+            (self._cache, self._logits, self._pos, self._remaining,
+             self._active, self._temps, self._top_ps, self._stops,
+             self._adapter_idx, self._rng) = self._insert_paged(
+                self._cache, self._logits, self._pos, self._remaining,
+                self._active, self._temps, self._top_ps, self._stops,
+                self._adapter_idx, self._rng,
+                jnp.asarray(slot, jnp.int32), self._table_row(blocks),
+                row, row_logits, jnp.asarray(cursor, jnp.int32),
+                *self._arm_args(req, int(payload["pos"]), remaining),  # dtxlint: disable=DTX001 — parked payloads carry host scalars
+            )
+            # token-exact resume: restore the slot's LIVE rng stream in
+            # place of the seed-derived key the insert armed
+            self._rng = self._rng.at[slot].set(
+                jnp.asarray(payload["rng"], jnp.uint32))
+        except Exception:
+            if blocks:
+                self._allocator.free(blocks)
+            if pinned:
+                self.adapter_registry.release(name)
+            raise
+        req.adapter = idx
+        if pinned:
+            self._slot_adapter[slot] = name
+        self._slot_blocks[slot] = blocks
+        self._slot_req[slot] = req
+        self._decode_ready[slot] = True
+        self._slot_demand[slot] = self._eager_demand(cursor, remaining)
+        self._note_admitted(slot)
+        self._count_preempt("resumed")
+        self._trace("resume", slot, cursor)
+        if self.tracing:
+            req.mark("resume", slot=slot, cursor=cursor)
+        return True
 
     # ------------------------------------------------ speculative decoding
     def _spec_prime_slot(self, slot: int):
@@ -1898,8 +2519,10 @@ class BatchedEngine:
             # (its prefill budget was spent on the source replica), so it
             # outranks cold admissions for free slots
             self._service_migrations()
+            self._resume_preempted_tick()
             self._admit_waiting()
             self._prefill_tick()
+            self._grow_tick()
 
             if not any(self._decode_ready):
                 if self._pending:
@@ -2003,9 +2626,32 @@ class BatchedEngine:
         return req.tokens
 
     def _encode_chat(self, messages: List[dict]):
+        import json
+
         from datatunerx_tpu.serving.engine import encode_chat_messages
 
-        return encode_chat_messages(self.template, self.tokenizer, messages)
+        # tiny LRU keyed by the serialized messages: usage reporting (the
+        # serving response's prompt_tokens) and the in-process replica's
+        # calibration feedback re-encode the prompt a request already
+        # encoded — memoizing makes the count a dict hit instead of a
+        # second O(prompt) tokenizer pass on the serving hot path
+        try:
+            key = json.dumps(messages, sort_keys=True)
+        except (TypeError, ValueError):
+            return encode_chat_messages(self.template, self.tokenizer,
+                                        messages)
+        with self._encode_memo_lock:
+            hit = self._encode_memo.get(key)
+            if hit is not None:
+                self._encode_memo.move_to_end(key)
+                return hit
+        out = encode_chat_messages(self.template, self.tokenizer, messages)
+        with self._encode_memo_lock:
+            self._encode_memo[key] = out
+            self._encode_memo.move_to_end(key)
+            while len(self._encode_memo) > 32:
+                self._encode_memo.popitem(last=False)
+        return out
 
     def perplexity(self, prompt_ids: Sequence[int],
                    completion_ids: Sequence[int], adapter: str = "") -> dict:
@@ -2108,3 +2754,9 @@ class BatchedEngine:
             cmd["_error"] = "engine shut down"
             cmd["_refused"] = False
             cmd["_done"].set()
+        # preemption-parked sessions can never resume now — fail their
+        # requests so consumers don't sit out their full wait timeout
+        parked = list(self._preempted)
+        self._preempted = []  # dtxlint: disable=DTX006 — owner thread already joined
+        for entry in parked:
+            entry["req"].finish(error="engine shut down")

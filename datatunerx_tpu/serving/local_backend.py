@@ -76,7 +76,8 @@ class LocalServingBackend:
             # both accept these); paged_kernel rides along so an operator
             # can pin the decode path per deployment ("auto" is default
             # and needs no spec entry)
-            for key in ("kv_block_size", "kv_blocks", "prefill_chunk",
+            for key in ("kv_block_size", "kv_blocks", "kv_overcommit",
+                        "prefill_chunk",
                         "prefill_token_budget", "adapter_pool",
                         "adapter_rank_max", "paged_kernel",
                         "spec_draft_config", "spec_k", "spec_mode"):

@@ -54,14 +54,44 @@ def _b64(arr: np.ndarray) -> str:
     return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode()
 
 
-def _unb64(data: str, dtype, shape) -> np.ndarray:
-    buf = base64.b64decode(data.encode())
-    arr = np.frombuffer(buf, dtype=dtype)
+def _body(arr, b64: bool):
+    """One array body: base64 text for the JSON wire, or the host numpy
+    array itself for payloads that never leave the process (the engine's
+    preemption parking — paying a base64 round-trip to sit in a host list
+    would be pure overhead)."""
+    host = np.asarray(arr)  # dtxlint: disable=DTX001 — migration serialization point
+    return _b64(host) if b64 else host
+
+
+def _unb64(data, dtype, shape) -> np.ndarray:
+    if isinstance(data, np.ndarray):  # raw in-process body (b64=False)
+        arr = np.ascontiguousarray(data).reshape(-1).view(np.dtype(dtype))
+    else:
+        arr = np.frombuffer(base64.b64decode(data.encode()), dtype=dtype)
     if arr.size != int(np.prod(shape)):
         raise ValueError(
             f"kv payload body holds {arr.size} elements, shape {shape} "
             f"needs {int(np.prod(shape))}")
     return arr.reshape(shape)
+
+
+def encode_payload(payload: dict) -> dict:
+    """Make a payload JSON-wire-safe: base64-encode any raw numpy bodies a
+    ``b64=False`` (in-process) export left behind. Idempotent — already-
+    encoded payloads pass through untouched — so the export surface can
+    apply it unconditionally before a payload crosses the admin HTTP
+    wire (e.g. a gateway drain exporting preemption-parked sessions)."""
+    out = dict(payload)
+    if isinstance(out.get("logits"), np.ndarray):
+        out["logits"] = _b64(np.asarray(out["logits"], np.float32))
+    kv = out.get("kv")
+    if isinstance(kv, dict):
+        kv = dict(kv)
+        for key in ("k", "v", "pos", "k_scale", "v_scale"):
+            if isinstance(kv.get(key), np.ndarray):
+                kv[key] = _b64(kv[key])
+        out["kv"] = kv
+    return out
 
 
 def model_signature(cfg, kv_quant: Optional[str]) -> dict:
@@ -89,13 +119,16 @@ def check_signature(payload: dict, cfg) -> None:
             f"unsupported session payload version {payload.get('version')!r}")
 
 
-def pack_kv_row(row: Dict, cursor: int, wire: str) -> dict:
+def pack_kv_row(row: Dict, cursor: int, wire: str, b64: bool = True) -> dict:
     """A dense row cache (``paged_extract_row`` output or a dense-cache
     slot slice) → JSON-safe wire doc, trimmed to the live ``cursor``.
 
     ``wire`` is "int8" or "bf16"; int8 input rows (kv_quant caches) are
     shipped as-is under "int8" (exact), and a bf16 row asked for "int8"
-    goes through kv_quantize (the over-the-wire compression path)."""
+    goes through kv_quantize (the over-the-wire compression path).
+    ``b64=False`` keeps the array bodies as host numpy (in-process
+    payloads: engine preemption parking); ``encode_payload`` upgrades
+    them to base64 if they ever need the wire."""
     row = row_trim(row, max(1, cursor))
     k, v = row["k"], row["v"]
     quantized_cache = "k_scale" in row
@@ -118,12 +151,13 @@ def pack_kv_row(row: Dict, cursor: int, wire: str) -> dict:
     doc = {
         "wire": wire, "width": int(W), "layers": int(L),
         "kv_heads": int(KV), "head_dim": int(d),
-        "k": _b64(k_np), "v": _b64(v_np),
-        "pos": _b64(pos_np),
+        "k": _b64(k_np) if b64 else k_np,
+        "v": _b64(v_np) if b64 else v_np,
+        "pos": _b64(pos_np) if b64 else pos_np,
     }
     if wire == "int8":
-        doc["k_scale"] = _b64(np.asarray(ks, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
-        doc["v_scale"] = _b64(np.asarray(vs, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
+        doc["k_scale"] = _body(np.asarray(ks, np.float32), b64)  # dtxlint: disable=DTX001 — migration serialization point
+        doc["v_scale"] = _body(np.asarray(vs, np.float32), b64)  # dtxlint: disable=DTX001 — migration serialization point
     return doc
 
 
@@ -178,8 +212,8 @@ def unpack_kv_row(doc: dict, full_width: int,
     return row
 
 
-def pack_logits(logits) -> str:
-    return _b64(np.asarray(logits, np.float32))  # dtxlint: disable=DTX001 — migration serialization point
+def pack_logits(logits, b64: bool = True):
+    return _body(np.asarray(logits, np.float32), b64)  # dtxlint: disable=DTX001 — migration serialization point
 
 
 def unpack_logits(payload: dict, vocab: int) -> jnp.ndarray:
@@ -188,14 +222,16 @@ def unpack_logits(payload: dict, vocab: int) -> jnp.ndarray:
 
 def build_payload(cfg, kv_quant: Optional[str], request: dict, row: Dict,
                   cursor, pos, remaining, rng, logits,
-                  wire: Optional[str] = None) -> dict:
+                  wire: Optional[str] = None, b64: bool = True) -> dict:
     """Assemble the full wire payload for one exported session.
 
     ``request`` carries the Request's host-side fields (trace_id, adapter
     name, prompt/token lists, sampling params); ``cursor``/``pos``/
     ``remaining``/``rng``/``logits`` are the slot's decode-state scalars,
     already device_get'd by the engine; ``row`` is the (device) dense KV
-    row this function trims, encodes, and pulls to host."""
+    row this function trims, encodes, and pulls to host. ``b64=False``
+    keeps array bodies as raw numpy for payloads that stay in-process
+    (engine preemption parking); ``encode_payload`` makes them wire-safe."""
     cursor = int(cursor)
     default_wire = "int8" if kv_quant == "int8" else "bf16"
     return {
@@ -203,8 +239,8 @@ def build_payload(cfg, kv_quant: Optional[str], request: dict, row: Dict,
         **request,
         "pos": int(pos), "remaining": int(remaining), "cursor": cursor,
         "rng": [int(x) for x in np.asarray(rng, np.uint32)],
-        "logits": pack_logits(logits),
-        "kv": pack_kv_row(row, cursor, wire or default_wire),
+        "logits": pack_logits(logits, b64=b64),
+        "kv": pack_kv_row(row, cursor, wire or default_wire, b64=b64),
         "model_sig": model_signature(cfg, kv_quant),
     }
 

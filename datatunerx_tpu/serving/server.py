@@ -142,11 +142,44 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
                             "Free paged KV-cache blocks.")
     blocks_total = reg.gauge("dtx_serving_kv_blocks_capacity",
                              "Total paged KV-cache blocks.")
+    blocks_reserved = reg.gauge("dtx_serving_kv_blocks_reserved",
+                                "Allocated paged KV-cache blocks (slots' "
+                                "tables + COW prefix-cache entries).")
+    block_size_g = reg.gauge("dtx_serving_kv_block_size",
+                             "Tokens per paged KV block — the unit the "
+                             "gateway's fleet-true admission prices "
+                             "admits in.")
+    over_ratio = reg.gauge("dtx_serving_kv_overcommit_ratio",
+                           "Live sessions' eager-equivalent block demand "
+                           "over the physical pool (> 1 = overcommitted; "
+                           "only meaningful with --kv_overcommit on).")
+    preempt = reg.counter("dtx_serving_preemptions_total",
+                          "KV-overcommit preemptions by outcome (exported "
+                          "= session parked host-side, resumed = parked "
+                          "session re-admitted token-exactly, "
+                          "requeued_prefill = mid-prefill admission "
+                          "rolled back to the cold queue).")
     blocks_free.clear()
     blocks_total.clear()
+    blocks_reserved.clear()
+    block_size_g.clear()
+    over_ratio.clear()
+    preempt.clear()
     if getattr(eng, "total_kv_blocks", None):
         blocks_free.set(eng.free_kv_blocks)
         blocks_total.set(eng.total_kv_blocks)
+        reserved = getattr(eng, "kv_blocks_reserved", None)
+        if reserved is None:
+            reserved = eng.total_kv_blocks - eng.free_kv_blocks
+        blocks_reserved.set(reserved)
+        block_size_g.set(getattr(eng, "block_size", 0) or 0)
+        ratio = getattr(eng, "kv_overcommit_ratio", None)
+        if ratio is not None:
+            over_ratio.set(ratio)
+    pstats = getattr(eng, "preempt_stats", None)
+    if isinstance(pstats, dict):
+        for outcome, np_ in sorted(pstats.items()):
+            preempt.set(np_, {"outcome": outcome})
     # dynamic adapter pool (datatunerx_tpu/adapters/): occupancy, the
     # residency set the gateway's cache-locality routing scrapes, and
     # per-adapter traffic. Declared/cleared on every scrape so a swapped
@@ -634,10 +667,12 @@ class Handler(BaseHTTPRequestHandler):
             if trace and getattr(STATE.engine, "trace_store", None) is not None:
                 kwargs["trace_id"] = trace
             if req.get("stream"):
-                self._stream_chat(messages, kwargs)
+                self._stream_chat(messages, kwargs,
+                                  usage=self._prompt_usage(messages))
                 return
+            usage = self._prompt_usage(messages)
             text = STATE.engine.chat(messages, **kwargs)
-            self._json(200, {
+            body = {
                 "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
                 "object": "chat.completion",
                 "created": int(time.time()),
@@ -647,9 +682,41 @@ class Handler(BaseHTTPRequestHandler):
                     "message": {"role": "assistant", "content": text},
                     "finish_reason": "stop",
                 }],
-            })
+            }
+            if usage is not None:
+                usage["completion_tokens"] = self._count_tokens(text)
+                usage["total_tokens"] = (usage["prompt_tokens"]
+                                         + usage["completion_tokens"])
+                body["usage"] = usage
+            self._json(200, body)
         except Exception as e:  # noqa: BLE001 - serving must answer, not die
             self._json(500, {"error": str(e)})
+
+    @staticmethod
+    def _prompt_usage(messages) -> Optional[dict]:
+        """Replica-side tokenized prompt length — the TRUTHFUL count the
+        gateway's admission calibrates with (the chars-per-token heuristic
+        is a guess; this is what prefill actually pays). None on engines
+        without the chat encoder (duck-typed stand-ins)."""
+        enc = getattr(STATE.engine, "_encode_chat", None)
+        if not callable(enc):
+            return None
+        try:
+            return {"prompt_tokens": len(enc(messages)[0])}
+        except Exception:  # noqa: BLE001 — usage is advisory
+            return None
+
+    @staticmethod
+    def _count_tokens(text: str) -> int:
+        tok = getattr(STATE.engine, "tokenizer", None)
+        if tok is None or not text:
+            return 0
+        try:
+            return len(tok.encode(text, add_special_tokens=False))
+        except TypeError:  # tokenizers without the kwarg
+            return len(tok.encode(text))
+        except Exception:  # noqa: BLE001
+            return 0
 
     def _perplexity(self):
         """POST {"prompt": str, "completion": str[, "model": adapter]} →
@@ -683,9 +750,12 @@ class Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._json(500, {"error": str(e)})
 
-    def _stream_chat(self, messages, kwargs):
+    def _stream_chat(self, messages, kwargs, usage=None):
         """SSE: one ``data: {chat.completion.chunk}`` event per text delta,
-        then ``data: [DONE]`` (OpenAI stream shape)."""
+        then ``data: [DONE]`` (OpenAI stream shape). The terminal chunk
+        carries ``usage`` (replica-side tokenized prompt length) so
+        streaming clients — the gateway's HTTPReplica included — get the
+        same truthful count the non-streamed response body does."""
         stream_fn = getattr(STATE.engine, "chat_stream", None)
         if stream_fn is None:  # single-slot engine: one terminal delta
             def stream_fn(msgs, **kw):
@@ -714,12 +784,15 @@ class Handler(BaseHTTPRequestHandler):
                                      "delta": {"content": delta},
                                      "finish_reason": None}],
                     })
-                event({
+                terminal = {
                     "id": rid, "object": "chat.completion.chunk",
                     "created": int(time.time()), "model": STATE.model_path,
                     "choices": [{"index": 0, "delta": {},
                                  "finish_reason": "stop"}],
-                })
+                }
+                if usage is not None:
+                    terminal["usage"] = usage
+                event(terminal)
             except Exception as e:  # noqa: BLE001 — headers already sent:
                 # a second HTTP response would corrupt the stream, so errors
                 # become a terminal SSE event instead
@@ -739,7 +812,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       quantization=None, slots=4, decode_chunk=8,
                       adapters=None, adapter_pool=0, adapter_rank_max=8,
                       adapter_targets=None, kv_quant=None, prefix_cache=0,
-                      kv_block_size=0, kv_blocks=0, prefill_chunk=256,
+                      kv_block_size=0, kv_blocks=0, kv_overcommit="off",
+                      prefill_chunk=256,
                       prefill_token_budget=0, paged_kernel="auto",
                       spec_draft=None, spec_k=4, spec_mode="auto",
                       trace_ring=256, trace_log_path=None):
@@ -755,6 +829,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               ("--prefix_cache", prefix_cache),
                               ("--kv_quant", kv_quant),
                               ("--kv_block_size", kv_block_size),
+                              ("--kv_overcommit", kv_overcommit == "on"),
                               # only "on" demands the batched paged engine;
                               # "off"/"auto" are no-ops everywhere else
                               ("--paged_kernel", paged_kernel == "on"),
@@ -776,6 +851,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     slots=slots, decode_chunk=decode_chunk,
                     kv_quant=kv_quant or None, prefix_cache=prefix_cache,
                     kv_block_size=kv_block_size, kv_blocks=kv_blocks or None,
+                    kv_overcommit=kv_overcommit or "off",
                     paged_kernel=paged_kernel or "auto",
                     spec_draft=spec_draft or None,
                     spec_k=spec_k, spec_mode=spec_mode or "auto",
@@ -863,6 +939,17 @@ def main(argv=None):
                    help="total blocks in the paged pool (default "
                         "slots × max_seq_len / kv_block_size; set lower to "
                         "serve the same slots in less HBM)")
+    p.add_argument("--kv_overcommit", default="off",
+                   choices=["off", "on"],
+                   help="on: KV overcommit — admission reserves only the "
+                        "prompt's blocks plus a small headroom, the "
+                        "scheduler grows tables at each cursor, prefix-"
+                        "cache hits share refcounted blocks copy-on-write, "
+                        "and exhaustion preempts youngest-first (sessions "
+                        "park host-side and resume token-exactly). off "
+                        "(default) = eager ceil((prompt+max_new)/bs) "
+                        "reserve, byte-identical to the pre-overcommit "
+                        "engine")
     p.add_argument("--paged_kernel", default="auto",
                    choices=["auto", "on", "off"],
                    help="Pallas in-place paged-attention decode kernel: "
@@ -929,6 +1016,7 @@ def main(argv=None):
                       kv_quant=args.kv_quant, prefix_cache=args.prefix_cache,
                       kv_block_size=args.kv_block_size,
                       kv_blocks=args.kv_blocks,
+                      kv_overcommit=args.kv_overcommit,
                       prefill_chunk=args.prefill_chunk,
                       prefill_token_budget=args.prefill_token_budget,
                       paged_kernel=args.paged_kernel,

@@ -111,6 +111,29 @@ def main() -> int:
                       f"plain_steps={adv.get('plain_steps')}) · "
                       f"tpot p50 {adv_on.get('tpot_ms_p50')}ms vs "
                       f"{adv_off.get('tpot_ms_p50')}ms off")
+        # KV-overcommit capacity twin: peak concurrent sessions at one
+        # block budget is the headline; blocks-per-session and preemption
+        # round-trips show HOW the extra sessions fit
+        cap = last.get("capacity")
+        if isinstance(cap, dict):
+            ov = cap.get("overcommit") or {}
+            eg = cap.get("eager") or {}
+            row += ("\n  - capacity: peak sessions "
+                    f"{ov.get('peak_sessions')} overcommit vs "
+                    f"{eg.get('peak_sessions')} eager "
+                    f"(ratio {cap.get('peak_ratio')}) on "
+                    f"{cap.get('kv_blocks')} blocks of "
+                    f"{cap.get('block_size')} · "
+                    f"{ov.get('tokens_per_sec')} vs "
+                    f"{eg.get('tokens_per_sec')} tok/s")
+            row += ("\n  - overcommit: blocks/session "
+                    f"p50={ov.get('blocks_per_session_p50')} "
+                    f"p95={ov.get('blocks_per_session_p95')} · "
+                    f"preemptions={ov.get('preemptions')} "
+                    f"resumes={ov.get('resumes')} "
+                    f"errors={ov.get('errors')}")
+            if cap.get("parity_checked"):
+                row += " · overcommit-vs-eager parity: checked"
         # load-replay mode: the SLO verdict IS the headline — a chaos run
         # whose objectives held, or the violated objectives by name
         rp = last.get("replay")

@@ -88,6 +88,16 @@ class _StatsEngine:
     slots = 4
     _slot_req = [object(), None, None, None]
     prefill_stats = {"full": 2, "reuse": 1, "extend": 0}
+    # paged KV pool + overcommit plane: free/reserved/block-size gauges,
+    # the overcommit ratio, and the preemption outcome counter — built and
+    # linted on BOTH planes (the gateway pass scrapes these through the
+    # InProcessReplica stats surface into its per-replica gauges)
+    total_kv_blocks = 32
+    free_kv_blocks = 20
+    kv_blocks_reserved = 12
+    block_size = 16
+    kv_overcommit_ratio = 1.5
+    preempt_stats = {"exported": 3, "resumed": 2, "requeued_prefill": 1}
     # KV migration fabric outcome counters (dtx_serving_session_* series)
     session_stats = {"export": {"ok": 2, "skipped_prefill": 1},
                      "import": {"ok": 2, "refused": 1}}

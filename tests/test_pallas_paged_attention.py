@@ -143,6 +143,25 @@ def test_bf16_pools_match_oracle_bitwise():
     np.testing.assert_array_equal(got, want)
 
 
+def test_aliased_tables_shared_prefix_blocks():
+    """COW prefix sharing (kv_overcommit): several slots' tables map the
+    SAME physical blocks for their shared prefix, diverging only in their
+    owned tails. Kernel reads walk each slot's own table, so aliasing must
+    be invisible — pinned against the oracle over genuinely shared blocks
+    (the shared region's positions 0..15 coincide across slots, exactly
+    what a mapped prefix-cache entry produces)."""
+    tables = jnp.asarray([[0, 1, 2, -1],   # donor: prefix + own tail
+                          [0, 1, 3, -1],   # sharer at a different depth
+                          [0, 1, 4, 5]],   # deeper sharer, two own blocks
+                         jnp.int32)
+    got, want = _run(B=3, NB=8, nbps=4, lens=(21, 17, 30), tables=tables)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # bf16 serving dtype: aliased reads must stay BITWISE oracle-equal
+    got, want = _run(B=3, NB=8, nbps=4, lens=(21, 17, 30), tables=tables,
+                     dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_bf16_int8_pools_match_oracle_bitwise():
     got, want = _run(dtype=jnp.bfloat16, quant=True, lens=(12, 23))
     np.testing.assert_array_equal(got, want)
