@@ -875,6 +875,255 @@ def child_replay(preflight=None):
     print(json.dumps(line), flush=True)
 
 
+def child_disagg(preflight=None):
+    """DTX_BENCH_DISAGG=1: disaggregated-serving twin bench. The same
+    mixed workload — short interactive requests plus long prompts sharing
+    one long document preamble — runs against TWIN in-process fleets of
+    REAL BatchedEngines at EQUAL chips:
+
+    - **uniform**: two mixed replicas, role-blind least-busy routing
+      (PR 15 behavior; no fleet plane).
+    - **disagg**: one prefill specialist + one decode replica, the
+      router's prompt-token threshold steering longs at the specialist,
+      the fleet-shared prefix tier on, and (by default) the fleet
+      handoff plane re-homing decode-ready sessions onto the decode
+      replica mid-run.
+
+    Before the clock starts, a token-parity gate (greedy AND fixed-seed
+    sampled, engine-level; plus one greedy probe through each gateway)
+    asserts the disagg twin's outputs byte-identical to the uniform twin
+    — role routing, prefix sharing and handoff must be invisible in the
+    tokens or the numbers are unreportable. The run then asserts the
+    disaggregation claim at equal chips: TTFT p95 no worse AND tokens/s
+    no worse than uniform, with zero errors on both twins. The win is
+    structural — longs pay their shared-prefix prefill ONCE on the
+    specialist instead of once per replica, and shorts on the decode
+    replica never queue behind a long prefill. CPU numbers are
+    smoke-only, like the serve bench."""
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = "tinyllama-1.1b" if on_tpu else "debug"
+    max_seq = 1024 if on_tpu else 256
+    n_short = int(os.environ.get("DTX_BENCH_DISAGG_SHORT",
+                                 "10" if on_tpu else "8"))
+    n_long = int(os.environ.get("DTX_BENCH_DISAGG_LONG", "4"))
+    short_new = 24 if on_tpu else 12
+    long_new = 32 if on_tpu else 12
+    handoff_on = os.environ.get("DTX_BENCH_DISAGG_HANDOFF", "1") != "0"
+
+    def build(disagg: bool, threshold: int):
+        from datatunerx_tpu.gateway.admission import AdmissionController
+
+        engines = [
+            BatchedEngine(f"preset:{model}", template="vanilla",
+                          max_seq_len=max_seq, slots=2, decode_chunk=4,
+                          # local prefix cache ON for BOTH twins (fair):
+                          # the comparison is prefix LOCALITY via role
+                          # routing, not cache-on vs cache-off
+                          prefix_cache=4)
+            for _ in range(2)  # shared program memo: 2nd engine is cheap
+        ]
+        roles = ["prefill", "decode"] if disagg else ["mixed", "mixed"]
+        pool = ReplicaPool([
+            InProcessReplica(f"replica-{i}", e, role=roles[i])
+            for i, e in enumerate(engines)])
+        # tokenizer-exact admission (both twins): the routing threshold
+        # then compares true token counts, not the chars/4 heuristic
+        tok = engines[0].tokenizer
+        adm = AdmissionController(
+            count_tokens=lambda s: len(tok.encode(s)))
+        gw = Gateway(pool, model_name=f"preset:{model}", admission=adm,
+                     prefill_threshold=threshold if disagg else 0,
+                     fleet_prefix_bytes=(8 << 20) if disagg else 0,
+                     fleet_handoff=disagg and handoff_on)
+        return gw, engines
+
+    def run_twin(gw):
+        lock = threading.Lock()
+        per_req = []
+
+        def one(req, idx):
+            t0 = time.perf_counter()
+            ttft = None
+            toks = 0
+            err = None
+            try:
+                for _ in gw.chat_stream(dict(req),
+                                        trace_id=f"disagg-{idx}"):
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    toks += 1
+            except Exception as e:  # noqa: BLE001 — an error IS the data
+                err = f"{type(e).__name__}: {e}"
+            if ttft is None:
+                # tiny presets can hit EOS before the first delta — the
+                # queue+prefill wait is still the number being measured,
+                # so fall back to end-to-end completion time
+                ttft = time.perf_counter() - t0
+            with lock:
+                per_req.append((ttft, toks, err))
+
+        # longs first (they are the work that must not block shorts),
+        # shorts right behind — everything in flight together
+        workload = long_reqs + short_reqs
+        threads = []
+        wall0 = time.perf_counter()
+        for i, req in enumerate(workload):
+            th = threading.Thread(target=one, args=(req, i), daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(0.01)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - wall0
+        assert len(per_req) == len(workload) and \
+            not any(th.is_alive() for th in threads), \
+            "disagg workload: session(s) never terminated"
+        ttfts = sorted(t * 1e3 for t, _, _ in per_req if t is not None)
+        # LOGICAL tokens — each request's prompt plus its decoded deltas.
+        # Identical prompt work is credited to both twins, so tokens/s is
+        # a pure wall-clock comparison at equal work; the disagg twin's
+        # skipped re-prefills (prefix extends on the specialist) show up
+        # as the shorter wall, not as a smaller numerator
+        tokens = prompt_toks_total + sum(n for _, n, _ in per_req)
+        errors = [e for _, _, e in per_req if e]
+        return {
+            "requests": len(per_req), "errors": len(errors),
+            "error_detail": errors[:3],
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else 0.0,
+            "ttft_ms_p50": _pct(ttfts, 0.5),
+            "ttft_ms_p95": _pct(ttfts, 0.95),
+            "wall_s": round(wall, 3),
+        }
+
+    probe_req = {"messages": [{"role": "user", "content": "parity probe"}],
+                 "max_tokens": 8}
+    gw_u, eng_u = build(disagg=False, threshold=0)
+    # size the shared preamble in MEASURED tokens (the debug preset's
+    # tokenizer is near char-level): it must fit max_seq with decode
+    # room, or the engine truncates it and the prefix is never shared.
+    # The preamble rides in the USER turn — the vanilla template renders
+    # only the final query, so a system turn would be dropped on the
+    # floor and the longs would not actually be long
+    tok = eng_u[0].tokenizer
+    base = "clause and subclause policy detail. "
+    # bucket math bounds the preamble: prepare_prompt pads plen to
+    # DECODE_BUCKET (64) multiples and a prefix EXTEND appends a further
+    # padded suffix bucket, so the warm entry's cursor + 64 must still
+    # leave decode room under max_seq — 0.35*max_seq keeps the CPU
+    # preset's warm plen at 128 of 256 (extend cursor 192, room 64)
+    target = int(max_seq * (0.6 if on_tpu else 0.35))
+    preamble = "You are a meticulous assistant. "
+    while len(tok.encode(preamble + base)) < target:
+        preamble += base
+    long_reqs = [{"messages": [
+        {"role": "user", "content": f"{preamble}\nsummarize item {i}."}],
+        "max_tokens": long_new} for i in range(n_long)]
+    short_reqs = [{"messages": [
+        {"role": "user", "content": f"quick question {i}?"}],
+        "max_tokens": short_new} for i in range(n_short)]
+    # the prefix-cache win is only real if the warm prompt's tokens are a
+    # STRICT prefix of every long's tokens (longest_prefix is a trie walk
+    # over whole cached keys) — assert it, or a tokenizer merging across
+    # the preamble/suffix boundary silently degrades extends to full
+    # prefills and the bench measures nothing
+    pre_ids = list(tok.encode(preamble))
+    for r in long_reqs:
+        ids = list(tok.encode(r["messages"][0]["content"]))
+        assert len(ids) > len(pre_ids) and ids[:len(pre_ids)] == pre_ids, \
+            "warm preamble does not token-prefix the long prompts"
+    prompt_toks_total = sum(
+        len(tok.encode(m["content"]))
+        for r in long_reqs + short_reqs for m in r["messages"])
+    threshold = int(os.environ.get(
+        "DTX_BENCH_DISAGG_THRESHOLD", str(target // 2)))
+    gw_d, eng_d = build(disagg=True, threshold=threshold)
+    try:
+        # pre-clock token-parity gate (engine level, greedy + seeded
+        # sampled): the twins must be the same model before the clock
+        # may compare them
+        ids = eng_u[0].tokenizer.encode("a quick question about weather")
+        for kw in ({}, {"temperature": 0.8, "top_p": 0.9, "seed": 11}):
+            want = eng_u[0].generate(ids, max_new_tokens=12, **kw)
+            got = eng_d[0].generate(ids, max_new_tokens=12, **kw)
+            assert got == want, (
+                f"disagg twin diverged from uniform (kw={kw}): "
+                f"{got} != {want}")
+        # gateway-level greedy probe: role routing must not change tokens
+        want = gw_u.chat(dict(probe_req), trace_id="parity-u")
+        got = gw_d.chat(dict(probe_req), trace_id="parity-d")
+        assert got == want, (
+            f"gateway routing changed tokens: {got!r} != {want!r}")
+        if gw_d.fleet is not None:
+            gw_d.fleet.start(0.05)
+        # steady-state warm phase (both twins, pre-clock): the BARE
+        # preamble has been seen before the measured burst, and its
+        # cached entry strict-prefixes every long — the clocked
+        # comparison is prefix LOCALITY (disagg: every long lands where
+        # the prefix is hot and pays a suffix-only extend; uniform:
+        # role-blind spread re-prefills the preamble per replica), not
+        # first-ever-prefill cost
+        warm = {"messages": [{"role": "user", "content": preamble}],
+                "max_tokens": 4}
+        gw_u.chat(dict(warm), trace_id="warm-u")
+        gw_d.chat(dict(warm), trace_id="warm-d")
+        uniform = run_twin(gw_u)
+        disagg = run_twin(gw_d)
+        fleet_stats = gw_d.fleet.stats() if gw_d.fleet is not None else {}
+        role_routes = dict(getattr(gw_d.router, "role_routes", {}))
+    finally:
+        gw_u.close()
+        gw_d.close()
+
+    assert uniform["errors"] == 0 and disagg["errors"] == 0, (
+        "disagg twin bench dropped requests: "
+        f"uniform={uniform['error_detail']} "
+        f"disagg={disagg['error_detail']}")
+    assert disagg["ttft_ms_p95"] <= uniform["ttft_ms_p95"], (
+        "disaggregation did NOT hold TTFT p95 at equal chips: "
+        f"{disagg['ttft_ms_p95']}ms vs uniform {uniform['ttft_ms_p95']}ms")
+    assert disagg["tokens_per_sec"] >= uniform["tokens_per_sec"], (
+        "disaggregation did NOT hold tokens/s at equal chips: "
+        f"{disagg['tokens_per_sec']} vs uniform "
+        f"{uniform['tokens_per_sec']}")
+    tag = f"{model},2replicas,thr{threshold}"
+    line = {
+        "metric": f"serve_disagg_tokens_per_sec[{tag}]",
+        "value": disagg["tokens_per_sec"],
+        "unit": "tok/s",
+        "vs_baseline": round(disagg["tokens_per_sec"]
+                             / max(uniform["tokens_per_sec"], 1e-9), 3),
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
+        "disagg": {
+            "parity_checked": True,
+            "handoff_enabled": handoff_on,
+            "threshold_tokens": threshold,
+            "workload": {"long": n_long, "short": n_short},
+            "uniform": uniform,
+            "disaggregated": disagg,
+            "fleet": fleet_stats,
+            "role_routes": role_routes,
+        },
+    }
+    if preflight is not None:
+        line["preflight"] = preflight
+    print(json.dumps(line), flush=True)
+
+
 # ------------------------------------------------------------- orchestrator
 
 # The probe reports each phase AS IT COMPLETES (one JSON line, flushed), so
@@ -1133,6 +1382,10 @@ if __name__ == "__main__":
         # replay mode: loadgen harness against an in-process fleet, with
         # the same per-phase pre-flight diagnosis on its line
         child_replay(preflight=_preflight_probe())
+    elif os.environ.get("DTX_BENCH_DISAGG"):
+        # disaggregated-serving twin bench (uniform vs role-split fleet
+        # at equal chips) with the same per-phase pre-flight diagnosis
+        child_disagg(preflight=_preflight_probe())
     elif os.environ.get("DTX_BENCH_SERVE_CAPACITY"):
         # KV-overcommit capacity twin bench (eager reserve vs overcommit
         # over one block budget) with the same pre-flight diagnosis

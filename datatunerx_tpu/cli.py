@@ -239,6 +239,11 @@ def cmd_serve(args):
             "--policy", args.policy,
             "--max_queue", str(args.max_queue),
             "--token_budget", str(args.token_budget),
+            "--role", args.role,
+            "--prefill_threshold", str(args.prefill_threshold),
+            "--fleet_prefix_mb", str(args.fleet_prefix_mb),
+            "--fleet_handoff", str(int(args.fleet_handoff)),
+            "--fleet_spill", str(int(args.fleet_spill)),
         ]
         if args.workdir:
             argv += ["--workdir", args.workdir]
@@ -265,6 +270,9 @@ def cmd_serve(args):
         "--spec_mode", args.spec_mode,
         "--prefill_token_budget", str(args.prefill_token_budget),
     ]
+    if args.role:
+        # single server: one role, not a cycle (serving.server validates)
+        argv += ["--role", args.role]
     return serving_main(argv)
 
 
@@ -450,6 +458,22 @@ def main(argv=None):
     vp.add_argument("--prefill_token_budget", type=int, default=0,
                     help="prefill tokens per scheduler tick between decode "
                          "chunks (0 = unbounded)")
+    vp.add_argument("--role", default="",
+                    help="disaggregation role(s): a single role for one "
+                         "server (prefill/decode/mixed), or a comma-"
+                         "separated cycle for gateway-spawned replicas "
+                         "(e.g. 'prefill,decode'); empty = all mixed")
+    vp.add_argument("--prefill_threshold", type=int, default=0,
+                    help="gateway: prompts of >= this many tokens prefer "
+                         "role=prefill replicas (0 = role-blind routing)")
+    vp.add_argument("--fleet_prefix_mb", type=float, default=0.0,
+                    help="gateway: fleet-shared prefix tier budget in MB "
+                         "(0 = off)")
+    vp.add_argument("--fleet_handoff", type=int, default=0,
+                    help="gateway: 1 = prefill→decode session handoff")
+    vp.add_argument("--fleet_spill", type=int, default=0,
+                    help="gateway: 1 = spill preemption-parked sessions "
+                         "to peers with free KV blocks")
     vp.add_argument("--replicas", type=int, default=1,
                     help="replica count; > 1 puts the gateway in front")
     vp.add_argument("--gateway", action="store_true",

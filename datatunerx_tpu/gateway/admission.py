@@ -20,9 +20,13 @@ when the price exceeds what the fleet has free, net of admits so recent the
 replicas' gauges cannot reflect them yet. Dense fleets (or missing stats)
 return no block signal and the static budget remains the only gate.
 
-Retry-After is derived from observed drain throughput (EWMA of completed
-prefill tokens/s), so a shed client waits roughly one queue-drain, not a
-fixed guess. ``calibrate()`` lets the gateway feed REAL replica-side
+Retry-After is BLOCK-denominated when the fleet reports a paged pool:
+successive ``fleet_blocks_fn`` samples yield a freed-blocks/s EWMA, and a
+shed client waits roughly until the fleet has freed the blocks its admit
+needs — the same currency admission itself is priced in. When that rate
+is unpopulated (dense fleet, no frees observed yet) it falls back to the
+token-drain EWMA (completed prefill tokens/s), so a shed client still
+waits roughly one queue-drain, not a fixed guess. ``calibrate()`` lets the gateway feed REAL replica-side
 tokenized prompt counts back (the serving response's ``usage``), so the
 chars-per-token heuristic converges on the deployment's actual ratio when
 no local tokenizer is available.
@@ -122,6 +126,12 @@ class AdmissionController:
         # drain-rate EWMA (tokens/s) for the Retry-After estimate
         self._rate = 0.0
         self._last_release = time.monotonic()
+        # block-drain EWMA (freed blocks/s) from successive fleet samples:
+        # the Retry-After currency once admission is priced in blocks.
+        # Only POSITIVE free-count deltas feed it (a growing free count is
+        # the fleet draining; admissions shrinking it are not a drain).
+        self._blocks_rate = 0.0
+        self._last_fleet: Optional[tuple] = None  # (t, free)
 
     # ------------------------------------------------------------ admission
     def estimate(self, messages: List[dict]) -> int:
@@ -161,6 +171,8 @@ class AdmissionController:
             except Exception:  # noqa: BLE001 — a stats fault must not shed 500s
                 fleet = None
         with self._lock:
+            if fleet and fleet.get("total"):
+                self._note_fleet_locked(fleet)
             if self._depth + 1 > self.max_queue:
                 self._shed += 1
                 raise Overloaded(
@@ -186,7 +198,8 @@ class AdmissionController:
                     raise Overloaded(
                         f"fleet KV blocks exhausted (need {need}, "
                         f"free {free}, pending {pending})",
-                        self._retry_after_locked())
+                        self._retry_after_locked(
+                            block_deficit=need + pending - free))
                 self._pending_blocks.append((now, need))
             self._depth += 1
             self._tokens += n
@@ -203,8 +216,30 @@ class AdmissionController:
             self._rate = inst if self._rate == 0 else (
                 0.8 * self._rate + 0.2 * inst)
 
-    def _retry_after_locked(self) -> int:
-        if self._rate > 0:
+    def _note_fleet_locked(self, fleet: dict):
+        """Fold one fleet free-block sample into the freed-blocks/s EWMA.
+        Only positive deltas count: a rising free count is the fleet
+        draining; admissions pulling it down are not drain throughput.
+        Unchanged samples (the replicas' stats TTL cache) are skipped so
+        they neither decay nor inflate the rate."""
+        now = time.monotonic()
+        free = int(fleet.get("free", 0))
+        if self._last_fleet is not None:
+            t0, f0 = self._last_fleet
+            dt = now - t0
+            freed = free - f0
+            if dt >= 1e-3 and freed > 0:
+                inst = freed / dt
+                self._blocks_rate = inst if self._blocks_rate == 0 else (
+                    0.8 * self._blocks_rate + 0.2 * inst)
+        self._last_fleet = (now, free)
+
+    def _retry_after_locked(self, block_deficit: int = 0) -> int:
+        if block_deficit > 0 and self._blocks_rate > 0:
+            # block-denominated: wait until the fleet has freed the
+            # blocks this admit is short by, at the observed drain rate
+            est = block_deficit / self._blocks_rate
+        elif self._rate > 0:
             est = self._tokens / self._rate
         else:
             est = float(self.max_retry_after_s)

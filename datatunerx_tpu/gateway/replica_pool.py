@@ -163,9 +163,14 @@ class Replica:
     ``chat_stream``, ``probe_health`` and ``stats``."""
 
     def __init__(self, name: str, failure_threshold: int = 3,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0, role: str = "mixed"):
         self.name = name
         self.breaker = CircuitBreaker(failure_threshold, cooldown_s)
+        # disaggregation role: "prefill" (long-prompt specialist), "decode"
+        # (steady-state token production), or "mixed" (either — the
+        # default, which keeps routing byte-identical to a role-less
+        # fleet). HTTP replicas refresh this from their /metrics scrape.
+        self.role = role or "mixed"
         self.draining = False
         self.healthy = True  # last health-probe verdict
         self.inflight = 0  # gateway-side in-flight count (least-busy fallback)
@@ -266,11 +271,14 @@ class Replica:
 
     # --------------------------------------------------- KV migration fabric
     def export_sessions(self, slots: Optional[List[int]] = None,
-                        wire: Optional[str] = None) -> Optional[dict]:
+                        wire: Optional[str] = None,
+                        include_prefill: bool = False) -> Optional[dict]:
         """Serialize (and terminate) the replica's in-flight decode
         sessions for handoff. None = the replica kind/engine has no
         migration surface; otherwise {"sessions": [payload...],
-        "skipped": [...]}. Raises ReplicaError on transport faults."""
+        "skipped": [...]}. ``include_prefill`` ships mid-chunked-prefill
+        slots too (disaggregated prefill→decode handoff). Raises
+        ReplicaError on transport faults."""
         return None
 
     def import_session(self, payload: dict):
@@ -279,6 +287,36 @@ class Replica:
         ``text_so_far`` (the detokenized migrated tail) and ``stream``
         yields the continuation deltas. Raises ReplicaError on refusal
         (status 409: no slot / blocks / adapter) or fault."""
+        return None
+
+    # ------------------------------------------------------ fleet plane
+    def hold_parked(self, max_sessions: int = 4,
+                    hold_s: float = 10.0) -> Optional[dict]:
+        """Lease preemption-parked sessions for a peer spill (phase 1).
+        None = unsupported; otherwise {"sessions": [...], "parked": n}."""
+        return None
+
+    def drop_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        """Finish a spill (phase 2, success): drop the re-homed sessions
+        and terminate their source requests with the migrated marker."""
+        return None
+
+    def release_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        """Abort a spill (phase 2, failure): clear the leases so the
+        sessions resume locally."""
+        return None
+
+    def export_prefix_entries(self, exclude: Optional[List[str]] = None,
+                              max_entries: int = 4,
+                              wire: Optional[str] = None) -> Optional[dict]:
+        """Publishable local prefix-cache entries (dtx-kv-prefix payloads)
+        for the fleet prefix tier; None = unsupported."""
+        return None
+
+    def import_prefix_entry(self, payload: dict) -> Optional[dict]:
+        """Install a fleet-published prefix payload into the replica's
+        local prefix cache; None = unsupported. Raises ReplicaError on
+        refusal (status 409) or fault."""
         return None
 
     def adapter_inventory(self) -> Optional[Dict[str, str]]:
@@ -423,11 +461,14 @@ class InProcessReplica(Replica):
         return self.healthy
 
     # --------------------------------------------------- KV migration fabric
-    def export_sessions(self, slots=None, wire=None):
+    def export_sessions(self, slots=None, wire=None, include_prefill=False):
         fn = getattr(self.engine, "export_sessions", None)
         if not callable(fn):
             return None
         try:
+            if include_prefill:
+                return fn(slots=slots, wire_quant=wire, include_prefill=True)
+            # older engines lack the kwarg — the default call keeps them
             return fn(slots=slots, wire_quant=wire)
         except Exception as e:  # noqa: BLE001 — export fault = replica fault
             raise ReplicaError(f"{self.name}: export failed: {e}") from e
@@ -459,6 +500,44 @@ class InProcessReplica(Replica):
             raise
         except Exception as e:  # noqa: BLE001
             raise ReplicaError(f"{self.name}: resume failed: {e}") from e
+
+    # ------------------------------------------------------ fleet plane
+    def _fleet_call(self, attr: str, **kw) -> Optional[dict]:
+        """One error-mapping shim for the engine's fleet surface:
+        ValueError/KeyError = refusal (409, no failover), anything else =
+        replica fault; None when the engine lacks the method."""
+        fn = getattr(self.engine, attr, None)
+        if not callable(fn):
+            return None
+        try:
+            return fn(**kw)
+        except (ValueError, KeyError) as e:
+            raise ReplicaError(
+                f"{self.name}: {attr} refused: {_client_error_message(e)}",
+                status=409) from e
+        except Exception as e:  # noqa: BLE001
+            raise ReplicaError(f"{self.name}: {attr} failed: {e}") from e
+
+    def hold_parked(self, max_sessions: int = 4,
+                    hold_s: float = 10.0) -> Optional[dict]:
+        return self._fleet_call("hold_parked", max_sessions=max_sessions,
+                                hold_s=hold_s)
+
+    def drop_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        return self._fleet_call("drop_parked", trace_ids=trace_ids)
+
+    def release_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        return self._fleet_call("release_parked", trace_ids=trace_ids)
+
+    def export_prefix_entries(self, exclude: Optional[List[str]] = None,
+                              max_entries: int = 4,
+                              wire: Optional[str] = None) -> Optional[dict]:
+        return self._fleet_call("export_prefix_entries", exclude=exclude,
+                                max_entries=max_entries, wire_quant=wire)
+
+    def import_prefix_entry(self, payload: dict) -> Optional[dict]:
+        return self._fleet_call("import_prefix_entry",
+                                payload=dict(payload))
 
     def adapter_inventory(self) -> Optional[Dict[str, str]]:
         catalog_fn = getattr(self.engine, "adapter_catalog", None)
@@ -541,6 +620,11 @@ class InProcessReplica(Replica):
             # and the gateway's per-replica acceptance gauge read these
             "spec_enabled": bool(spec_doc),
             "spec_accept_rate": (spec_doc or {}).get("accept_rate"),
+            # disaggregation: routing role + parked-session count (the
+            # spill coordinator's candidate signal)
+            "role": self.role,
+            "sessions_parked": int(
+                getattr(self.engine, "parked_sessions", 0) or 0),
         }
 
     def close(self):
@@ -669,12 +753,14 @@ class HTTPReplica(Replica):
         return ReplicaError(f"{self.name}: {_error_detail(e)}",
                             status=e.code)
 
-    def export_sessions(self, slots=None, wire=None):
+    def export_sessions(self, slots=None, wire=None, include_prefill=False):
         body: dict = {}
         if slots is not None:
             body["slots"] = list(slots)
         if wire:
             body["wire"] = wire
+        if include_prefill:
+            body["prefill"] = True
         try:
             with self._post("/admin/sessions/export", body) as r:
                 return json.load(r)
@@ -684,6 +770,52 @@ class HTTPReplica(Replica):
             raise self._admin_error(e) from e
         except (OSError, ValueError) as e:
             raise ReplicaError(f"{self.name}: export failed: {e}") from e
+
+    # ------------------------------------------------------ fleet plane
+    def _fleet_post(self, path: str, body: dict,
+                    what: str) -> Optional[dict]:
+        """POST a fleet-plane admin call; 501 (or 404 from an older
+        serving build) = surface absent → None, like export_sessions."""
+        try:
+            with self._post(path, body) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 501):
+                return None
+            raise self._admin_error(e) from e
+        except (OSError, ValueError) as e:
+            raise ReplicaError(f"{self.name}: {what} failed: {e}") from e
+
+    def hold_parked(self, max_sessions: int = 4,
+                    hold_s: float = 10.0) -> Optional[dict]:
+        return self._fleet_post("/admin/sessions/hold",
+                                {"max_sessions": max_sessions,
+                                 "hold_s": hold_s}, "hold_parked")
+
+    def drop_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        return self._fleet_post("/admin/sessions/drop",
+                                {"trace_ids": list(trace_ids)},
+                                "drop_parked")
+
+    def release_parked(self, trace_ids: List[str]) -> Optional[dict]:
+        return self._fleet_post("/admin/sessions/release",
+                                {"trace_ids": list(trace_ids)},
+                                "release_parked")
+
+    def export_prefix_entries(self, exclude: Optional[List[str]] = None,
+                              max_entries: int = 4,
+                              wire: Optional[str] = None) -> Optional[dict]:
+        body: dict = {"max_entries": max_entries}
+        if exclude:
+            body["exclude"] = list(exclude)
+        if wire:
+            body["wire"] = wire
+        return self._fleet_post("/admin/prefix/export", body,
+                                "export_prefix")
+
+    def import_prefix_entry(self, payload: dict) -> Optional[dict]:
+        return self._fleet_post("/admin/prefix/import", dict(payload),
+                                "import_prefix")
 
     def import_session(self, payload: dict):
         body = dict(payload)
@@ -804,7 +936,8 @@ class HTTPReplica(Replica):
                "kv_blocks_free": 0, "kv_blocks_total": 0,
                "kv_block_size": 0, "adapters": None,
                "resident_adapters": None,
-               "spec_enabled": False, "spec_accept_rate": None}
+               "spec_enabled": False, "spec_accept_rate": None,
+               "role": self.role, "sessions_parked": 0}
         try:
             with urllib.request.urlopen(
                     self.base_url + "/metrics", timeout=2) as r:
@@ -830,6 +963,17 @@ class HTTPReplica(Replica):
                         out["spec_enabled"] = float(line.split()[-1]) > 0
                     elif line.startswith("dtx_serving_spec_accept_rate "):
                         out["spec_accept_rate"] = float(line.split()[-1])
+                    elif line.startswith("dtx_serving_sessions_parked "):
+                        out["sessions_parked"] = int(float(line.split()[-1]))
+                    elif line.startswith('dtx_serving_role{role="'):
+                        rest = line[len('dtx_serving_role{role="'):]
+                        name = rest.split('"', 1)[0]
+                        try:
+                            if float(line.rsplit(None, 1)[-1]) == 1:
+                                out["role"] = name
+                                self.role = name  # routing reads the attr
+                        except ValueError:
+                            pass
                     else:
                         # residency/capability sets from the labeled gauges
                         # (absent series = no signal, stays None)
@@ -858,7 +1002,8 @@ class HTTPReplica(Replica):
                 "kv_blocks_free": 0, "kv_blocks_total": 0,
                 "kv_block_size": 0, "adapters": None,
                 "resident_adapters": None,
-                "spec_enabled": False, "spec_accept_rate": None}
+                "spec_enabled": False, "spec_accept_rate": None,
+                "role": self.role, "sessions_parked": 0}
 
 
 class ReplicaPool:

@@ -59,11 +59,17 @@ def session_key(messages: List[dict], explicit: Optional[str] = None) -> str:
 
 class Router:
     def __init__(self, pool: ReplicaPool, policy: str = "least_busy",
-                 affinity_capacity: int = 4096):
+                 affinity_capacity: int = 4096,
+                 prefill_threshold: int = 0):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r}; one of {POLICIES}")
         self.pool = pool
         self.policy = policy
+        # disaggregation: prompts of >= this many tokens PREFER replicas
+        # declaring role=prefill; shorter prompts prefer non-prefill
+        # replicas. 0 disables the stage entirely (routing byte-identical
+        # to a role-less fleet).
+        self.prefill_threshold = int(prefill_threshold or 0)
         self._rr = 0
         self._wrr: dict = {}  # smooth-WRR current weights, by replica name
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
@@ -82,6 +88,9 @@ class Router:
         # spec-routing outcomes: how often spec-friendly (greedy) traffic
         # found a healthy speculative-decode replica to prefer
         self.spec_routes = {"preferred": 0, "blind": 0}
+        # role-routing outcomes: long prompts steered to prefill
+        # specialists, short ones away from them, or no role signal
+        self.role_routes = {"prefill": 0, "decode": 0, "blind": 0}
         # replicas whose acceptance EMA sits below this report as spec-
         # enabled but are NOT preferred — their controller has effectively
         # disabled drafting, so there is no TPOT win to chase there.
@@ -92,7 +101,8 @@ class Router:
     def route(self, messages: Optional[List[dict]] = None,
               adapter: str = "", session_id: Optional[str] = None,
               exclude: Optional[set] = None, on_event=None,
-              prefer_spec: bool = False) -> Replica:
+              prefer_spec: bool = False,
+              prompt_tokens: Optional[int] = None) -> Replica:
         """Pick a replica. ``exclude`` names replicas already tried for this
         request (failover must not retry the replica that just died).
         ``on_event(name, **detail)`` receives routing decisions — the
@@ -115,6 +125,9 @@ class Router:
         if adapter:
             candidates = self._adapter_candidates(adapter, candidates,
                                                   on_event)
+        if self.prefill_threshold > 0 and prompt_tokens is not None:
+            candidates = self._role_candidates(prompt_tokens, candidates,
+                                               on_event)
         if prefer_spec:
             candidates = self._spec_candidates(candidates, on_event)
 
@@ -207,6 +220,36 @@ class Router:
                          replicas=[r.name for r in preferred])
             return preferred
         return candidates
+
+    def _role_candidates(self, prompt_tokens: int,
+                         candidates: List[Replica], on_event) -> list:
+        """Disaggregated routing: prompts at/above the threshold PREFER
+        prefill specialists (their chunked-prefill budget is the product
+        there — the handoff coordinator re-homes them for decode);
+        everything else prefers non-prefill replicas so specialists stay
+        free for prompt work. A preference, never a filter — a fleet with
+        no matching role routes exactly as before (mixed replicas satisfy
+        both sides)."""
+        long_prompt = prompt_tokens >= self.prefill_threshold
+        if long_prompt:
+            preferred = [r for r in candidates
+                         if getattr(r, "role", "mixed") == "prefill"]
+            outcome = "prefill"
+        else:
+            preferred = [r for r in candidates
+                         if getattr(r, "role", "mixed") != "prefill"]
+            outcome = "decode"
+        if not preferred or len(preferred) == len(candidates):
+            with self._lock:
+                self.role_routes["blind"] += 1
+            return candidates
+        with self._lock:
+            self.role_routes[outcome] += 1
+        if on_event is not None:
+            on_event("role_route", outcome=outcome,
+                     prompt_tokens=prompt_tokens,
+                     replicas=[r.name for r in preferred])
+        return preferred
 
     def _pick(self, candidates: List[Replica]) -> Replica:
         weights = {r.name: max(0.0, getattr(r, "weight", 1.0))

@@ -34,6 +34,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from datatunerx_tpu.fleet import FleetPlane
 from datatunerx_tpu.gateway.admission import AdmissionController, Overloaded
 from datatunerx_tpu.gateway.autoscale import autoscale_hint
 from datatunerx_tpu.gateway.metrics import MS_BUCKETS, Registry
@@ -132,9 +133,14 @@ class Gateway:
                  max_attempts: int = 3, model_name: str = "",
                  trace_ring: int = 256,
                  trace_log_path: Optional[str] = None,
-                 slos=None, session_handoff: bool = True):
+                 slos=None, session_handoff: bool = True,
+                 prefill_threshold: int = 0,
+                 fleet_prefix_bytes: int = 0,
+                 fleet_handoff: bool = False,
+                 fleet_spill: bool = False):
         self.pool = pool
-        self.router = Router(pool, policy=policy)
+        self.router = Router(pool, policy=policy,
+                             prefill_threshold=prefill_threshold)
         self.admission = admission or AdmissionController()
         # fleet-true admission: tie 429/Retry-After to the fleet's LIVE
         # free-block sum whenever the replicas report a paged pool (dense
@@ -206,6 +212,16 @@ class Gateway:
             "Per-session export→import handoff time (trace exemplars "
             "resolve at /debug/trace/<id>).",
             buckets=MS_BUCKETS)
+        # disaggregated fleet plane (datatunerx_tpu/fleet/): prefix tier
+        # + prefill→decode handoff + peer spill, each flag-gated. With
+        # every flag at its default the plane is never constructed and
+        # the gateway is byte-identical to a fleet-less build.
+        self.fleet: Optional[FleetPlane] = None
+        if fleet_prefix_bytes > 0 or fleet_handoff or fleet_spill:
+            self.fleet = FleetPlane(
+                pool, self._handoff.put,
+                prefix_budget_bytes=fleet_prefix_bytes,
+                handoff=fleet_handoff, spill=fleet_spill)
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -222,10 +238,12 @@ class Gateway:
         return adapter
 
     def _route(self, messages, adapter, session_id, tried,
-               on_event=None, prefer_spec: bool = False) -> Replica:
+               on_event=None, prefer_spec: bool = False,
+               prompt_tokens: Optional[int] = None) -> Replica:
         return self.router.route(messages=messages, adapter=adapter,
                                  session_id=session_id, exclude=tried,
-                                 on_event=on_event, prefer_spec=prefer_spec)
+                                 on_event=on_event, prefer_spec=prefer_spec,
+                                 prompt_tokens=prompt_tokens)
 
     @staticmethod
     def _spec_friendly(kwargs: dict) -> bool:
@@ -272,7 +290,7 @@ class Gateway:
         t0 = time.monotonic()
         root = self._begin_request_span("gateway.request", trace_id, adapter)
         try:
-            with self.admission.try_admit(messages):
+            with self.admission.try_admit(messages) as ticket:
                 root.event("admitted")
                 tried: set = set()
                 last: Optional[Exception] = None
@@ -302,7 +320,11 @@ class Gateway:
                     replica = self._route(
                         messages, adapter, session_id, tried,
                         on_event=root.event,
-                        prefer_spec=self._spec_friendly(kwargs))
+                        prefer_spec=self._spec_friendly(kwargs),
+                        # the admission estimate IS the routing signal:
+                        # tokenizer-exact when one is wired, else the
+                        # calibrated chars-per-token heuristic (PR 15)
+                        prompt_tokens=ticket.tokens)
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -367,7 +389,7 @@ class Gateway:
         t0 = time.monotonic()
         root = self._begin_request_span("gateway.stream", trace_id, adapter)
         try:
-            with self.admission.try_admit(messages):
+            with self.admission.try_admit(messages) as ticket:
                 root.event("admitted")
                 emitted = ""
                 tried: set = set()
@@ -400,7 +422,8 @@ class Gateway:
                     replica = self._route(
                         messages, adapter, session_id, tried,
                         on_event=root.event,
-                        prefer_spec=self._spec_friendly(kwargs))
+                        prefer_spec=self._spec_friendly(kwargs),
+                        prompt_tokens=ticket.tokens)
                     tried.add(replica.name)
                     root.event("route", replica=replica.name,
                                attempt=attempt)
@@ -563,8 +586,15 @@ class Gateway:
         cold and fall back to today's re-prefill failover."""
         summary: dict = {"source": source.name, "exported": 0,
                          "imported": 0, "cold": 0, "skipped": 0}
+        # with the fleet handoff plane on, a drain also ships MID-chunked-
+        # prefill tails (blocks written so far + remaining prompt) — a
+        # prefill specialist drained mid-prompt re-prefills nothing.
+        # Off (default) keeps the PR 15 behavior: mid-prefill slots are
+        # skipped and their streams take the cold path.
+        include_prefill = (self.fleet is not None
+                           and self.fleet.handoff is not None)
         try:
-            doc = source.export_sessions()
+            doc = source.export_sessions(include_prefill=include_prefill)
         except ReplicaError as e:
             self._handoffs.inc({"outcome": "export_failed"})
             summary["error"] = str(e)
@@ -852,6 +882,17 @@ class Gateway:
             "Spec-friendly (greedy) routing outcomes: preferred = "
             "narrowed to spec-enabled replicas, blind = no narrowing "
             "possible (none or all candidates run spec).")
+        # disaggregated routing: long prompts steered to prefill
+        # specialists / short ones away, plus each replica's declared role
+        role_routes = self.registry.counter(
+            "dtx_gateway_role_routes_total",
+            "Role-aware routing outcomes: prefill = long prompt steered "
+            "to a prefill specialist, decode = short prompt steered away "
+            "from them, blind = no role signal narrowed the candidates.")
+        replica_role = g("dtx_gateway_replica_role",
+                         "Per-replica declared disaggregation role, "
+                         "one-hot by label (scraped from "
+                         "dtx_serving_role on remote replicas).")
         circuit.clear()
         up.clear()
         busy.clear()
@@ -864,12 +905,17 @@ class Gateway:
         a_resident.clear()
         spec_rate.clear()
         spec_routes.clear()
+        role_routes.clear()
+        replica_role.clear()
         with self.router._lock:
             routes = dict(self.router.adapter_routes)
             per_adapter = dict(self.router.adapter_requests)
             s_routes = dict(getattr(self.router, "spec_routes", {}))
+            r_routes = dict(getattr(self.router, "role_routes", {}))
         for outcome, n in sorted(s_routes.items()):
             spec_routes.set(n, {"outcome": outcome})
+        for outcome, n in sorted(r_routes.items()):
+            role_routes.set(n, {"outcome": outcome})
         for outcome, n in sorted(routes.items()):
             a_routes.set(n, {"outcome": outcome})
         for name, n in sorted(per_adapter.items()):
@@ -903,6 +949,8 @@ class Gateway:
                               {"replica": r.name})
             weight.set(round(getattr(r, "weight", 1.0), 6),
                        {"replica": r.name})
+            replica_role.set(1, {"replica": r.name,
+                                 "role": getattr(r, "role", "mixed")})
             out = r.outcome_stats()
             attempts.set(out["requests"] - out["errors"],
                          {"replica": r.name, "outcome": "ok"})
@@ -910,7 +958,65 @@ class Gateway:
                          {"replica": r.name, "outcome": "error"})
         for a, n in sorted(residency.items()):
             a_resident.set(n, {"adapter": a})
+        if self.fleet is not None:
+            self._restate_fleet_locked()
         return self.registry.expose(with_exemplars=with_exemplars)
+
+    def _restate_fleet_locked(self):
+        """dtx_fleet_* series, restated from the fleet plane's counters
+        at scrape time (same pattern as the router's). Only emitted when
+        the plane exists — a fleet-less gateway's exposition is unchanged
+        down to the byte."""
+        g = self.registry.gauge
+        fstats = self.fleet.stats()
+        prefix = fstats.get("prefix")
+        if prefix is not None:
+            g("dtx_fleet_prefix_entries",
+              "Prefix payloads resident in the fleet-shared tier "
+              "directory.").set(prefix["entries"])
+            g("dtx_fleet_prefix_bytes",
+              "Approximate directory footprint of the fleet prefix tier "
+              "(b64 wire bytes; LRU-evicted past the budget).").set(
+                prefix["bytes"])
+            pub = self.registry.counter(
+                "dtx_fleet_prefix_publishes_total",
+                "Prefix entries pulled from a replica into the fleet "
+                "tier (first prefill of a shared prompt).")
+            hits = self.registry.counter(
+                "dtx_fleet_prefix_hits_total",
+                "Peer imports that activated a fleet prefix entry — "
+                "that replica's next matching request prefills zero "
+                "chunks.")
+            misses = self.registry.counter(
+                "dtx_fleet_prefix_misses_total",
+                "Prefix pushes a peer refused or failed (no free "
+                "slot/blocks, adapter not loaded there, transport "
+                "fault).")
+            pub.set(prefix["publishes"])
+            hits.set(prefix["hits"])
+            misses.set(prefix["misses"])
+        handoff = fstats.get("handoff")
+        if handoff is not None:
+            c = self.registry.counter(
+                "dtx_fleet_handoff_total",
+                "Prefill→decode re-homings by outcome (ok = continuation "
+                "parked on a decode peer, cold = no peer could admit, "
+                "skipped = still mid-prefill this tick, none = no "
+                "decode-side peer existed).")
+            c.clear()
+            for outcome, n in sorted(handoff.items()):
+                c.set(n, {"outcome": outcome})
+        spill = fstats.get("spill")
+        if spill is not None:
+            c = self.registry.counter(
+                "dtx_fleet_spill_total",
+                "Parked-session spills to a peer by outcome (ok = "
+                "re-homed token-exactly, refused = every peer 409'd, "
+                "error = transport/drop fault, skipped = no eligible "
+                "peer).")
+            c.clear()
+            for outcome, n in sorted(spill.items()):
+                c.set(n, {"outcome": outcome})
 
     # ------------------------------------------------------------ promotion
     def set_weight(self, name: str, weight: float) -> bool:
@@ -984,6 +1090,8 @@ class Gateway:
 
     def close(self):
         self.slo.stop()
+        if self.fleet is not None:
+            self.fleet.stop()
         if self.replica_set is not None:
             self.replica_set.close()
         self.pool.close()
@@ -1004,9 +1112,15 @@ class ManagedReplicaSet:
 
     def __init__(self, pool: ReplicaPool, server_args: List[str],
                  workdir: str = "", drain_timeout_s: float = 30.0,
-                 supervise_interval_s: float = 2.0):
+                 supervise_interval_s: float = 2.0,
+                 roles: Optional[List[str]] = None):
         self.pool = pool
         self.server_args = list(server_args)
+        # disaggregation role cycle ("prefill,decode" = half and half):
+        # each spawn takes the role furthest below its share of the
+        # cycle, so a replacement restores the fleet's role balance no
+        # matter which replica died. Empty/None = role-less (mixed).
+        self.roles = [r for r in (roles or []) if r]
         self.workdir = workdir or os.getcwd()
         self.drain_timeout_s = drain_timeout_s
         self.target = 0
@@ -1032,21 +1146,43 @@ class ManagedReplicaSet:
                 daemon=True)
             self._supervisor.start()
 
+    def _next_role(self) -> Optional[str]:
+        """The role this spawn should take: the cycle entry furthest
+        below its share of the live fleet (ties break in cycle order, so
+        a fresh fleet spawns exactly the configured cycle)."""
+        if not self.roles:
+            return None
+        want: dict = {}
+        for r in self.roles:
+            want[r] = want.get(r, 0) + 1
+        live = {r: 0 for r in want}
+        for rep in self.pool.replicas():
+            role = getattr(rep, "role", "mixed")
+            if role in live and not rep.draining:
+                live[role] += 1
+        return min(want, key=lambda r: (live[r] / want[r],
+                                        self.roles.index(r)))
+
     def spawn(self) -> HTTPReplica:
         with self._lock:
             idx = self._next_idx
             self._next_idx += 1
         name = f"replica-{idx}"
         port = _free_port()
+        role = self._next_role()
+        args = list(self.server_args)
+        if role:
+            args += ["--role", role]
         log = open(os.path.join(self.workdir, f"{name}.log"), "w")
         proc = subprocess.Popen(
             [sys.executable, "-m", "datatunerx_tpu.serving.server",
-             *self.server_args, "--port", str(port)],
+             *args, "--port", str(port)],
             stdout=log, stderr=subprocess.STDOUT, cwd=self.workdir,
         )
         with self._lock:
             self._procs[name] = proc
-        replica = HTTPReplica(name, f"http://127.0.0.1:{port}")
+        replica = HTTPReplica(name, f"http://127.0.0.1:{port}",
+                              role=role or "mixed")
         replica.healthy = False  # until the health probe sees model loaded
         self._apply_inheritance(replica)
         self.pool.add(replica)
@@ -1272,6 +1408,11 @@ def make_handler(gw: Gateway):
                 self.wfile.write(body)
             elif self.path == "/debug/slo":
                 self._json(200, self.gateway.slo_report())
+            elif self.path == "/debug/fleet":
+                if self.gateway.fleet is None:
+                    self._json(404, {"error": "fleet plane not enabled"})
+                else:
+                    self._json(200, self.gateway.fleet.stats())
             elif self.path.startswith("/debug/trace/"):
                 tid = self.path[len("/debug/trace/"):]
                 doc = self.gateway.trace(tid) if tid else None
@@ -1520,6 +1661,33 @@ def main(argv=None):
                    help="background SLO sampling interval so the burn-rate "
                         "windows have history without a /debug/slo poller "
                         "(0 disables the sampler)")
+    p.add_argument("--prefill_threshold", type=int, default=0,
+                   help="prompts of >= this many tokens PREFER replicas "
+                        "declaring role=prefill (shorter prompts prefer "
+                        "the rest); 0 (default) disables role-aware "
+                        "routing entirely")
+    p.add_argument("--fleet_prefix_mb", type=float, default=0.0,
+                   help="fleet-shared prefix tier budget in MB: the "
+                        "first replica to prefill a shared system prompt "
+                        "publishes it and peers import it COW — their "
+                        "first matching request prefills zero chunks. "
+                        "0 (default) disables the tier")
+    p.add_argument("--fleet_handoff", type=int, default=0,
+                   help="1: prefill→decode handoff — finished prompt "
+                        "work on role=prefill replicas is re-homed onto "
+                        "decode peers (and drains ship mid-prefill "
+                        "tails); 0 (default) off")
+    p.add_argument("--fleet_spill", type=int, default=0,
+                   help="1: peer-replica KV spill — preemption-parked "
+                        "sessions re-home onto a peer with free blocks "
+                        "instead of waiting locally; 0 (default) off")
+    p.add_argument("--fleet_interval", type=float, default=1.0,
+                   help="fleet coordination tick interval in seconds "
+                        "(prefix sync + handoff + spill passes)")
+    p.add_argument("--role", default="",
+                   help="comma-separated role cycle for spawned replicas "
+                        "(e.g. 'prefill,decode' alternates; entries from "
+                        "prefill/decode/mixed); empty = all mixed")
     p.add_argument("--session_handoff", type=int, default=1,
                    help="1 (default): drain exports every in-flight KV "
                         "session from the leaving replica and imports it "
@@ -1564,6 +1732,10 @@ def main(argv=None):
         p.error("need --replica_url URL(s) or --replicas N with --model_path")
     if args.replicas > 0 and not args.model_path:
         p.error("--replicas spawning requires --model_path")
+    roles = [r.strip() for r in args.role.split(",") if r.strip()]
+    for r in roles:
+        if r not in ("prefill", "decode", "mixed"):
+            p.error(f"--role entries must be prefill/decode/mixed, got {r!r}")
 
     # token-accurate admission (ROADMAP): count prefill tokens with the real
     # tokenizer when one is loadable; otherwise the chars/token heuristic
@@ -1589,9 +1761,15 @@ def main(argv=None):
                  trace_ring=args.trace_ring,
                  trace_log_path=args.trace_log or None,
                  slos=load_slos(args.slo_config) if args.slo_config else None,
-                 session_handoff=bool(args.session_handoff))
+                 session_handoff=bool(args.session_handoff),
+                 prefill_threshold=args.prefill_threshold,
+                 fleet_prefix_bytes=int(args.fleet_prefix_mb * 1024 * 1024),
+                 fleet_handoff=bool(args.fleet_handoff),
+                 fleet_spill=bool(args.fleet_spill))
     if args.slo_sample_s > 0:
         gw.slo.start(args.slo_sample_s)
+    if gw.fleet is not None:
+        gw.fleet.start(args.fleet_interval)
     for i, url in enumerate(args.replica_url):
         pool.add(HTTPReplica(f"replica-{i}", url))
     if args.replicas > 0:
@@ -1619,7 +1797,8 @@ def main(argv=None):
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]
         gw.replica_set = ManagedReplicaSet(
-            pool, server_args, workdir=args.workdir or "gateway-replicas")
+            pool, server_args, workdir=args.workdir or "gateway-replicas",
+            roles=roles)
         gw.replica_set.scale(args.replicas)
 
     srv = serve(gw, port=args.port)

@@ -296,11 +296,17 @@ class _FakeEngine:
     handoff and adapter-evict chaos without loading a model."""
 
     def __init__(self, name: str, delay_s: float = 0.002,
-                 adapters: Optional[List[str]] = None):
+                 adapters: Optional[List[str]] = None,
+                 prefill_steps: int = 0):
         from datatunerx_tpu.obs.trace import TraceStore
 
         self.name = name
         self.delay_s = delay_s
+        # chunked-prefill stand-in: each session burns this many silent
+        # steps (no deltas) before its first token — a drain that lands
+        # inside them exercises the mid-prefill export/import tail path
+        self.prefill_steps = max(0, int(prefill_steps))
+        self.mid_prefill_imports = 0
         self.fail = False
         self.adapter_ids = {"": 0}
         for i, a in enumerate(adapters or []):
@@ -325,11 +331,18 @@ class _FakeEngine:
             raise RuntimeError(f"{self.name}: injected fault")
         n = max(1, min(int(max_new_tokens), 8))
         sess = {"trace_id": trace_id, "total": n, "emitted": 0,
-                "migrate": False, "adapter": kw.get("adapter", "")}
+                "migrate": False, "adapter": kw.get("adapter", ""),
+                "prefill_done": 0, "prefill_total": self.prefill_steps}
         if trace_id:
             with self._lock:
                 self._live[trace_id] = sess
         try:
+            while sess["prefill_done"] < sess["prefill_total"]:
+                time.sleep(self.delay_s)
+                if sess["migrate"]:
+                    raise RuntimeError(
+                        f"session migrated off {self.name}")
+                sess["prefill_done"] += 1
             for i in range(n):
                 time.sleep(self.delay_s)
                 if self.fail and i > 0:
@@ -350,17 +363,27 @@ class _FakeEngine:
         return "".join(self.chat_stream(messages, **kw))
 
     # ------------------------------------------ KV migration (fake twin)
-    def export_sessions(self, slots=None, wire_quant=None) -> dict:
+    def export_sessions(self, slots=None, wire_quant=None,
+                        include_prefill: bool = False) -> dict:
         with self._lock:
             live = list(self._live.values())
         sessions = []
+        skipped = []
         for sess in live:
+            mid_prefill = sess["prefill_done"] < sess["prefill_total"]
+            if mid_prefill and not include_prefill:
+                # real-engine contract: mid-prefill sessions only ship
+                # when the caller asks for tails (the drain path)
+                skipped.append(sess["trace_id"])
+                continue
             sess["migrate"] = True  # the stream dies with the marker
             sessions.append({"fake": True, "trace_id": sess["trace_id"],
                              "emitted": int(sess["emitted"]),
                              "total": int(sess["total"]),
-                             "adapter": sess["adapter"]})
-        return {"sessions": sessions, "skipped": []}
+                             "adapter": sess["adapter"],
+                             "prefill_done": int(sess["prefill_done"]),
+                             "prefill_total": int(sess["prefill_total"])})
+        return {"sessions": sessions, "skipped": skipped}
 
     def import_session(self, payload: dict) -> dict:
         if not payload.get("fake"):
@@ -369,11 +392,22 @@ class _FakeEngine:
         if adapter and adapter not in self.adapter_ids:
             raise ValueError(f"unknown adapter {adapter!r}")
         emitted = int(payload["emitted"])
-        handle = {"remaining": max(0, int(payload["total"]) - emitted)}
+        pf_done = int(payload.get("prefill_done") or 0)
+        pf_total = int(payload.get("prefill_total") or 0)
+        if pf_done < pf_total:
+            self.mid_prefill_imports += 1
+        handle = {"remaining": max(0, int(payload["total"]) - emitted),
+                  # resume the prompt where the source stopped — the done
+                  # part is NOT redone (the zero-re-prefill contract)
+                  "prefill_remaining": max(0, pf_total - pf_done)}
         return {"session": payload.get("trace_id"), "tokens": emitted,
                 "text_so_far": "tok " * emitted, "_request": handle}
 
     def resume_stream(self, handle: dict):
+        for _ in range(handle.get("prefill_remaining", 0)):
+            time.sleep(self.delay_s)
+            if self.fail:
+                raise RuntimeError(f"{self.name}: killed mid-resume")
         for _ in range(handle["remaining"]):
             time.sleep(self.delay_s)
             if self.fail:
@@ -386,9 +420,13 @@ class _FakeEngine:
 
 def build_selftest_fleet(adapters: Optional[List[str]] = None,
                          session_handoff: bool = True,
-                         delay_s: float = 0.002):
+                         delay_s: float = 0.002,
+                         roles: Optional[List[str]] = None,
+                         prefill_steps: int = 0):
     """2 in-process fake replicas behind a real Gateway — the CI smoke
-    fleet. Returns (gateway, engines)."""
+    fleet. Returns (gateway, engines). ``roles`` assigns disaggregation
+    roles by replica index and turns the fleet handoff plane on, so a
+    drain ships mid-prefill tails instead of skipping them."""
     from datatunerx_tpu.gateway.replica_pool import (
         InProcessReplica,
         ReplicaPool,
@@ -396,12 +434,17 @@ def build_selftest_fleet(adapters: Optional[List[str]] = None,
     from datatunerx_tpu.gateway.server import Gateway
 
     adapters = adapters if adapters is not None else ["tenant-a", "tenant-b"]
+    roles = roles or []
     engines = [_FakeEngine(f"replica-{i}", delay_s=delay_s,
-                           adapters=adapters)
+                           adapters=adapters, prefill_steps=prefill_steps)
                for i in range(2)]
-    pool = ReplicaPool([InProcessReplica(e.name, e) for e in engines])
+    pool = ReplicaPool([
+        InProcessReplica(e.name, e,
+                         role=roles[i] if i < len(roles) else "mixed")
+        for i, e in enumerate(engines)])
     gw = Gateway(pool, model_name="selftest",
-                 session_handoff=session_handoff)
+                 session_handoff=session_handoff,
+                 fleet_handoff=bool(roles))
     return gw, engines
 
 
@@ -419,12 +462,14 @@ def drain_when_busy(gw, name: str, wait_s: float = 3.0) -> dict:
             "handoff": gw.last_handoff}
 
 
-def selftest_chaos(gw, engines, duration_s: float) -> ChaosInjector:
+def selftest_chaos(gw, engines, duration_s: float,
+                   drain_replica: str = "replica-1") -> ChaosInjector:
     """The default self-test schedule: one /admin/drain mid-run, fired
-    when the replica is mid-stream (replica-1 stops taking traffic; its
-    sessions hand off and availability must hold on replica-0)."""
+    when the replica is mid-stream (the drained replica stops taking
+    traffic; its sessions hand off and availability must hold on the
+    survivor)."""
     ops = [{"t": round(duration_s * 0.5, 3), "op": "drain",
-            "replica": "replica-1"}]
+            "replica": drain_replica}]
     actions = {
         "drain": lambda op: drain_when_busy(gw, op["replica"]),
         "kill": lambda op: _kill_engine(engines, op["replica"]),
@@ -545,6 +590,16 @@ def main(argv=None) -> int:
     p.add_argument("--selftest_delay", type=float, default=0.002,
                    help="selftest per-token delay (raise it so a "
                         "mid-stream drain reliably catches sessions)")
+    p.add_argument("--roles", default="",
+                   help="selftest fleet: comma-separated disaggregation "
+                        "roles by replica index (e.g. 'prefill,decode') — "
+                        "turns the fleet handoff plane on and points the "
+                        "default drain chaos at the first prefill replica")
+    p.add_argument("--selftest_prefill", type=int, default=0,
+                   help="selftest: silent prefill steps per session before "
+                        "the first token; with --roles + --expect_handoff "
+                        "the drain must catch and re-home at least one "
+                        "session mid-prefill with its prompt work kept")
     p.add_argument("--report_json", default="",
                    help="write the full report (results + chaos log + SLO "
                         "verdicts) to this file")
@@ -590,11 +645,22 @@ def main(argv=None) -> int:
     trace_duration = events[-1]["t"] if events else 0.0
     try:
         if args.selftest:
+            roles = [r.strip() for r in args.roles.split(",") if r.strip()]
+            for r in roles:
+                if r not in ("prefill", "decode", "mixed"):
+                    p.error(f"--roles: {r!r} is not prefill/decode/mixed")
             gw, engines = build_selftest_fleet(
                 adapters or None, session_handoff=args.handoff == "on",
-                delay_s=args.selftest_delay)
+                delay_s=args.selftest_delay, roles=roles or None,
+                prefill_steps=args.selftest_prefill)
             client = LocalClient(gw)
-            default = selftest_chaos(gw, engines, trace_duration)
+            # with roles on, the interesting drain is the prefill
+            # specialist — caught mid-prompt, its tail must ship
+            drain_target = "replica-1"
+            if roles and "prefill" in roles:
+                drain_target = f"replica-{roles.index('prefill')}"
+            default = selftest_chaos(gw, engines, trace_duration,
+                                     drain_replica=drain_target)
             chaos = (ChaosInjector(load_chaos(args.chaos), default.actions)
                      if args.chaos else default)
         else:
@@ -640,6 +706,17 @@ def main(argv=None) -> int:
                           if int(c) >= 500)
             if dropped:
                 problems.append(f"{dropped} request(s) dropped (5xx)")
+            if engines is not None and args.selftest_prefill > 0:
+                mid = sum(e.mid_prefill_imports for e in engines)
+                report["mid_prefill_imports"] = mid
+                if mid < 1:
+                    problems.append(
+                        "no session was re-homed mid-prefill (the drain "
+                        "missed the prompt phase — raise "
+                        "--selftest_prefill or --selftest_delay)")
+                else:
+                    print(f"[replay] {mid} session(s) re-homed "
+                          "mid-prefill with prompt work kept")
             for p_ in problems:
                 print(f"[replay] handoff assertion FAILED: {p_}")
             if problems:

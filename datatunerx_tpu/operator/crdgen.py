@@ -97,6 +97,25 @@ SPECS = {
                        "enum": ["least_busy", "round_robin"]},
             "minReplicas": INT,
             "maxReplicas": INT,
+            # paged-KV overcommit (serving --kv_overcommit): admission by
+            # prompt-need + headroom, on-demand growth, preempt-and-park
+            "kvOvercommit": {"type": "string", "enum": ["", "off", "on"]},
+            # speculative decoding (serving --spec_draft_config/--spec_k/
+            # --spec_mode): draft-propose / verify-k decode
+            "specDraft": STR,
+            "specK": INT,
+            "specMode": {"type": "string",
+                         "enum": ["", "auto", "on", "off"]},
+            # disaggregated fleet plane (gateway/server.py): role is a
+            # single role for one server or a comma cycle the gateway
+            # assigns across spawned replicas; prompts >= the threshold
+            # prefer prefill specialists; the fleet knobs enable the
+            # shared prefix tier / prefill→decode handoff / peer KV spill
+            "role": STR,
+            "prefillThreshold": INT,
+            "fleetPrefixMb": {"type": "number"},
+            "fleetHandoff": BOOL,
+            "fleetSpill": BOOL,
         }),
     }, required=["finetune"]),
     "FinetuneExperiment": obj({

@@ -241,6 +241,20 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         "min_replicas": int(serve_cfg.get("minReplicas") or 1),
         "max_replicas": int(serve_cfg.get("maxReplicas")
                             or serve_cfg.get("replicas") or 1),
+        # paged-KV overcommit + speculative decoding (serving/server.py
+        # --kv_overcommit / --spec_draft_config / --spec_k / --spec_mode)
+        "kv_overcommit": serve_cfg.get("kvOvercommit") or "",
+        "spec_draft_config": serve_cfg.get("specDraft") or "",
+        "spec_k": serve_cfg.get("specK"),
+        "spec_mode": serve_cfg.get("specMode") or "",
+        # disaggregated fleet plane (gateway/server.py --role /
+        # --prefill_threshold / --fleet_*): replica roles, the shared
+        # prefix tier, prefill→decode handoff, peer KV spill
+        "role": serve_cfg.get("role") or "",
+        "prefill_threshold": serve_cfg.get("prefillThreshold"),
+        "fleet_prefix_mb": serve_cfg.get("fleetPrefixMb"),
+        "fleet_handoff": bool(serve_cfg.get("fleetHandoff")),
+        "fleet_spill": bool(serve_cfg.get("fleetSpill")),
     }
 
 

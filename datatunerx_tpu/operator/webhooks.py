@@ -140,6 +140,33 @@ def _validate_serve_config(cfg: dict):
     if cfg.get("policy") is not None:
         _require(str(cfg["policy"]) in ("least_busy", "round_robin"),
                  "serveConfig.policy must be least_busy or round_robin")
+    if cfg.get("kvOvercommit") not in (None, ""):
+        _require(str(cfg["kvOvercommit"]) in ("off", "on"),
+                 "serveConfig.kvOvercommit must be off or on")
+    if cfg.get("specMode") not in (None, ""):
+        _require(str(cfg["specMode"]) in ("auto", "on", "off"),
+                 "serveConfig.specMode must be auto, on, or off")
+    for key in ("specK", "prefillThreshold"):
+        if cfg.get(key) is not None:
+            v = _num(cfg[key], f"serveConfig.{key}")
+            _require(v >= 1 and float(v).is_integer(),
+                     f"serveConfig.{key} must be a positive integer")
+    if cfg.get("fleetPrefixMb") is not None:
+        _require(_num(cfg["fleetPrefixMb"],
+                      "serveConfig.fleetPrefixMb") > 0,
+                 "serveConfig.fleetPrefixMb must be > 0")
+    if cfg.get("role") not in (None, ""):
+        roles = [r.strip() for r in str(cfg["role"]).split(",") if r.strip()]
+        _require(bool(roles), "serveConfig.role must name at least one role")
+        for r in roles:
+            _require(r in ("prefill", "decode", "mixed"),
+                     "serveConfig.role entries must be prefill, decode, "
+                     "or mixed")
+        gateway = bool(cfg.get("gateway")) or \
+            int(float(cfg.get("replicas") or 1)) > 1
+        _require(len(roles) == 1 or gateway,
+                 "serveConfig.role cycles need the gateway (replicas > 1 "
+                 "or gateway=true) to distribute them")
 
 
 def validate_finetuneexperiment(obj: CustomResource):

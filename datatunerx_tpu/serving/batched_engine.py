@@ -184,6 +184,17 @@ class _PrefixCache:
         for ent in entries:
             self._on_evict(ent)
 
+    def snapshot_entries(self):
+        """MRU-first (key, entry) pairs WITHOUT touching recency — the
+        fleet prefix tier's publish scan. The list is a point-in-time
+        copy; entries may be evicted while the caller iterates (COW block
+        entries are only freed via on_evict, so a concurrently-evicted
+        entry's blocks may already be recycled — callers on the scheduler
+        thread are safe, eviction happens there or under drop_adapter
+        which the admin surface serializes)."""
+        with self._lock:
+            return list(reversed(list(self._d.items())))
+
     def pop_lru_block_entry(self):
         """Evict (and return) the least-recently-used BLOCK entry — the
         overcommit scheduler's first reclamation tier when growth finds
@@ -590,6 +601,7 @@ class BatchedEngine:
         tracing: bool = True,  # per-request span timelines + trace ring
         trace_ring: int = 256,  # completed traces kept for /debug/trace
         trace_log_path: Optional[str] = None,  # optional JSONL span log
+        prefix_keep_warm: bool = False,  # publish prompt blocks on preempt
     ):
         # serving is single-program: clear any mesh a Trainer left in the
         # process-global flash context before the engine's jits first trace
@@ -892,6 +904,15 @@ class BatchedEngine:
         # refcounted BLOCK entries — hits map shared physical blocks into
         # the new slot's table instead of the dense-row copy + re-insert
         self.cow = self.overcommit and self._prefix is not None
+        # keep-warm (fleet plane, off by default = byte-identical engine):
+        # a preempted/drained slot publishes its prompt blocks as a
+        # no_reuse prefix entry before freeing, so the prompt survives the
+        # park as a COW-extendable prefix instead of dying with the slot.
+        # Requires COW entries (the publish is a block incref + tail copy).
+        self.prefix_keep_warm = bool(prefix_keep_warm) and self.cow
+        # slot → (prefix-cache key, prompt cursor) of the prompt the slot
+        # holds — what keep-warm publishes at preemption time
+        self._slot_key: List[Optional[tuple]] = [None] * slots
         # observability: how admissions were served (tests + /metrics)
         self.prefill_stats = {"full": 0, "reuse": 0, "extend": 0}
         # Shared-registry latency histograms. Recording is BUFFERED off the
@@ -951,6 +972,13 @@ class BatchedEngine:
         demand = sum(self._slot_demand[s] for s in range(self.slots)
                      if self._slot_req[s] is not None)
         return round(demand / max(1, self._allocator.num_blocks), 4)
+
+    @property
+    def parked_sessions(self) -> int:
+        """Preemption-parked sessions awaiting local resume — what the
+        fleet spill coordinator polls (via /stats) to find re-homing
+        candidates. Host-side list length; safe from any thread."""
+        return len(self._preempted)
 
     def _free_prefix_entry(self, ent: dict):
         """Prefix-cache eviction hook: return a COW block entry's refs to
@@ -1133,7 +1161,11 @@ class BatchedEngine:
         # the effective budget below min(requested, cold)
         need = min(budget_needed, self.max_seq_len - plen)
         ent = self._prefix.get(key)
-        if ent is not None and self.max_seq_len - ent["cursor"] >= need:
+        # no_reuse entries (keep-warm publishes, logits-free tier imports)
+        # carry no activation logits — they serve strict-prefix extension
+        # only, never the exact-hit fast path
+        if (ent is not None and not ent.get("no_reuse")
+                and self.max_seq_len - ent["cursor"] >= need):
             self.prefill_stats["reuse"] += 1
             return ent["logits"], ent["cache"], ent["cursor"]
         pkey, pent = self._prefix.longest_prefix(used, akey)
@@ -1414,6 +1446,7 @@ class BatchedEngine:
         need = min(max_new, self.max_seq_len - plen)
         ent = self._prefix.get(key)
         if (ent is not None and ent.get("blocks") is not None
+                and not ent.get("no_reuse")
                 and self.max_seq_len - ent["cursor"] >= need):
             m = max(1, min(max_new, self.max_seq_len - ent["cursor"]))
             ok = self._cow_map(req, slot, ent, n_prompt, m,
@@ -1495,6 +1528,7 @@ class BatchedEngine:
         self._slot_blocks[slot] = blocks
         self._slot_req[slot] = req
         self._slot_demand[slot] = self._eager_demand(final, max_new)
+        self._slot_key[slot] = (key, final)
         if suffix is None:
             self._decode_ready[slot] = True
         else:
@@ -1532,6 +1566,45 @@ class BatchedEngine:
         self._prefix.put(key, {"blocks": ent_blocks, "full": full,
                                "rem": rem, "cursor": cursor,
                                "logits": row_logits})
+
+    def _keep_warm(self, slot: int):
+        """Publish the slot's PROMPT prefix into the prefix cache as a
+        no-reuse COW block entry right before the slot is released
+        (preemption / drain export), so a resume — here or on a peer —
+        admits via a COW strict-prefix hit instead of re-paying the
+        prefix prefill. No logits are stored: exact-hit arming needs the
+        prompt's last-token logits, which a slot that has decoded past
+        its prompt no longer has, hence ``no_reuse``. Best-effort — a
+        missing key, an existing entry, or a pool too tight for the tail
+        copy all skip silently (serving beats caching)."""
+        sk = self._slot_key[slot]
+        if sk is None:
+            return
+        key, pcursor = sk
+        if self._prefix.get(key) is not None:
+            return
+        full, rem = divmod(pcursor, self.block_size)
+        blocks = self._slot_blocks[slot]
+        if len(blocks) < full + (1 if rem else 0):
+            return
+        shared = list(blocks[:full])
+        ent_blocks = list(shared)
+        if rem:
+            tail = self._allocator.alloc(1)
+            if tail is None:
+                return
+            # decode lanes past the prompt cursor live at offsets >= rem
+            # of the tail block — the COW copy scrubs them in the copy
+            self._cache = self._copy_block(
+                self._cache, jnp.asarray(blocks[full], jnp.int32),
+                jnp.asarray(tail[0], jnp.int32),
+                jnp.asarray(rem, jnp.int32))
+            ent_blocks = shared + tail
+        self._allocator.incref(shared)
+        self._prefix.put(key, {"blocks": ent_blocks, "full": full,
+                               "rem": rem, "cursor": pcursor,
+                               "logits": None, "no_reuse": True})
+        self._trace("keep_warm", slot, pcursor)
 
     def _alloc_blocks(self, depth: int) -> Optional[List[int]]:
         from datatunerx_tpu.ops.paged_attention import blocks_for_depth
@@ -1701,10 +1774,13 @@ class BatchedEngine:
                 *self._arm_args(req, st["n_prompt"], max_new),
             )
         self._decode_ready[slot] = True
-        if not st.get("base"):
-            # suffix extensions already counted as "extend" at admission
+        if not st.get("base") and st.get("key") is not None:
+            # suffix extensions already counted as "extend" at admission;
+            # imported mid-prefill tails (key None) are not cold prefills
             self.prefill_stats["full"] += 1
-        if self._prefix is not None:
+        if st.get("key") is not None:
+            self._slot_key[slot] = (st["key"], cursor)
+        if self._prefix is not None and st.get("key") is not None:
             if self.cow:
                 # publish refcounted blocks — no dense-row materialisation
                 self._cow_store(slot, st["key"], cursor, row_logits)
@@ -1728,7 +1804,8 @@ class BatchedEngine:
     # ------------------------------------------------- KV migration fabric
     def export_sessions(self, slots: Optional[Sequence[int]] = None,
                         wire_quant: Optional[str] = None,
-                        timeout_s: float = 30.0) -> dict:
+                        timeout_s: float = 30.0,
+                        include_prefill: bool = False) -> dict:
         """Serialize every in-flight decode session (or just ``slots``)
         into portable payloads (serving/migration.py wire format) AND
         terminate the source requests with the migrated marker — their
@@ -1738,11 +1815,17 @@ class BatchedEngine:
         the command and waits. Returns {"sessions": [...], "skipped":
         [{"slot", "reason"}]} — slots mid-chunked-prefill are skipped
         (their KV is incomplete; they finish in place on the draining
-        replica, the counted fallback)."""
+        replica, the counted fallback).
+
+        ``include_prefill=True`` ships mid-chunked-prefill slots too
+        (disaggregated handoff): the payload carries the blocks written so
+        far plus a ``pending`` document with the remaining prompt tail, and
+        the importer resumes chunked prefill where the source stopped."""
         return self._mig_call({"kind": "export",
                                "slots": (None if slots is None
                                          else [int(s) for s in slots]),
-                               "wire": wire_quant}, timeout_s)
+                               "wire": wire_quant,
+                               "prefill": bool(include_prefill)}, timeout_s)
 
     def import_session(self, payload: dict, timeout_s: float = 30.0,
                        wait_s: float = 10.0) -> dict:
@@ -1763,6 +1846,63 @@ class BatchedEngine:
         migrated tail)."""
         return self._mig_call(
             {"kind": "import", "payload": payload,
+             "deadline": time.monotonic() + wait_s}, timeout_s)
+
+    def hold_parked(self, max_sessions: int = 4, hold_s: float = 10.0,
+                    timeout_s: float = 30.0) -> dict:
+        """Phase 1 of a peer spill: lease up to ``max_sessions``
+        preemption-parked payloads to the fleet coordinator. A held entry
+        will not resume locally until the hold expires (or is released) —
+        and, because the parked head still gates younger cold admissions,
+        FIFO fairness holds while the coordinator re-homes it. Holds are
+        time-bounded so a dead coordinator never wedges resumption.
+        Returns {"sessions": [{"trace_id", "seq", "cursor", "remaining",
+        "payload"}], "parked": n}."""
+        return self._mig_call({"kind": "hold_parked",
+                               "max_sessions": int(max_sessions),
+                               "hold_s": float(hold_s)}, timeout_s)
+
+    def drop_parked(self, trace_ids: Sequence[str],
+                    timeout_s: float = 30.0) -> dict:
+        """Phase 2 (success): the coordinator imported these parked
+        sessions onto a peer — drop them here and terminate their source
+        requests with the migrated marker so the gateway splices."""
+        return self._mig_call({"kind": "drop_parked",
+                               "trace_ids": [str(t) for t in trace_ids]},
+                              timeout_s)
+
+    def release_parked(self, trace_ids: Sequence[str],
+                       timeout_s: float = 30.0) -> dict:
+        """Phase 2 (failure): the peer refused — clear the hold so the
+        sessions resume locally as if the spill was never attempted."""
+        return self._mig_call({"kind": "release_parked",
+                               "trace_ids": [str(t) for t in trace_ids]},
+                              timeout_s)
+
+    def export_prefix_entries(self, exclude: Optional[Sequence[str]] = None,
+                              max_entries: int = 4,
+                              wire_quant: Optional[str] = None,
+                              timeout_s: float = 30.0) -> dict:
+        """Serialize up to ``max_entries`` local prefix-cache entries
+        (MRU first) as ``dtx-kv-prefix`` payloads for the fleet-shared
+        prefix tier, skipping fingerprints in ``exclude`` (what the
+        gateway directory already holds). Non-destructive: entries stay
+        cached locally. Returns {"entries": [payload, ...]}."""
+        return self._mig_call({"kind": "export_prefix",
+                               "exclude": (set(exclude) if exclude
+                                           else set()),
+                               "max_entries": int(max_entries),
+                               "wire": wire_quant}, timeout_s)
+
+    def import_prefix_entry(self, payload: dict, timeout_s: float = 30.0,
+                            wait_s: float = 5.0) -> dict:
+        """Install a fleet-published prefix payload into the local
+        ``_PrefixCache`` so the NEXT prompt sharing that prefix admits via
+        the COW hit path with zero prefill chunks. Transient block
+        shortages retry until ``wait_s``; permanent mismatches (model
+        signature, unknown adapter) raise ValueError."""
+        return self._mig_call(
+            {"kind": "import_prefix", "payload": payload,
              "deadline": time.monotonic() + wait_s}, timeout_s)
 
     def resume_stream(self, req: Request):
@@ -1810,7 +1950,7 @@ class BatchedEngine:
         return cmd["_result"]
 
     def _count_mig(self, kind: str, outcome: str):
-        d = self.session_stats[kind]
+        d = self.session_stats.setdefault(kind, {})
         d[outcome] = d.get(outcome, 0) + 1
 
     def _service_migrations(self):
@@ -1826,8 +1966,21 @@ class BatchedEngine:
             try:
                 if cmd["kind"] == "export":
                     cmd["_result"] = self._do_export(cmd)
-                else:
+                elif cmd["kind"] == "import":
                     cmd["_result"] = self._do_import(cmd)
+                elif cmd["kind"] == "hold_parked":
+                    cmd["_result"] = self._do_hold_parked(cmd)
+                elif cmd["kind"] == "drop_parked":
+                    cmd["_result"] = self._do_drop_parked(cmd)
+                elif cmd["kind"] == "release_parked":
+                    cmd["_result"] = self._do_release_parked(cmd)
+                elif cmd["kind"] == "export_prefix":
+                    cmd["_result"] = self._do_export_prefix(cmd)
+                elif cmd["kind"] == "import_prefix":
+                    cmd["_result"] = self._do_import_prefix(cmd)
+                else:
+                    raise ValueError(
+                        f"unknown session command {cmd['kind']!r}")
             except _RetryLater as retry:
                 if time.monotonic() < cmd.get("deadline", 0.0):
                     cmd["_retry_reason"] = str(retry)
@@ -1859,6 +2012,35 @@ class BatchedEngine:
                     skipped.append({"slot": slot, "reason": "empty"})
                 continue
             if not self._decode_ready[slot]:
+                st = self._pending.get(slot)
+                if cmd.get("prefill") and st is not None and self.paged:
+                    # disaggregated handoff: ship the blocks written so
+                    # far plus the remaining prompt tail — the importer
+                    # resumes chunked prefill exactly where we stopped
+                    try:
+                        payload = self._export_prefill_slot(
+                            slot, st, cmd.get("wire"))
+                    except Exception as e:  # noqa: BLE001 — skip slot, keep rest
+                        skipped.append({"slot": slot, "reason": str(e)})
+                        self._count_mig("export", "error")
+                        continue
+                    sessions.append(payload)
+                    self._count_mig("export", "ok_prefill")
+                    self._trace("export_prefill", slot)
+                    if self.tracing:
+                        req.mark("export", slot=slot, prefill=True,
+                                 done=st["done"])
+                    self._release_slot(slot)
+                    self._active = self._active.at[slot].set(False)
+                    self._remaining = self._remaining.at[slot].set(0)
+                    from datatunerx_tpu.serving.migration import (
+                        MIGRATED_SESSION,
+                    )
+
+                    self._complete(
+                        req,
+                        error=f"{MIGRATED_SESSION}: prefill slot exported")
+                    continue
                 skipped.append({"slot": slot,
                                 "reason": "prefill_in_progress"})
                 self._count_mig("export", "skipped_prefill")
@@ -1881,6 +2063,11 @@ class BatchedEngine:
             self._trace("export", slot)
             if self.tracing:
                 req.mark("export", slot=slot, cursor=payload["cursor"])
+            if self.prefix_keep_warm:
+                # keep the session's prompt rows warm across the drain so
+                # a later resume-on-peer (or a sibling tenant) gets a COW
+                # hit instead of a cold prefill
+                self._keep_warm(slot)
             self._release_slot(slot)
             # the slot is still ACTIVE on device — every other release
             # happens after the decode kernel deactivated it. Clear the
@@ -1903,7 +2090,15 @@ class BatchedEngine:
                 encode_payload,
             )
 
-            parked, self._preempted = self._preempted, []
+            # entries leased to the spill coordinator stay parked: the
+            # coordinator (or lease expiry) is their single owner — a
+            # drain exporting them too would fork the session onto two
+            # replicas at once
+            now = time.monotonic()
+            parked = [e for e in self._preempted
+                      if e.get("hold_until", 0.0) <= now]
+            self._preempted = [e for e in self._preempted
+                               if e.get("hold_until", 0.0) > now]
             for entry in parked:
                 req = entry["req"]
                 sessions.append(encode_payload(entry["payload"]))
@@ -1952,6 +2147,47 @@ class BatchedEngine:
             row=row, cursor=cursor, pos=pos, remaining=remaining,
             rng=rng, logits=logits, wire=wire, b64=b64)
 
+    def _export_prefill_slot(self, slot: int, st: dict,
+                             wire: Optional[str]) -> dict:
+        """Serialize a mid-chunked-prefill slot: the KV written so far
+        (``base + done`` lanes) plus a ``pending`` document carrying the
+        un-prefilled prompt tail. No decode state exists yet — rng/logits
+        are placeholders; the importer's ``_finish_prefill`` arms the slot
+        from ``req.seed`` exactly as an undisturbed in-place prefill
+        would, so the handoff is token-exact by construction."""
+        from datatunerx_tpu.serving import migration as mig
+
+        req = st["req"]
+        cursor = int(st.get("base", 0)) + int(st["done"])  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+        w = min(-(-max(1, cursor) // DECODE_BUCKET) * DECODE_BUCKET,
+                self.max_seq_len)
+        row = self._extract(self._cache, jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(cursor, jnp.int32), width=w)
+        payload = mig.build_payload(
+            self.cfg, self.kv_quant,
+            request={"trace_id": req.trace_id,
+                     "adapter": req.adapter_name,
+                     "prompt_ids": list(req.prompt_ids),
+                     "tokens": list(req.tokens),
+                     "max_new_tokens": req.max_new_tokens,
+                     "temperature": req.temperature, "top_p": req.top_p,
+                     "seed": req.seed, "stop_ids": list(req.stop_ids)},
+            row=row, cursor=cursor, pos=st["n_prompt"],
+            remaining=st["max_new"], rng=np.zeros(2, np.uint32),
+            logits=np.zeros((self.cfg.vocab_size,), np.float32),
+            wire=wire, b64=True)
+        done = int(st["done"])  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+        payload["pending"] = {
+            "ids": [int(t) for t in st["ids"][done:]],  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "mask": [int(m) for m in st["mask"][done:]],  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "positions": [int(p) for p in st["positions"][done:]],  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "n_prompt": int(st["n_prompt"]),  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "max_new": int(st["max_new"]),  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "base": int(st.get("base", 0)),  # dtxlint: disable=DTX001 — pending-doc fields are host ints
+            "done": done,
+        }
+        return payload
+
     def _do_import(self, cmd: dict) -> dict:
         from datatunerx_tpu.serving import migration as mig
 
@@ -1998,6 +2234,15 @@ class BatchedEngine:
                 idx = self._static_adapter_ids[name]
             else:
                 raise ValueError(f"unknown adapter {name!r} on this replica")
+        pending = payload.get("pending")
+        if pending is not None:
+            try:
+                return self._import_prefill_tail(payload, pending, slot,
+                                                 name, idx, pinned, cursor)
+            except Exception:
+                if pinned:
+                    self.adapter_registry.release(name)
+                raise
         blocks: Optional[List[int]] = None
         try:
             if self.paged:
@@ -2086,11 +2331,296 @@ class BatchedEngine:
                 "remaining": remaining, "adapter": name,
                 "text_so_far": text, "_request": req}
 
+    def _import_prefill_tail(self, payload: dict, pending: dict, slot: int,
+                             name: str, idx: int, pinned: bool,
+                             cursor: int) -> dict:
+        """Admit a mid-chunked-prefill export: scatter the KV written so
+        far into fresh blocks, then register the remaining prompt tail as
+        a normal ``_pending`` chunked prefill (``key`` None — an imported
+        tail is not a cold prefill and never publishes a prefix entry).
+        ``_finish_prefill`` then arms decode from ``req.seed`` exactly as
+        the source replica would have, so the handoff is token-exact."""
+        from datatunerx_tpu.ops.paged_attention import paged_insert_row
+        from datatunerx_tpu.serving import migration as mig
+
+        if not self.paged:
+            raise ValueError("mid-prefill import requires a paged engine")
+        ids = [int(t) for t in pending["ids"]]  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        mask = [int(m) for m in pending["mask"]]  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        positions = [int(p) for p in pending["positions"]]  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        final = cursor + len(ids)
+        W = self.max_seq_len
+        if final >= W:
+            raise ValueError(
+                f"prefill depth {final} exceeds this replica's context {W}")
+        max_new = max(1, int(pending["max_new"]))  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        blocks = self._alloc_blocks(self._reserve_depth(final, max_new))
+        if blocks is None:
+            raise _RetryLater(
+                "kv blocks exhausted for mid-prefill import "
+                f"(free {self._allocator.free_count})")
+        try:
+            row = mig.unpack_kv_row(payload["kv"], full_width=W,
+                                    quantize=self.kv_quant)
+            req = Request(
+                payload["prompt_ids"], payload["max_new_tokens"],
+                payload["temperature"], payload["top_p"],
+                payload["seed"], payload["stop_ids"],
+                idx, adapter_name=name,
+                trace_id=(payload["trace_id"]
+                          or f"dtx-{uuid.uuid4().hex[:16]}"))
+            req.tokens = payload["tokens"]
+            req.resume_base = len(req.tokens)
+            if self.spec is not None:
+                from datatunerx_tpu.utils.decoding import prepare_prompt
+
+                p_ids, _, _, p_plen, p_n, _, _ = prepare_prompt(
+                    payload["prompt_ids"], self.tokenizer.eos_token_id,
+                    self.max_seq_len, payload["max_new_tokens"])
+                req.spec_prime_ids = p_ids[p_plen - p_n:]
+            # the row's unwritten tail is POS_SENTINEL-padded to full
+            # width, so the scatter doubles as the recycled-block scrub
+            self._cache = paged_insert_row(
+                self._cache, slot, self._table_row(blocks), row)
+            self._cache["len"] = self._cache["len"].at[slot].set(cursor)
+        except Exception:
+            self._allocator.free(blocks)
+            raise
+        if pinned:
+            self._slot_adapter[slot] = name
+        self._slot_blocks[slot] = blocks
+        self._slot_req[slot] = req
+        self._decode_ready[slot] = False
+        self._slot_demand[slot] = self._eager_demand(final, max_new)
+        self._pending[slot] = {
+            "req": req, "ids": ids, "mask": mask, "positions": positions,
+            "plen": len(ids), "n_prompt": int(pending["n_prompt"]),  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+            "max_new": max_new, "adapter": req.adapter, "done": 0,
+            "base": cursor, "key": None,
+        }
+        self._note_admitted(slot)
+        self._count_mig("import", "ok_prefill")
+        self._trace("import_prefill", slot, cursor)
+        if self.tracing:
+            req.mark("import", slot=slot, cursor=cursor, adapter=name,
+                     prefill=True, tail=len(ids))
+        text = (self.tokenizer.decode(req.tokens, skip_special_tokens=True)
+                if req.tokens else "")
+        return {"session": req.trace_id, "slot": slot,
+                "tokens": req.resume_base, "cursor": cursor,
+                "remaining": max_new, "adapter": name,
+                "text_so_far": text, "_request": req, "prefill": True}
+
+    # ------------------------------------------------- fleet spill (parked)
+    def _do_hold_parked(self, cmd: dict) -> dict:
+        from datatunerx_tpu.serving.migration import encode_payload
+
+        now = time.monotonic()
+        hold_until = now + float(cmd.get("hold_s", 10.0))  # dtxlint: disable=DTX001 — mig-command args are host scalars
+        limit = int(cmd.get("max_sessions", 4))  # dtxlint: disable=DTX001 — mig-command args are host scalars
+        out = []
+        for entry in self._preempted:
+            if len(out) >= limit:
+                break
+            if entry.get("hold_until", 0.0) > now:
+                continue  # already leased
+            entry["hold_until"] = hold_until
+            payload = entry["payload"]
+            out.append({"trace_id": entry["req"].trace_id,
+                        "seq": entry["req"].seq,
+                        "cursor": int(payload["cursor"]),  # dtxlint: disable=DTX001 — parked payloads carry host scalars
+                        "remaining": int(payload["remaining"]),  # dtxlint: disable=DTX001 — parked payloads carry host scalars
+                        "payload": encode_payload(payload)})
+        return {"sessions": out, "parked": len(self._preempted)}
+
+    def _do_drop_parked(self, cmd: dict) -> dict:
+        from datatunerx_tpu.serving.migration import MIGRATED_SESSION
+
+        want = set(cmd.get("trace_ids") or [])
+        keep, dropped = [], 0
+        for entry in self._preempted:
+            req = entry["req"]
+            if req.trace_id in want:
+                dropped += 1
+                self._count_preempt("spilled")
+                self._trace("spill", req.seq)
+                if self.tracing:
+                    req.mark("spill")
+                self._complete(
+                    req, error=f"{MIGRATED_SESSION}: parked session spilled")
+            else:
+                keep.append(entry)
+        self._preempted = keep
+        return {"dropped": dropped}
+
+    def _do_release_parked(self, cmd: dict) -> dict:
+        want = set(cmd.get("trace_ids") or [])
+        released = 0
+        for entry in self._preempted:
+            if entry["req"].trace_id in want and entry.pop(
+                    "hold_until", None) is not None:
+                released += 1
+        return {"released": released}
+
+    # ------------------------------------------------- fleet prefix tier
+    def _adapter_akey_name(self, akey) -> Optional[str]:
+        """Cache-key adapter identity → fleet-wide NAME (dynamic pools key
+        by name already; static stacks key by index). None = unmappable."""
+        if isinstance(akey, str):
+            return akey
+        if akey == 0:
+            return ""
+        for n, idx in self._static_adapter_ids.items():
+            if idx == akey:
+                return n
+        return None
+
+    def _mount_entry_row(self, ent: dict, cursor: int):
+        """Gather a COW block entry into a dense row by temporarily
+        installing its blocks on a FREE slot's table (nothing reads an
+        unoccupied slot's table, and it is restored before returning)."""
+        slot = next((i for i in range(self.slots)
+                     if self._slot_req[i] is None), None)
+        if slot is None:
+            raise _RetryLater("no free slot to stage a prefix export")
+        w = min(-(-max(1, cursor) // DECODE_BUCKET) * DECODE_BUCKET,
+                self.max_seq_len)
+        saved = self._cache["block_tables"][slot]
+        try:
+            self._cache["block_tables"] = self._cache["block_tables"].at[
+                slot].set(self._table_row(ent["blocks"]))
+            return self._extract(self._cache, jnp.asarray(slot, jnp.int32),
+                                 jnp.asarray(cursor, jnp.int32), width=w)
+        finally:
+            self._cache["block_tables"] = \
+                self._cache["block_tables"].at[slot].set(saved)
+
+    def _do_export_prefix(self, cmd: dict) -> dict:
+        from datatunerx_tpu.serving import migration as mig
+
+        if self._prefix is None:
+            return {"entries": []}
+        exclude = cmd.get("exclude") or set()
+        limit = int(cmd.get("max_entries", 4))  # dtxlint: disable=DTX001 — mig-command args are host scalars
+        wire = cmd.get("wire")
+        entries: List[dict] = []
+        for key, ent in self._prefix.snapshot_entries():
+            if len(entries) >= limit:
+                break
+            ptoks, akey = key
+            name = self._adapter_akey_name(akey)
+            if name is None:
+                continue
+            fp = mig.prefix_fingerprint(name, ptoks)
+            if fp in exclude:
+                continue
+            cursor = int(ent["cursor"])  # dtxlint: disable=DTX001 — prefix entries store host cursors
+            try:
+                if ent.get("blocks") is not None:
+                    row = self._mount_entry_row(ent, cursor)
+                else:
+                    row = ent["cache"]
+                entries.append({
+                    "kind": mig.PREFIX_KIND,
+                    "version": mig.PAYLOAD_VERSION,
+                    "fingerprint": fp,
+                    "adapter": name,
+                    "prompt_ids": [int(t) for t in ptoks],  # dtxlint: disable=DTX001 — prefix entries store host cursors
+                    "cursor": cursor,
+                    "no_reuse": bool(ent.get("no_reuse", False)),
+                    "logits": (None if ent.get("logits") is None
+                               else mig.pack_logits(ent["logits"])),
+                    "kv": mig.pack_kv_row(row, cursor, wire),
+                    "model_sig": mig.model_signature(self.cfg,
+                                                     self.kv_quant),
+                })
+                self._count_mig("export_prefix", "ok")
+            except Exception:  # noqa: BLE001 — publish is best-effort
+                self._count_mig("export_prefix", "error")
+                continue
+        return {"entries": entries}
+
+    def _do_import_prefix(self, cmd: dict) -> dict:
+        from datatunerx_tpu.ops.paged_attention import (
+            paged_insert_row,
+            row_trim,
+        )
+        from datatunerx_tpu.serving import migration as mig
+
+        if self._prefix is None:
+            raise ValueError("prefix cache disabled on this replica")
+        payload = cmd["payload"]
+        mig.check_prefix_signature(payload, self.cfg)
+        name = payload.get("adapter") or ""
+        if not name:
+            akey = "" if self.adapter_registry is not None else 0
+        elif self.adapter_registry is not None:
+            if name not in self.adapter_registry.names():
+                raise ValueError(f"unknown adapter {name!r} on this replica")
+            akey = name
+        elif name in self._static_adapter_ids:
+            akey = self._static_adapter_ids[name]
+        else:
+            raise ValueError(f"unknown adapter {name!r} on this replica")
+        ptoks = tuple(int(t) for t in payload["prompt_ids"])  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        key = (ptoks, akey)
+        if self._prefix.get(key) is not None:
+            return {"imported": False, "reason": "present"}
+        cursor = int(payload["cursor"])  # dtxlint: disable=DTX001 — wire payloads carry host scalars
+        if not 0 < cursor < self.max_seq_len:
+            raise ValueError(
+                f"prefix depth {cursor} unusable in context "
+                f"{self.max_seq_len}")
+        row = mig.unpack_kv_row(payload["kv"], full_width=self.max_seq_len,
+                                quantize=self.kv_quant)
+        logits = (None if payload.get("logits") is None
+                  else mig.unpack_logits(payload, self.cfg.vocab_size))
+        no_reuse = bool(payload.get("no_reuse")) or logits is None
+        if self.cow:
+            full, rem = divmod(cursor, self.block_size)
+            n_blocks = full + (1 if rem else 0)
+            blocks = self._allocator.alloc(n_blocks)
+            if blocks is None:
+                raise _RetryLater(
+                    f"kv blocks exhausted for prefix import "
+                    f"(need {n_blocks}, free {self._allocator.free_count})")
+            slot = next((i for i in range(self.slots)
+                         if self._slot_req[i] is None), None)
+            if slot is None:
+                self._allocator.free(blocks)
+                raise _RetryLater("no free slot to stage a prefix import")
+            try:
+                # the scatter installs the table on the free slot; restore
+                # it right after — the ENTRY owns these blocks, not a slot
+                saved = self._cache["block_tables"][slot]
+                self._cache = paged_insert_row(
+                    self._cache, slot, self._table_row(blocks), row)
+                self._cache["block_tables"] = \
+                    self._cache["block_tables"].at[slot].set(saved)
+            except Exception:
+                self._allocator.free(blocks)
+                raise
+            ent = {"blocks": blocks, "full": full, "rem": rem,
+                   "cursor": cursor, "logits": logits}
+        else:
+            w = min(-(-max(1, cursor) // DECODE_BUCKET) * DECODE_BUCKET,
+                    self.max_seq_len)
+            ent = {"cache": row_trim(row, w), "logits": logits,
+                   "cursor": cursor}
+        if no_reuse:
+            ent["no_reuse"] = True
+        self._prefix.put(key, ent)
+        self._count_mig("import_prefix", "ok")
+        self._trace("import_prefix", cursor)
+        return {"imported": True, "cursor": cursor,
+                "fingerprint": payload.get("fingerprint")}
+
     def _release_slot(self, slot: int, note_session: bool = True):
         self._slot_req[slot] = None
         self._pending.pop(slot, None)
         self._decode_ready[slot] = False
         self._slot_demand[slot] = 0
+        self._slot_key[slot] = None
         if self.spec is not None:
             self._spec_form[slot] = False
             self._spec_primed[slot] = False
@@ -2207,6 +2737,11 @@ class BatchedEngine:
         if self.spec is not None and self._spec_form[slot]:
             self._spec_settle_slot(slot)
         payload = self._export_slot(slot, req, None, b64=False)
+        if self.prefix_keep_warm:
+            # publish the session's prompt rows before freeing them: a
+            # resume (here or on a peer) admits via a COW hit instead of
+            # re-paying the prefix prefill
+            self._keep_warm(slot)
         self._release_slot(slot, note_session=False)
         # the slot is still ACTIVE on device (only the decode kernel
         # deactivates slots itself) — clear the mask and budget NOW, or an
@@ -2245,6 +2780,11 @@ class BatchedEngine:
         everything behind it until the next tick."""
         while self._preempted:
             entry = self._preempted[0]
+            if entry.get("hold_until", 0.0) > time.monotonic():
+                # leased to the fleet spill coordinator: hold local
+                # resumption (and, via the admission gate, younger cold
+                # admissions) until the spill lands or the lease expires
+                return
             try:
                 ok = self._resume_one(entry)
             except Exception as e:  # noqa: BLE001 — fail the session, not the loop

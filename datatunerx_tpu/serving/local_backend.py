@@ -60,6 +60,16 @@ class LocalServingBackend:
                     "--policy", spec.get("policy") or "least_busy",
                     "--workdir", appdir,
                 ]
+                # disaggregation knobs are gateway-only: role here is a
+                # comma cycle assigned across spawned replicas, and the
+                # prefill threshold / fleet plane live in the router
+                for key in ("role", "prefill_threshold", "fleet_prefix_mb",
+                            "fleet_handoff", "fleet_spill"):
+                    val = spec.get(key)
+                    if val:
+                        if isinstance(val, bool):
+                            val = int(val)  # the gateway flags are ints
+                        argv += [f"--{key}", str(val)]
             else:
                 argv = [
                     sys.executable, "-m", "datatunerx_tpu.serving.server",
@@ -69,6 +79,10 @@ class LocalServingBackend:
                     "--port", str(port),
                     "--quantization", spec.get("quantization") or "",
                 ]
+                if spec.get("role"):
+                    # single server: one role (the webhook rejects cycles
+                    # when there is no gateway to distribute them)
+                    argv += ["--role", str(spec["role"])]
             if spec.get("slots"):
                 argv += ["--slots", str(spec["slots"])]
             # paged-cache + adapter-pool tuning flows through the

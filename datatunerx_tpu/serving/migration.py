@@ -32,7 +32,8 @@ HTTP surface (``POST /admin/sessions/export`` / ``/import``).
 from __future__ import annotations
 
 import base64
-from typing import Dict, Optional
+import hashlib
+from typing import Dict, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -41,6 +42,11 @@ from datatunerx_tpu.ops.attention import kv_quantize
 from datatunerx_tpu.ops.paged_attention import POS_SENTINEL, row_trim
 
 PAYLOAD_KIND = "dtx-kv-session"
+# fleet prefix tier (datatunerx_tpu/fleet/prefix_tier.py): a prefilled
+# prefix-cache entry serialized for cross-replica publish/import. Same KV
+# row encoding as a session payload, but no decode state — the importer
+# builds a local _PrefixCache entry, not a live slot.
+PREFIX_KIND = "dtx-kv-prefix"
 PAYLOAD_VERSION = 1
 
 # The error string a migrated-away request dies with. The gateway matches
@@ -101,7 +107,7 @@ def model_signature(cfg, kv_quant: Optional[str]) -> dict:
             "kv_quant": kv_quant or ""}
 
 
-def check_signature(payload: dict, cfg) -> None:
+def _check_model_sig(payload: dict, cfg) -> None:
     sig = payload.get("model_sig") or {}
     for key, want in (("layers", cfg.num_layers),
                       ("kv_heads", cfg.num_kv_heads),
@@ -111,12 +117,36 @@ def check_signature(payload: dict, cfg) -> None:
             raise ValueError(
                 f"session payload is from an incompatible model: "
                 f"{key}={sig.get(key)} here {want}")
+
+
+def check_signature(payload: dict, cfg) -> None:
+    _check_model_sig(payload, cfg)
     if payload.get("kind") != PAYLOAD_KIND:
         raise ValueError(
             f"not a {PAYLOAD_KIND} payload (kind={payload.get('kind')!r})")
     if payload.get("version") != PAYLOAD_VERSION:
         raise ValueError(
             f"unsupported session payload version {payload.get('version')!r}")
+
+
+def check_prefix_signature(payload: dict, cfg) -> None:
+    _check_model_sig(payload, cfg)
+    if payload.get("kind") != PREFIX_KIND:
+        raise ValueError(
+            f"not a {PREFIX_KIND} payload (kind={payload.get('kind')!r})")
+    if payload.get("version") != PAYLOAD_VERSION:
+        raise ValueError(
+            f"unsupported prefix payload version {payload.get('version')!r}")
+
+
+def prefix_fingerprint(adapter: str, prompt_ids: Sequence[int]) -> str:
+    """Stable fleet-wide identity of a prefix entry: (adapter NAME, prompt
+    token ids). Names, not pool indices — indices are replica-local."""
+    h = hashlib.sha1()
+    h.update(str(adapter or "").encode("utf-8", "replace"))
+    h.update(b"\x00")
+    h.update(np.asarray(list(prompt_ids), np.int64).tobytes())
+    return h.hexdigest()
 
 
 def pack_kv_row(row: Dict, cursor: int, wire: str, b64: bool = True) -> dict:

@@ -37,6 +37,12 @@ class ServingState:
         self.engine = None
         self.error: Optional[str] = None
         self.model_path = ""
+        # disaggregation role this replica declares to the fleet:
+        # "prefill" (long-prompt specialist), "decode", or "mixed" (the
+        # default — role-less routing, byte-identical to older fleets).
+        # Surfaced as dtx_serving_role{role=...}; the gateway's
+        # HTTPReplica scrape keeps its routing view in sync.
+        self.role = "mixed"
         # the server's ONE registry: engine latency histograms record into
         # it (load_engine_async passes it down) and every scrape-time gauge
         # is re-stated into it, so /metrics is a single exposition
@@ -180,6 +186,18 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
     if isinstance(pstats, dict):
         for outcome, np_ in sorted(pstats.items()):
             preempt.set(np_, {"outcome": outcome})
+    # disaggregated fleet plane: the role this replica declares (one-hot
+    # label the gateway's role-aware routing scrapes) and the parked-
+    # session backlog the fleet spill coordinator treats as work
+    role_g = reg.gauge("dtx_serving_role",
+                       "Replica disaggregation role, one-hot by label "
+                       "(prefill / decode / mixed).")
+    parked_g = reg.gauge("dtx_serving_sessions_parked",
+                         "Preemption-parked sessions awaiting resume — "
+                         "the fleet spill coordinator's work signal.")
+    role_g.clear()
+    role_g.set(1, {"role": STATE.role})
+    parked_g.set(int(getattr(eng, "parked_sessions", 0) or 0))
     # dynamic adapter pool (datatunerx_tpu/adapters/): occupancy, the
     # residency set the gateway's cache-locality routing scrapes, and
     # per-adapter traffic. Declared/cleared on every scrape so a swapped
@@ -510,8 +528,10 @@ class Handler(BaseHTTPRequestHandler):
     # --------------------------------------------------- KV migration fabric
     def _sessions_export(self, req: dict):
         """POST /admin/sessions/export {"slots": [..]?, "wire":
-        "bf16"|"int8"?} — serialize (and terminate) in-flight decode
-        sessions for replica-to-replica handoff. 501 on engines without
+        "bf16"|"int8"?, "prefill": bool?} — serialize (and terminate)
+        in-flight decode sessions for replica-to-replica handoff;
+        ``prefill`` additionally ships MID-chunked-prefill slots (blocks
+        written so far + remaining prompt tail). 501 on engines without
         the migration surface."""
         eng = STATE.engine
         if eng is None:
@@ -521,13 +541,74 @@ class Handler(BaseHTTPRequestHandler):
         if not callable(fn):
             self._json(501, {"error": "engine has no session export"})
             return
+        kw = {"slots": req.get("slots"),
+              "wire_quant": req.get("wire") or None}
+        if req.get("prefill"):
+            # only when asked: older engines lack the kwarg entirely
+            kw["include_prefill"] = True
         try:
-            self._json(200, fn(slots=req.get("slots"),
-                               wire_quant=req.get("wire") or None))
+            self._json(200, fn(**kw))
         except TimeoutError as e:
             self._json(503, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — serving must answer
             self._json(500, {"error": str(e)})
+
+    def _fleet_admin(self, attr: str, kwargs: dict):
+        """Shared shell for the fleet-plane admin surfaces (spill leases
+        + prefix tier). Engine refusals (ValueError/KeyError) map to 409
+        — the coordinator's fall-back-or-retry signal — and a missing
+        engine method to 501, which HTTPReplica reads as 'replica kind
+        without the surface' (None, skipped quietly)."""
+        eng = STATE.engine
+        if eng is None:
+            self._json(503, {"error": "model not loaded"})
+            return
+        fn = getattr(eng, attr, None)
+        if not callable(fn):
+            self._json(501, {"error": f"engine has no {attr}"})
+            return
+        try:
+            self._json(200, fn(**kwargs))
+        except (ValueError, KeyError) as e:
+            self._json(409, {"error": str(e)})
+        except TimeoutError as e:
+            self._json(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — serving must answer
+            self._json(500, {"error": str(e)})
+
+    def _sessions_hold(self, req: dict):
+        """POST /admin/sessions/hold {"max_sessions": n, "hold_s": s} —
+        lease preemption-parked sessions for a peer spill (phase 1)."""
+        self._fleet_admin("hold_parked", {
+            "max_sessions": int(req.get("max_sessions", 4)),
+            "hold_s": float(req.get("hold_s", 10.0))})
+
+    def _sessions_drop(self, req: dict):
+        """POST /admin/sessions/drop {"trace_ids": [...]} — finish a
+        spill: drop the re-homed sessions, terminating their source
+        requests with the migrated marker."""
+        self._fleet_admin("drop_parked", {
+            "trace_ids": list(req.get("trace_ids") or [])})
+
+    def _sessions_release(self, req: dict):
+        """POST /admin/sessions/release {"trace_ids": [...]} — abort a
+        spill: clear the leases so the sessions resume locally."""
+        self._fleet_admin("release_parked", {
+            "trace_ids": list(req.get("trace_ids") or [])})
+
+    def _prefix_export(self, req: dict):
+        """POST /admin/prefix/export {"max_entries": n, "exclude":
+        [fp...], "wire": "bf16"|"int8"?} — publishable local prefix-cache
+        entries for the fleet prefix tier."""
+        self._fleet_admin("export_prefix_entries", {
+            "exclude": req.get("exclude") or None,
+            "max_entries": int(req.get("max_entries", 4)),
+            "wire_quant": req.get("wire") or None})
+
+    def _prefix_import(self, req: dict):
+        """POST /admin/prefix/import <dtx-kv-prefix payload> — install a
+        fleet-published prefix entry into the local prefix cache."""
+        self._fleet_admin("import_prefix_entry", {"payload": dict(req)})
 
     def _sessions_import(self, req: dict):
         """POST /admin/sessions/import <payload> — admit an exported
@@ -598,17 +679,23 @@ class Handler(BaseHTTPRequestHandler):
         if self.path == "/perplexity":
             self._perplexity()
             return
-        if self.path in ("/admin/sessions/export", "/admin/sessions/import"):
+        fleet_routes = {
+            "/admin/sessions/export": self._sessions_export,
+            "/admin/sessions/import": self._sessions_import,
+            "/admin/sessions/hold": self._sessions_hold,
+            "/admin/sessions/drop": self._sessions_drop,
+            "/admin/sessions/release": self._sessions_release,
+            "/admin/prefix/export": self._prefix_export,
+            "/admin/prefix/import": self._prefix_import,
+        }
+        if self.path in fleet_routes:
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": f"invalid JSON body: {e}"})
                 return
-            if self.path.endswith("/export"):
-                self._sessions_export(req)
-            else:
-                self._sessions_import(req)
+            fleet_routes[self.path](req)
             return
         if self.path == "/admin/adapters":
             try:
@@ -980,6 +1067,15 @@ def main(argv=None):
                         "decode chunks (0 = unbounded); bounds the TPOT "
                         "hit a long admission can inflict on in-flight "
                         "requests")
+    p.add_argument("--role", default="mixed",
+                   choices=["prefill", "decode", "mixed"],
+                   help="disaggregation role declared to the fleet: "
+                        "prefill = long-prompt specialist (the gateway "
+                        "steers prompts over its threshold here and the "
+                        "handoff coordinator re-homes finished prefills "
+                        "for decode), decode = token production, mixed "
+                        "(default) = role-less, routing byte-identical "
+                        "to older fleets")
     p.add_argument("--trace_ring", type=int, default=256,
                    help="completed request traces kept for "
                         "GET /debug/trace/<id>")
@@ -995,6 +1091,7 @@ def main(argv=None):
                         "on /debug/slo)")
     args = p.parse_args(argv)
 
+    STATE.role = args.role
     if args.slo_config:
         from datatunerx_tpu.obs.slo import load_slos
 

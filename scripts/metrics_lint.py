@@ -36,8 +36,9 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 SHARED_NAMES = {"dtx_build_info"}
 # shared FAMILIES: the SLO verdict gauges (obs/slo.py) are restated into
 # every plane's registry under one name so dashboards join them across
-# planes on the {slo} label
-SHARED_PREFIXES = ("dtx_slo_",)
+# planes on the {slo} label; the fleet-plane series (fleet/) describe
+# cross-replica state and keep one name wherever they surface
+SHARED_PREFIXES = ("dtx_slo_", "dtx_fleet_")
 # words that mean "this samples a duration" and demand a unit suffix
 TIME_WORDS = ("latency", "wait", "duration", "uptime", "elapsed", "ttft",
               "tpot")
@@ -98,6 +99,9 @@ class _StatsEngine:
     block_size = 16
     kv_overcommit_ratio = 1.5
     preempt_stats = {"exported": 3, "resumed": 2, "requeued_prefill": 1}
+    # disaggregation plane: parked-session depth behind the spill
+    # coordinator's eligibility scan (dtx_serving_sessions_parked)
+    parked_sessions = 1
     # KV migration fabric outcome counters (dtx_serving_session_* series)
     session_stats = {"export": {"ok": 2, "skipped_prefill": 1},
                      "import": {"ok": 2, "refused": 1}}
@@ -138,7 +142,12 @@ def gateway_exposition() -> str:
     from datatunerx_tpu.gateway.server import Gateway
 
     pool = ReplicaPool([InProcessReplica("r0", _StatsEngine())])
-    gw = Gateway(pool, model_name="preset:lint")
+    # fleet plane ON so the dtx_fleet_* series (prefix tier, handoff and
+    # spill outcome counters) and the role-routing series are built and
+    # linted — at defaults they are absent by design
+    gw = Gateway(pool, model_name="preset:lint", prefill_threshold=8,
+                 fleet_prefix_bytes=1 << 20, fleet_handoff=True,
+                 fleet_spill=True)
     try:
         # drive one request so the labeled counters and the queue-wait
         # histogram expose real series, not just TYPE lines — and one

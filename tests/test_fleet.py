@@ -1,0 +1,766 @@
+"""Disaggregated fleet plane (datatunerx_tpu/fleet/): replica roles,
+fleet-shared prefix tier, peer-replica KV spill.
+
+The correctness bars are the ISSUE's oracles:
+
+- a session exported MID-chunked-prefill (role handoff / drain) resumes
+  on the peer with the prompt work done so far KEPT — no re-prefill of
+  completed chunks — and finishes TOKEN-EXACTLY vs an undisturbed run
+  (greedy, fixed-seed sampled, int8 kv_quant, pooled adapters);
+- a prefix published through the fleet tier activates on a second
+  replica with ZERO prefill chunks (asserted on sched_trace) and
+  token-exact output;
+- a preemption-parked session spilled to a peer resumes token-exactly,
+  with the source/coordinator counters reconciling
+  (preempt_stats["spilled"] == dtx_fleet_spill_total{outcome="ok"}).
+
+Coordinator policy (two-phase ordering, tombstones, lease release,
+role-deficit spawning, role-preference routing) is pinned on fakes;
+the operator plumbing (CRD schema, webhook validation, serving-spec
+pass-through) rides along as satellites.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from datatunerx_tpu.fleet import (
+    FleetPlane,
+    HandoffCoordinator,
+    PrefixTier,
+    SpillCoordinator,
+)
+from datatunerx_tpu.fleet.prefix_tier import payload_bytes
+from datatunerx_tpu.gateway.replica_pool import (
+    InProcessReplica,
+    ReplicaError,
+    ReplicaPool,
+)
+from datatunerx_tpu.serving.batched_engine import BatchedEngine
+from tests.test_session_handoff import _import_and_wait, _throttled
+
+MODEL = "preset:debug"
+
+
+def _throttled_prefill(eng, delay=0.05):
+    """Slow each prefill CHUNK so a test can deterministically catch a
+    request mid-chunked-prefill. Returns the original to restore."""
+    orig = eng._prefill_chunk_fn
+
+    def slow(*a, **k):
+        time.sleep(delay)
+        return orig(*a, **k)
+
+    eng._prefill_chunk_fn = slow
+    return orig
+
+
+def _export_mid_prefill(src, prompt, **kw):
+    """Submit a chunk-prefilling prompt on ``src``, catch it with the
+    prompt PARTIALLY done, and export with include_prefill=True."""
+    orig = _throttled_prefill(src)
+    try:
+        req = src.submit(prompt, **kw)
+        deadline = time.monotonic() + 30
+        caught = False
+        while time.monotonic() < deadline:
+            if any(0 < st["done"] < st["plen"]
+                   for st in src._pending.values()):
+                caught = True
+                break
+            time.sleep(0.002)
+        assert caught, "request never caught mid-chunked-prefill"
+        doc = src.export_sessions(include_prefill=True)
+    finally:
+        src._prefill_chunk_fn = orig
+    assert len(doc["sessions"]) == 1, doc
+    assert req.done.wait(10) and "session migrated" in (req.error or "")
+    return doc["sessions"][0]
+
+
+def _prefill_tokens(eng, mark=0):
+    """Prompt tokens chunk-prefilled since trace index ``mark``."""
+    return sum(ev[2] for ev in list(eng.sched_trace)[mark:]
+               if ev[0] == "prefill")
+
+
+@pytest.fixture(scope="module")
+def chunked_pair():
+    """Twin paged engines whose prefill is CHUNKED (budget 64/tick) —
+    the shape mid-prefill handoff exists for."""
+    mk = lambda: BatchedEngine(  # noqa: E731 — twin ctor, used twice
+        MODEL, template="vanilla", max_seq_len=256, slots=2,
+        decode_chunk=4, kv_block_size=16, prefill_chunk=64,
+        prefill_token_budget=64)
+    src, dst = mk(), mk()
+    yield src, dst
+    src.close()
+    dst.close()
+
+
+# ------------------------------------------- mid-prefill export / import
+
+def test_mid_prefill_export_import_parity(chunked_pair):
+    """A session exported mid-chunked-prefill resumes on the peer where
+    the source stopped: the importer chunk-prefills ONLY the remaining
+    prompt tail, and the continuation is token-exact vs an undisturbed
+    run — greedy and fixed-seed sampled."""
+    src, dst = chunked_pair
+    prompt = src.tokenizer.encode("chunked prefill handoff target " * 30)
+    for kw in ({}, {"temperature": 0.8, "top_p": 0.9, "seed": 11}):
+        want = src.generate(prompt, max_new_tokens=12, **kw)
+        payload = _export_mid_prefill(src, prompt, max_new_tokens=12, **kw)
+        pending = payload.get("pending")
+        assert pending, "payload lost the prompt tail"
+        tail, done_src = len(pending["ids"]), int(pending["done"])
+        assert tail > 0 and done_src > 0, pending
+        mark = len(dst.sched_trace)
+        handle, _ = _import_and_wait(dst, payload)
+        assert handle.tokens == want, (kw, handle.tokens, want)
+        # prompt work KEPT: the target chunk-prefills only the tail the
+        # source had not reached, strictly less than the full prompt
+        done = _prefill_tokens(dst, mark)
+        assert 0 < done <= tail < tail + done_src, (done, tail, done_src)
+    assert src.session_stats["export"].get("ok_prefill", 0) >= 2
+    # elastic accounting both sides
+    assert src.free_kv_blocks == src.total_kv_blocks
+    assert dst.free_kv_blocks == dst.total_kv_blocks
+
+
+def test_mid_prefill_export_skipped_without_flag(chunked_pair):
+    """Steady-state exports (no include_prefill) SKIP mid-prefill slots
+    — the session finishes its prompt in place, undisturbed."""
+    src, _ = chunked_pair
+    prompt = src.tokenizer.encode("skip me while prefill runs " * 30)
+    want = src.generate(prompt, max_new_tokens=8)
+    orig = _throttled_prefill(src)
+    try:
+        req = src.submit(prompt, max_new_tokens=8)
+        deadline = time.monotonic() + 30
+        while not any(0 < st["done"] < st["plen"]
+                      for st in src._pending.values()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+        doc = src.export_sessions()  # include_prefill defaults False
+    finally:
+        src._prefill_chunk_fn = orig
+    assert doc["sessions"] == []
+    assert any(s["reason"] == "prefill_in_progress"
+               for s in doc["skipped"]), doc
+    assert src.session_stats["export"].get("skipped_prefill", 0) >= 1
+    assert req.done.wait(120) and req.error is None
+    assert req.tokens == want
+
+
+def test_mid_prefill_int8_and_pooled_adapter_parity(tmp_path):
+    """The mid-prefill wire is exact for int8 kv_quant caches (native
+    encoding) and for pooled-adapter sessions (adapter resolved by NAME
+    on the importer, load-on-miss included)."""
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+
+    ck = {"t-a": make_adapter_checkpoint(str(tmp_path / "a"), MODEL,
+                                         seed=3, rank=2)}
+    mk = lambda: BatchedEngine(  # noqa: E731
+        MODEL, adapters=ck, adapter_pool=1, adapter_rank_max=4,
+        template="vanilla", max_seq_len=256, slots=2, decode_chunk=4,
+        kv_block_size=16, prefill_chunk=64, prefill_token_budget=64,
+        kv_quant="int8")
+    src, dst = mk(), mk()
+    try:
+        prompt = src.tokenizer.encode("tenant prefill on the move " * 30)
+        want = src.generate(prompt, max_new_tokens=10, adapter="t-a")
+        payload = _export_mid_prefill(src, prompt, max_new_tokens=10,
+                                      adapter="t-a")
+        assert payload["adapter"] == "t-a"
+        assert payload["kv"]["wire"] == "int8"
+        handle, meta = _import_and_wait(dst, payload)
+        assert handle.tokens == want, (handle.tokens, want)
+        assert meta["adapter"] == "t-a"
+        # adapter parity is vacuous if base produces the same tokens
+        assert want != src.generate(prompt, max_new_tokens=10)
+    finally:
+        src.close()
+        dst.close()
+
+
+# --------------------------------------------------- prefix tier (unit)
+
+def _prefix_payload(fp, nbytes=100, adapter="", cursor=64):
+    return {"fingerprint": fp, "adapter": adapter, "cursor": cursor,
+            "kv": {"k": "x" * nbytes}}
+
+
+def test_prefix_tier_directory_lru_budget():
+    tier = PrefixTier(byte_budget=250)
+    assert tier.publish(_prefix_payload("f1"), source="r0")
+    assert not tier.publish(_prefix_payload("f1"), source="r1")  # re-offer
+    assert tier.holders("f1") == {"r0", "r1"}
+    assert tier.publish(_prefix_payload("f2"), source="r0")
+    # third entry blows the budget: the LRU entry (f1) is evicted; the
+    # directory forgets it but holders keep serving their local copies
+    assert tier.publish(_prefix_payload("f3"), source="r0")
+    assert tier.entries == 2 and tier.counters["evicted"] == 1
+    assert tier.holders("f1") == set()
+    assert tier.bytes_used <= 250
+    # unkeyed payloads are refused, not stored
+    assert not tier.publish({"kv": {"k": "x"}})
+    assert payload_bytes(_prefix_payload("f", nbytes=10)) >= 10
+    st = tier.stats()
+    assert st["entries"] == 2 and st["publishes"] == 3
+
+
+class _FakePrefixReplica:
+    """Replica fake for tier sync: exports canned entries, records
+    import offers, and can refuse (409) or fault (transport)."""
+
+    def __init__(self, name, entries=(), mode="ok"):
+        self.name = name
+        self.role = "mixed"
+        self._entries = list(entries)
+        self.mode = mode
+        self.offered = []
+
+    def export_prefix_entries(self, exclude=None, max_entries=4):
+        ex = set(exclude or ())
+        return {"entries": [e for e in self._entries
+                            if e["fingerprint"] not in ex][:max_entries]}
+
+    def import_prefix_entry(self, payload):
+        self.offered.append(payload["fingerprint"])
+        if self.mode == "refuse":
+            raise ReplicaError(f"{self.name}: no blocks", status=409)
+        if self.mode == "fault":
+            raise ReplicaError(f"{self.name}: connection reset")
+        return {"imported": True, "fingerprint": payload["fingerprint"]}
+
+
+def test_prefix_tier_sync_pull_push_and_refusals():
+    tier = PrefixTier(1 << 20)
+    src = _FakePrefixReplica("r0", entries=[_prefix_payload("f1")])
+    ok = _FakePrefixReplica("r1")
+    out = tier.sync(src)
+    assert out["pulled"] == 1 and tier.entries == 1
+    out = tier.sync(ok)
+    assert out["pushed"] == 1 and tier.counters["hits"] == 1
+    # idempotent: r1 is a known holder now, nothing re-offered
+    assert tier.sync(ok) == {"pulled": 0, "pushed": 0, "refused": 0}
+    assert ok.offered == ["f1"]
+
+    # a 409 refusal counts a miss but stays RETRYABLE
+    busy = _FakePrefixReplica("r2", mode="refuse")
+    tier.sync(busy)
+    tier.sync(busy)
+    assert busy.offered == ["f1", "f1"]
+    assert tier.counters["misses"] == 2
+
+    # a transport fault marks the replica failed for the entry — it is
+    # not re-offered forever
+    broken = _FakePrefixReplica("r3", mode="fault")
+    tier.sync(broken)
+    tier.sync(broken)
+    assert broken.offered == ["f1"]
+
+
+def test_prefix_import_refusal_paths(chunked_pair):
+    """Engine-level refusals: no prefix cache / wrong model signature —
+    and the replica shim maps refusals to 409 ReplicaErrors so the tier
+    treats them as retryable misses, not replica faults."""
+    src, _ = chunked_pair  # chunked_pair engines have NO prefix cache
+    pcache = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                           slots=2, decode_chunk=4, kv_block_size=16,
+                           prefix_cache=4)
+    try:
+        prompt = pcache.tokenizer.encode("publish this prefix " * 10)
+        req = pcache.submit(prompt, max_new_tokens=4)
+        assert req.done.wait(120) and req.error is None
+        doc = pcache.export_prefix_entries()
+        assert len(doc["entries"]) == 1
+        payload = doc["entries"][0]
+
+        with pytest.raises(ValueError, match="prefix cache disabled"):
+            src.import_prefix_entry(json.loads(json.dumps(payload)))
+
+        bad = json.loads(json.dumps(payload))
+        bad["model_sig"]["layers"] = 999
+        with pytest.raises(ValueError, match="incompatible model"):
+            pcache.import_prefix_entry(bad)
+
+        rep = InProcessReplica("r-shim", src)
+        with pytest.raises(ReplicaError) as ei:
+            rep.import_prefix_entry(payload)
+        assert ei.value.status == 409
+    finally:
+        pcache.close()
+
+
+def test_prefix_tier_second_replica_zero_prefill():
+    """The tier's whole point: replica A prefills a shared prompt once,
+    the tier publishes it, and replica B's FIRST request against that
+    prompt admits with ZERO prefill chunks (sched_trace asserted) and
+    token-exact output."""
+    mk = lambda: BatchedEngine(  # noqa: E731
+        MODEL, template="vanilla", max_seq_len=256, slots=2,
+        decode_chunk=4, kv_block_size=16, prefill_chunk=64,
+        prefill_token_budget=64, prefix_cache=4)
+    a, b = mk(), mk()
+    try:
+        prompt = a.tokenizer.encode("shared system preamble " * 20)
+        req = a.submit(prompt, max_new_tokens=8)
+        assert req.done.wait(120) and req.error is None
+        want = req.tokens
+
+        tier = PrefixTier(16 << 20)
+        ra, rb = InProcessReplica("rA", a), InProcessReplica("rB", b)
+        out = tier.sync(ra)
+        assert out["pulled"] >= 1, out
+        out = tier.sync(rb)
+        assert out["pushed"] >= 1, out
+        fp = next(iter(tier._d))
+        assert tier.holders(fp) >= {"rA", "rB"}
+
+        mark = len(b.sched_trace)
+        req_b = b.submit(prompt, max_new_tokens=8)
+        assert req_b.done.wait(120) and req_b.error is None
+        assert req_b.tokens == want, (req_b.tokens, want)
+        # zero prefill chunks on B: the imported entry served the whole
+        # prompt via the exact-hit admission path
+        assert _prefill_tokens(b, mark) == 0
+        assert any(ev[0] == "admit" and ev[3] == "cache"
+                   for ev in list(b.sched_trace)[mark:])
+        assert b.prefill_stats["reuse"] == 1
+        assert b.session_stats["import_prefix"].get("ok", 0) == 1
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------- coordinator policy (fakes)
+
+class _FakePool:
+    def __init__(self, replicas):
+        self._replicas = list(replicas)
+
+    def available(self):
+        return list(self._replicas)
+
+
+class _FakeSessionReplica:
+    """Replica fake for handoff/spill policy: canned stats, canned
+    export/hold docs, scripted import outcomes, recorded calls."""
+
+    def __init__(self, name, role="mixed", free_blocks=8, busy=0,
+                 parked=0, export_doc=None, hold_doc=None,
+                 import_mode="ok"):
+        self.name = name
+        self.role = role
+        self._stats = {"slots_busy": busy, "kv_blocks_free": free_blocks,
+                       "sessions_parked": parked}
+        self.export_doc = export_doc
+        self.hold_doc = hold_doc
+        self.import_mode = import_mode
+        self.calls = []
+
+    def stats_snapshot(self):
+        return dict(self._stats)
+
+    def export_sessions(self, slots=None, wire=None,
+                        include_prefill=False):
+        self.calls.append(("export", include_prefill))
+        return self.export_doc
+
+    def import_session(self, payload):
+        self.calls.append(("import", payload.get("trace_id")))
+        if self.import_mode == "refuse":
+            raise ReplicaError(f"{self.name}: full", status=409)
+        if self.import_mode == "fault":
+            raise ReplicaError(f"{self.name}: died")
+        return ({"session": payload.get("trace_id"),
+                 "text_so_far": "tail "}, iter(["rest"]))
+
+    def hold_parked(self, max_sessions=4, hold_s=10.0):
+        self.calls.append(("hold", max_sessions, hold_s))
+        return self.hold_doc
+
+    def drop_parked(self, trace_ids):
+        self.calls.append(("drop", list(trace_ids)))
+        if getattr(self, "drop_fails", False):
+            raise ReplicaError(f"{self.name}: drop lost")
+        return {"dropped": list(trace_ids)}
+
+    def release_parked(self, trace_ids):
+        self.calls.append(("release", list(trace_ids)))
+        return {"released": list(trace_ids)}
+
+
+def test_handoff_coordinator_policy():
+    parked = {}
+    sess = {"trace_id": "t1", "tokens": [1, 2]}
+    src = _FakeSessionReplica(
+        "pf", role="prefill", busy=2,
+        export_doc={"sessions": [sess],
+                    "skipped": [{"slot": 1,
+                                 "reason": "prefill_in_progress"}]})
+    dec = _FakeSessionReplica("dc", role="decode", free_blocks=9)
+    hc = HandoffCoordinator(_FakePool([src, dec]),
+                            park=lambda t, e: parked.__setitem__(t, e))
+    out = hc.tick()
+    assert out == {"moved": 1, "cold": 0, "skipped": 1}
+    assert hc.counters == {"ok": 1, "cold": 0, "skipped": 1, "none": 0}
+    assert parked["t1"]["target"] == "dc"
+    assert parked["t1"]["text_so_far"] == "tail "
+    # steady-state export never ships mid-prefill tails
+    assert ("export", False) in src.calls
+    # decode-preferring targets only — a second PREFILL replica with more
+    # free blocks still ranks behind the decode replica
+    pf2 = _FakeSessionReplica("pf2", role="prefill", free_blocks=99)
+    from datatunerx_tpu.fleet.handoff import decode_targets
+
+    targets = decode_targets(_FakePool([src, dec, pf2]), "pf")
+    assert [t.name for t in targets] == ["dc", "pf2"]
+
+    # every peer refuses → tombstone parked so the client re-prefills
+    parked.clear()
+    dec.import_mode = "refuse"
+    pf2.import_mode = "refuse"
+    hc2 = HandoffCoordinator(_FakePool([src, dec, pf2]),
+                             park=lambda t, e: parked.__setitem__(t, e))
+    hc2.tick()
+    assert parked["t1"] == {"failed": True}
+    assert hc2.counters["cold"] == 1
+
+    # a prefill source with work but NO peers at all
+    hc3 = HandoffCoordinator(_FakePool([src]),
+                             park=lambda t, e: None)
+    hc3.tick()
+    assert hc3.counters["none"] == 1
+
+
+def test_spill_coordinator_two_phase_ordering():
+    events = []
+    sess = {"trace_id": "s1", "seq": 7, "payload": {"trace_id": "s1"}}
+    src = _FakeSessionReplica("ovc", parked=1,
+                              hold_doc={"sessions": [sess], "parked": 1})
+    dst = _FakeSessionReplica("peer", role="decode", free_blocks=4)
+    orig_drop = src.drop_parked
+
+    def drop_traced(tids):
+        events.append("drop")
+        return orig_drop(tids)
+
+    src.drop_parked = drop_traced
+    sc = SpillCoordinator(
+        _FakePool([src, dst]),
+        park=lambda t, e: events.append(("park", t, e["target"])))
+    out = sc.tick()
+    assert out["moved"] == 1 and sc.counters["ok"] == 1
+    # park-before-drop: the continuation must be waiting BEFORE the drop
+    # terminates the source stream
+    assert events == [("park", "s1", "peer"), "drop"]
+    assert ("hold", sc.max_sessions, sc.hold_s) in src.calls
+
+    # every peer 409s → released immediately (no lease wait), refused
+    src2 = _FakeSessionReplica("ovc2", parked=1,
+                               hold_doc={"sessions": [sess], "parked": 1})
+    full = _FakeSessionReplica("full", role="decode", free_blocks=2,
+                               import_mode="refuse")
+    sc2 = SpillCoordinator(_FakePool([src2, full]), park=lambda t, e: None)
+    assert sc2.tick()["refused"] == 1
+    assert sc2.counters["refused"] == 1
+    assert ("release", ["s1"]) in src2.calls
+
+    # no peer with free blocks → skipped WITHOUT leasing anything
+    src3 = _FakeSessionReplica("ovc3", parked=1,
+                               hold_doc={"sessions": [sess], "parked": 1})
+    empty = _FakeSessionReplica("dry", role="decode", free_blocks=0)
+    sc3 = SpillCoordinator(_FakePool([src3, empty]), park=lambda t, e: None)
+    assert sc3.tick()["skipped"] == 1
+    assert not any(c[0] == "hold" for c in src3.calls)
+
+    # drop failure is LOUD (single-ownership depends on the drop landing)
+    src4 = _FakeSessionReplica("ovc4", parked=1,
+                               hold_doc={"sessions": [sess], "parked": 1})
+    src4.drop_fails = True
+    dst4 = _FakeSessionReplica("peer4", role="decode", free_blocks=4)
+    sc4 = SpillCoordinator(_FakePool([src4, dst4]), park=lambda t, e: None)
+    sc4.tick()
+    assert sc4.counters["error"] == 1
+
+
+# --------------------------------------------- peer spill (real engines)
+
+def test_peer_spill_token_exact_counters_reconcile():
+    """A preemption-parked session re-homed onto a peer resumes
+    TOKEN-EXACTLY, and the books balance: the source's
+    preempt_stats["spilled"] equals the coordinator's ok count, and the
+    continuation (text_so_far + stream) is byte-identical to an
+    undisturbed run."""
+    a = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                      slots=4, decode_chunk=4, kv_block_size=16,
+                      kv_blocks=20, kv_overcommit="on")
+    b = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                      slots=4, decode_chunk=4, kv_block_size=16)
+    try:
+        prompts = [a.tokenizer.encode(f"spill pressure probe {i}")
+                   for i in range(4)]
+        want_text = [b.tokenizer.decode(
+            b.generate(p, max_new_tokens=80), skip_special_tokens=True)
+            for p in prompts]
+
+        parked_box = {}
+        pool = ReplicaPool([InProcessReplica("A", a, role="mixed"),
+                            InProcessReplica("B", b, role="decode")])
+        sc = SpillCoordinator(
+            pool, park=lambda t, e: parked_box.__setitem__(t, e))
+
+        orig = _throttled(a, delay=0.05)
+        try:
+            reqs = [a.submit(p, max_new_tokens=80, trace_id=f"spill-{i}")
+                    for i, p in enumerate(prompts)]
+            deadline = time.monotonic() + 90
+            while sc.counters["ok"] == 0 and time.monotonic() < deadline:
+                if a.parked_sessions:
+                    sc.tick()
+                if all(r.done.is_set() for r in reqs):
+                    break
+                time.sleep(0.01)
+        finally:
+            a._decode = orig
+        assert sc.counters["ok"] >= 1, (
+            f"pool never spilled: {sc.counters}, "
+            f"preempt={a.preempt_stats}")
+
+        for i, r in enumerate(reqs):
+            assert r.done.wait(300), f"request {i} stalled"
+            if r.error is None:
+                # resumed locally (or never preempted): exact in place
+                text = a.tokenizer.decode(r.tokens,
+                                          skip_special_tokens=True)
+                assert text == want_text[i], i
+                continue
+            assert "session migrated" in r.error, (i, r.error)
+            ent = parked_box[r.trace_id]
+            assert ent.get("failed") is not True, ent
+            assert ent["target"] == "B"
+            text = ent["text_so_far"] + "".join(ent["stream"])
+            assert text == want_text[i], (i, text, want_text[i])
+
+        # the books: every coordinator ok is a source-side spilled drop
+        assert a.preempt_stats.get("spilled", 0) == sc.counters["ok"]
+        assert b.session_stats["import"].get("ok", 0) >= sc.counters["ok"]
+        # pools whole again on both sides
+        assert a.free_kv_blocks == a.total_kv_blocks
+        assert b.free_kv_blocks == b.total_kv_blocks
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------- plane gating + wiring
+
+def test_fleet_plane_gating_and_gateway_metrics():
+    """Defaults build NO plane (byte-identical gateway); any flag builds
+    it, ticks cover only the enabled pieces, and the dtx_fleet_* series
+    appear in /metrics exactly when the plane exists."""
+    from datatunerx_tpu.gateway.server import Gateway
+    from tests.test_gateway import FakeEngine
+
+    plane = FleetPlane(_FakePool([]), park=lambda t, e: None)
+    assert not plane.enabled and plane.tick() == {} and plane.stats() == {}
+
+    pool = ReplicaPool([InProcessReplica("r0", FakeEngine("r0"))])
+    gw = Gateway(pool)
+    try:
+        assert gw.fleet is None
+        assert "dtx_fleet_" not in gw.metrics_text()
+    finally:
+        gw.slo.stop()
+
+    pool2 = ReplicaPool([InProcessReplica("r0", FakeEngine("r0"))])
+    gw2 = Gateway(pool2, prefill_threshold=8, fleet_prefix_bytes=1 << 20,
+                  fleet_handoff=True, fleet_spill=True)
+    try:
+        assert gw2.fleet is not None and gw2.fleet.enabled
+        out = gw2.fleet.tick()
+        assert set(out) == {"handoff", "spill", "prefix"}
+        text = gw2.metrics_text()
+        for series in ("dtx_fleet_prefix_entries",
+                       "dtx_fleet_prefix_bytes",
+                       "dtx_fleet_handoff_total",
+                       "dtx_fleet_spill_total"):
+            assert series in text, series
+    finally:
+        gw2.fleet.stop()
+        gw2.slo.stop()
+
+
+def test_router_role_preference_never_filters():
+    from datatunerx_tpu.gateway.router import Router
+    from tests.test_gateway import FakeEngine
+
+    pf = InProcessReplica("pf", FakeEngine("pf"), role="prefill")
+    dc = InProcessReplica("dc", FakeEngine("dc"), role="decode")
+    router = Router(ReplicaPool([pf, dc]), prefill_threshold=32)
+    assert router.route(prompt_tokens=64).name == "pf"
+    assert router.route(prompt_tokens=8).name == "dc"
+    # threshold boundary: exactly AT the threshold counts as long
+    assert router.route(prompt_tokens=32).name == "pf"
+    assert router.role_routes == {"prefill": 2, "decode": 1, "blind": 0}
+    # no token estimate → role-blind (and not counted as a role route)
+    router.route()
+    assert router.role_routes["blind"] == 0
+
+    # preference, never a filter: an all-mixed fleet routes as before
+    mixed = Router(ReplicaPool([
+        InProcessReplica("m0", FakeEngine("m0")),
+        InProcessReplica("m1", FakeEngine("m1"))]), prefill_threshold=32)
+    mixed.route(prompt_tokens=64)
+    assert mixed.role_routes == {"prefill": 0, "decode": 0, "blind": 1}
+    # threshold 0 = the PR 15 router, role logic never consulted
+    off = Router(ReplicaPool([pf, dc]))
+    off.route(prompt_tokens=64)
+    assert off.role_routes == {"prefill": 0, "decode": 0, "blind": 0}
+
+
+def test_managed_replica_set_role_deficit(tmp_path):
+    """Replacement spawns take the role furthest below its cycle share —
+    a dead prefill replica is replaced by a prefill replica, whichever
+    index died."""
+    from datatunerx_tpu.gateway.server import ManagedReplicaSet
+    from tests.test_gateway import FakeEngine
+
+    pool = ReplicaPool([])
+    mgr = ManagedReplicaSet(pool, [], workdir=str(tmp_path),
+                            supervise_interval_s=0,
+                            roles=["prefill", "decode", "decode"])
+    try:
+        assert mgr._next_role() == "prefill"  # fresh fleet: cycle order
+        pool.add(InProcessReplica("r0", FakeEngine("r0"), role="prefill"))
+        assert mgr._next_role() == "decode"
+        pool.add(InProcessReplica("r1", FakeEngine("r1"), role="decode"))
+        assert mgr._next_role() == "decode"  # decode wants 2 of 3
+        pool.add(InProcessReplica("r2", FakeEngine("r2"), role="decode"))
+        # balanced fleet: the first cycle entry wins the tie
+        assert mgr._next_role() == "prefill"
+        # a DRAINING prefill replica no longer counts toward its share
+        pool.get("r0").drain()
+        assert mgr._next_role() == "prefill"
+        # role-less sets keep spawning role-less
+        mgr2 = ManagedReplicaSet(pool, [], workdir=str(tmp_path),
+                                 supervise_interval_s=0)
+        assert mgr2._next_role() is None
+    finally:
+        mgr._shutdown.set()
+
+
+# ----------------------------------------------------- operator plumbing
+
+def _fleet_job(serve):
+    from datatunerx_tpu.operator.api import FinetuneJob, ObjectMeta
+
+    return FinetuneJob(
+        metadata=ObjectMeta(name="j", namespace="default"),
+        spec={"finetune": {"finetuneSpec": {
+            "llm": "m", "dataset": "d",
+            "hyperparameter": {"hyperparameterRef": "h"}}},
+            "serveConfig": serve},
+    )
+
+
+def test_crd_schema_includes_fleet_fields():
+    from datatunerx_tpu.operator.api import FinetuneJob
+    from datatunerx_tpu.operator.crdgen import crd_for
+
+    crd = crd_for(FinetuneJob)
+    serve = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+             ["properties"]["spec"]["properties"]["serveConfig"]
+             ["properties"])
+    for field in ("kvOvercommit", "specDraft", "specK", "specMode",
+                  "role", "prefillThreshold", "fleetPrefixMb",
+                  "fleetHandoff", "fleetSpill"):
+        assert field in serve, field
+    assert serve["role"]["type"] == "string"
+    assert serve["fleetPrefixMb"]["type"] == "number"
+
+
+def test_webhook_validates_fleet_serve_config():
+    from datatunerx_tpu.operator.webhooks import AdmissionError, admit
+
+    # single role needs no gateway; a cycle does
+    admit(_fleet_job({"role": "prefill"}))
+    admit(_fleet_job({"role": "prefill,decode", "replicas": 2}))
+    admit(_fleet_job({"role": "prefill,decode", "gateway": True}))
+    for bad in ({"role": "pilot"},
+                {"role": "prefill,decode"},  # cycle, no gateway
+                {"prefillThreshold": 0},
+                {"fleetPrefixMb": 0},
+                {"kvOvercommit": "maybe"},
+                {"specMode": "sometimes"},
+                {"specK": 0}):
+        with pytest.raises(AdmissionError):
+            admit(_fleet_job(bad))
+
+
+def test_serving_spec_carries_fleet_fields():
+    from datatunerx_tpu.operator.generate import generate_serving_spec
+    from datatunerx_tpu.operator.webhooks import admit
+
+    job = _fleet_job({"replicas": 2, "role": "prefill,decode",
+                      "prefillThreshold": 48, "fleetPrefixMb": 8.5,
+                      "fleetHandoff": True, "fleetSpill": True,
+                      "kvOvercommit": "on", "specMode": "auto",
+                      "specK": 3})
+    admit(job)
+    spec = generate_serving_spec(job, {})
+    assert spec["role"] == "prefill,decode"
+    assert spec["prefill_threshold"] == 48
+    assert spec["fleet_prefix_mb"] == 8.5
+    assert spec["fleet_handoff"] is True and spec["fleet_spill"] is True
+    assert spec["kv_overcommit"] == "on"
+    assert spec["spec_mode"] == "auto" and spec["spec_k"] == 3
+    # absent knobs stay falsy — the backend adds no argv for them
+    bare = generate_serving_spec(_fleet_job({}), {})
+    assert not bare["role"] and not bare["fleet_handoff"]
+    assert not bare["fleet_prefix_mb"] and not bare["prefill_threshold"]
+
+
+# -------------------------------------------- gateway chaos (fake fleet)
+
+def test_selftest_fleet_role_cycle_mid_prefill_rehoming():
+    """The CI role-cycle smoke in miniature: draining the PREFILL
+    replica while sessions are mid-prefill re-homes them with their
+    prompt work kept (mid_prefill_imports counted on the survivor), and
+    every client stream completes with exact text."""
+    from datatunerx_tpu.loadgen.replay import build_selftest_fleet
+
+    gw, engines = build_selftest_fleet(adapters=[], delay_s=0.01,
+                                       roles=["prefill", "decode"],
+                                       prefill_steps=5)
+    try:
+        req = {"messages": [{"role": "user", "content": "hi"}],
+               "max_tokens": 6}
+        texts = {}
+
+        def consume(i):
+            texts[i] = "".join(
+                gw.chat_stream(dict(req), trace_id=f"dtx-pf-{i}"))
+
+        ths = [threading.Thread(target=consume, args=(i,))
+               for i in range(3)]
+        for th in ths:
+            th.start()
+        # drain the prefill replica while its sessions are still paying
+        # prefill steps (5 steps x 10ms leaves a wide window)
+        deadline = time.monotonic() + 5
+        pf = gw.pool.get("replica-0")
+        while not pf.inflight and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert gw.drain("replica-0")
+        for th in ths:
+            th.join(timeout=15)
+        assert all(texts[i] == "tok " * 6 for i in range(3)), texts
+        assert sum(e.mid_prefill_imports for e in engines) >= 1
+        assert not gw.handoff_stats().get("cold")
+    finally:
+        gw.slo.stop()
