@@ -1124,6 +1124,203 @@ def child_disagg(preflight=None):
     print(json.dumps(line), flush=True)
 
 
+def child_tenant(preflight=None):
+    """DTX_BENCH_TENANT=1: multi-tenant QoS twin bench. The same mixed
+    two-tenant workload — a pinned interactive tenant (plat, one adapter,
+    a TTFT objective) sharing the fleet with a 3x-heavier bulk tenant
+    (batch, two adapters churning the pool, a KV-block quota) — runs
+    against TWIN in-process fleets of REAL BatchedEngines at equal chips:
+
+    - **off**: no tenant directory, no host tier (PR 16 behavior): the
+      tenant tags ride the requests but price nothing, every adapter
+      fights the same LRU, and every evict→reload pays the orbax read.
+    - **on**: the tenancy plane (datatunerx_tpu/tenancy/): plat's adapter
+      pinned against eviction, batch priced against its block quota at
+      admission, and the host-RAM adapter tier catching evicted weights
+      so reloads skip orbax.
+
+    One replica per twin ON PURPOSE: with two replicas the router's
+    residency-affinity would park each bulk adapter on its own replica
+    and the pool would never churn — the single 2-slot pool (pinned
+    adapter + 1 contested slot under 2 bulk adapters) makes the
+    evict→reload cycle the bench exists to price deterministic. The line
+    reports the pinned tenant's TTFT p95 on both twins plus the host
+    tier's hit rate, and asserts: zero 5xx on both twins; the pinned
+    adapter still resident after the churn; the churn actually evicted;
+    and every re-load after the first came from host RAM (each adapter
+    paid orbax AT MOST ONCE). CPU numbers are smoke-only, like the serve
+    bench."""
+    import tempfile
+
+    import jax
+
+    if os.environ.get("DTX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.loadgen.replay import LocalClient, ReplayRunner
+    from datatunerx_tpu.loadgen.workload import WorkloadModel, summarize
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = "tinyllama-1.1b" if on_tpu else "debug"
+    max_seq = 1024 if on_tpu else 256
+    n_requests = int(os.environ.get("DTX_BENCH_TENANT_REQUESTS",
+                                    "24" if on_tpu else "12"))
+    rps = float(os.environ.get("DTX_BENCH_TENANT_RPS", "3"))
+
+    tmp = tempfile.mkdtemp(prefix="dtx-tenant-bench-")
+    cks = {name: make_adapter_checkpoint(
+               os.path.join(tmp, name), f"preset:{model}",
+               seed=i + 3, rank=4)
+           for i, name in enumerate(("plat-a", "batch-a", "batch-b"))}
+    tenants_cfg = {
+        "plat": {"tier": "pinned", "adapters": ["plat-a"], "share": 4.0,
+                 "ttft_p95_ms": 2000.0},
+        "batch": {"tier": "bulk", "adapters": ["batch-a", "batch-b"],
+                  "share": 1.0, "kv_block_quota": 24},
+    }
+    mix = {"plat": {"adapters": ["plat-a"], "weight": 1.0},
+           "batch": {"adapters": ["batch-a", "batch-b"], "weight": 3.0}}
+
+    def tenant_p95(tstats: dict, name: str):
+        """→ (p95_ms, source): a tenant's TTFT p95, falling back to its
+        latency p95 when no request streamed a delta — the tiny debug
+        model can sample EOS as the first token, which leaves every
+        ttft_ms None and would report a meaningless 0.0. Real models on
+        TPU stream, so there the headline is true TTFT."""
+        t = tstats.get(name) or {}
+        if t.get("ttft_ms_p95"):
+            return t["ttft_ms_p95"], "ttft"
+        return t.get("latency_ms_p95") or 0.0, "latency"
+
+    def one_run(qos: bool):
+        # a roomy block pool: the bench prices the TENANT quota, not the
+        # fleet-wide block gate (dense-parity default would shed everyone)
+        eng = BatchedEngine(
+            f"preset:{model}", template="vanilla", max_seq_len=max_seq,
+            slots=2, decode_chunk=4, adapters=cks, adapter_pool=2,
+            adapter_rank_max=8, kv_block_size=16, kv_blocks=256,
+            tenants=tenants_cfg if qos else None,
+            host_adapter_cache_mb=64.0 if qos else 0.0)
+        pool = ReplicaPool([InProcessReplica("replica-0", eng)])
+        gw = Gateway(pool, model_name=f"preset:{model}",
+                     tenants=tenants_cfg if qos else None)
+        try:
+            # compile + warm OUTSIDE the clock, identically on both
+            # twins: the base decode step, every adapter's first pool
+            # insert, and one LoRA-apply step each pay one-time jit
+            # compiles that would otherwise all land on whichever twin
+            # runs first and swamp its latencies. plat-a loads LAST so
+            # the pinned adapter starts resident on both twins.
+            eng.generate(eng.tokenizer.encode("warm up"), max_new_tokens=2)
+            for name in ("batch-a", "batch-b", "plat-a"):
+                eng.load_adapter(name, cks[name], preload=True)
+                eng.chat([{"role": "user", "content": "warm"}],
+                         max_new_tokens=2, adapter=name)
+            wl = WorkloadModel(requests=n_requests, sessions=3, rps=rps,
+                               seed=11, prompt_chars=30,
+                               prompt_cap_chars=120, output_tokens=8,
+                               output_cap_tokens=16, base_every=0,
+                               tenants=mix)
+            # ...and one full UNTIMED replay of the exact workload: the
+            # per-adapter warm chats are single-slot and short-prompt, so
+            # the measured pass would still pay first-compiles for the
+            # long multi-turn prefill buckets and two-slot concurrency —
+            # ~1.5s each on CPU, all billed to whichever twin runs first
+            ReplayRunner(LocalClient(gw), max_inflight=8).run(wl.generate())
+            events = wl.generate()
+            runner = ReplayRunner(LocalClient(gw), max_inflight=8)
+            t0 = time.perf_counter()
+            report = runner.run(events)
+            wall = time.perf_counter() - t0
+            occ = eng.adapter_occupancy() or {}
+            host = (eng.adapter_registry.host_tier_stats()
+                    if eng.adapter_registry is not None else None)
+            hits = (host or {}).get("host_hits", 0)
+            orbax = (host or {}).get("orbax_loads", 0)
+            tstats = report.get("tenants") or {}
+            plat_p95, plat_src = tenant_p95(tstats, "plat")
+            batch_p95, _ = tenant_p95(tstats, "batch")
+            return {
+                "workload": summarize(events),
+                "requests": report["requests"],
+                "errors": report["errors"],
+                "codes": report["codes"],
+                "tenants": tstats,
+                "plat_ttft_ms_p95": plat_p95,
+                "plat_p95_source": plat_src,
+                "batch_ttft_ms_p95": batch_p95,
+                "pool_evictions": occ.get("evictions", 0),
+                "pinned_resident_at_end":
+                    "plat-a" in (occ.get("resident_adapters") or []),
+                "host_tier": host,
+                "host_hit_rate": (round(hits / max(hits + orbax, 1), 3)
+                                  if host is not None else None),
+                "wall_s": wall,
+            }
+        finally:
+            gw.close()
+
+    qos_on = one_run(qos=True)
+    qos_off = one_run(qos=False)
+    assert qos_on["errors"] == 0 and qos_off["errors"] == 0, (
+        "tenant twin bench dropped requests: "
+        f"on={qos_on['codes']} off={qos_off['codes']}")
+    for run, label in ((qos_on, "on"), (qos_off, "off")):
+        plat = (run["tenants"].get("plat") or {})
+        assert plat.get("ok", 0) >= 1, (
+            f"pinned tenant served nothing on the qos-{label} twin "
+            f"({plat}) — its TTFT p95 is meaningless")
+    on_plat = qos_on["tenants"].get("plat") or {}
+    assert not on_plat.get("shed"), (
+        "the tenancy twin shed pinned-tenant traffic: "
+        f"{on_plat} — quota pricing leaked onto the wrong tenant")
+    assert qos_on["pool_evictions"] >= 1, (
+        "bulk adapter churn never evicted — the host-tier hit rate "
+        "measures nothing")
+    assert qos_on["pinned_resident_at_end"], (
+        "the pinned tenant's adapter was evicted despite the pin tier")
+    host = qos_on["host_tier"] or {}
+    assert host.get("host_hits", 0) >= 1, (
+        f"no evict→reload came from the host tier: {host}")
+    assert host.get("orbax_loads", 0) <= len(cks), (
+        "an adapter paid the orbax read twice despite the host tier: "
+        f"{host}")
+
+    tag = f"{model},1replica,pool2,3adapters"
+    on_p95 = qos_on["plat_ttft_ms_p95"] or 0.0
+    off_p95 = qos_off["plat_ttft_ms_p95"] or 0.0
+    assert on_p95 > 0 and off_p95 > 0, (
+        "pinned-tenant p95 degenerated to 0 despite the latency "
+        f"fallback: on={qos_on['tenants']} off={qos_off['tenants']}")
+    line = {
+        "metric": f"tenant_pinned_ttft_p95_ms[{tag}]",
+        "value": on_p95,
+        "unit": "ms",
+        "vs_baseline": round(on_p95 / max(off_p95, 1e-9), 3),
+        "platform": jax.devices()[0].platform,
+        "cpu_fallback": not on_tpu,
+        "tenant": {
+            "workload": qos_on["workload"],
+            "host_hit_rate": qos_on["host_hit_rate"],
+            "p95_source": qos_on["plat_p95_source"],
+            "qos_on": {k: v for k, v in qos_on.items()
+                       if k not in ("wall_s", "workload")},
+            "qos_off": {k: v for k, v in qos_off.items()
+                        if k not in ("wall_s", "workload")},
+        },
+    }
+    if preflight is not None:
+        line["preflight"] = preflight
+    print(json.dumps(line), flush=True)
+
+
 # ------------------------------------------------------------- orchestrator
 
 # The probe reports each phase AS IT COMPLETES (one JSON line, flushed), so
@@ -1386,6 +1583,10 @@ if __name__ == "__main__":
         # disaggregated-serving twin bench (uniform vs role-split fleet
         # at equal chips) with the same per-phase pre-flight diagnosis
         child_disagg(preflight=_preflight_probe())
+    elif os.environ.get("DTX_BENCH_TENANT"):
+        # multi-tenant QoS twin bench (tenancy plane on vs off over the
+        # same two-tenant mix) with the same pre-flight diagnosis
+        child_tenant(preflight=_preflight_probe())
     elif os.environ.get("DTX_BENCH_SERVE_CAPACITY"):
         # KV-overcommit capacity twin bench (eager reserve vs overcommit
         # over one block budget) with the same pre-flight diagnosis

@@ -64,9 +64,19 @@ class AdapterRegistry:
     def __init__(self, store: AdapterStore,
                  loader: Optional[Callable[[str], dict]] = None,
                  load_observer: Optional[Callable[[float], None]] = None,
-                 on_load_done: Optional[Callable[[], None]] = None):
+                 on_load_done: Optional[Callable[[], None]] = None,
+                 host_tier=None):
         self.store = store
         self._loader = loader or _default_loader
+        # tenancy host-RAM tier (tenancy/host_tier.HostAdapterTier): evicted
+        # adapters' host arrays stay cached so evict→reload skips orbax;
+        # None (default) = byte-identical pre-tenancy behavior
+        self.host_tier = host_tier
+        self.host_hits = 0  # loads served from the host tier
+        self.orbax_loads = 0  # loads that paid the checkpoint read
+        # adapter names immune to LRU eviction (pinned-tier tenants');
+        # empty set = pre-tenancy eviction order
+        self._pinned_names: set = set()
         # called with each checkpoint load's wall ms (the engine wires the
         # shared-registry dtx_serving_adapter_load_ms histogram here)
         self._load_observer = load_observer
@@ -130,8 +140,23 @@ class AdapterRegistry:
             if ent.slot is not None:
                 self._evict_locked(ent)
             del self._entries[name]
+            if self.host_tier is not None:
+                # a deleted adapter must not resurrect from host RAM
+                self.host_tier.drop(name)
             self._publish_locked()
             return True
+
+    def set_pinned(self, names) -> None:
+        """Replace the pin-tier adapter set (the tenancy directory's
+        pinned tenants' adapters): these names are never chosen as LRU
+        eviction victims while resident. Idempotent; an empty set
+        restores the pre-tenancy eviction order."""
+        with self._lock:
+            self._pinned_names = set(names or ())
+
+    def pinned_names(self) -> set:
+        with self._lock:
+            return set(self._pinned_names)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -185,6 +210,18 @@ class AdapterRegistry:
                 "hbm_bytes": self.store.nbytes(),
                 **self.stats,
             }
+
+    def host_tier_stats(self) -> Optional[dict]:
+        """Host-RAM tier occupancy + the host_hits/orbax_loads load
+        split, or None when the tier isn't configured (so consumers can
+        gate their exposition on its presence)."""
+        if self.host_tier is None:
+            return None
+        out = self.host_tier.stats()
+        with self._lock:
+            out["host_hits"] = self.host_hits
+            out["orbax_loads"] = self.orbax_loads
+        return out
 
     # ------------------------------------------------------- acquire/release
     def acquire(self, name: str, wait: bool = False,
@@ -260,6 +297,8 @@ class AdapterRegistry:
         if self._free_slots:
             return self._free_slots.pop(0)
         for victim_name in self._lru:  # front = coldest
+            if victim_name in self._pinned_names:
+                continue  # pin-tier tenants' adapters never evict
             victim = self._entries.get(victim_name)
             if victim is not None and victim.slot is not None \
                     and victim.refs == 0:
@@ -286,17 +325,28 @@ class AdapterRegistry:
         entry for the next acquire to raise."""
         t0 = time.perf_counter()
         try:
-            state = self._loader(ent.checkpoint)
-            layers = (state.get("lora") or {}).get("layers")
-            if not layers:
-                raise ValueError(
-                    f"adapter {ent.name!r}: no lora tree in "
-                    f"{ent.checkpoint}")
+            cached = (self.host_tier.get(ent.name, ent.checkpoint)
+                      if self.host_tier is not None else None)
+            if cached is not None:
+                # host-tier hit: evict→reload without the orbax read
+                layers, scaling = cached
+                from_host = True
+            else:
+                state = self._loader(ent.checkpoint)
+                layers = (state.get("lora") or {}).get("layers")
+                if not layers:
+                    raise ValueError(
+                        f"adapter {ent.name!r}: no lora tree in "
+                        f"{ent.checkpoint}")
+                from_host = False
+                scaling = state.get("_scaling")
             rank = validate_adapter(layers, self.store.rank_max,
                                     self.store.targets, name=ent.name)
-            scaling = state.get("_scaling")
             if scaling is None:
                 scaling = lora_scaling(32.0, rank)
+            if self.host_tier is not None and not from_host:
+                self.host_tier.put(ent.name, ent.checkpoint, layers,
+                                   float(scaling))
         except Exception as e:  # noqa: BLE001 — parked for the acquirer
             self._load_failed(ent, slot, e)
             return
@@ -319,6 +369,10 @@ class AdapterRegistry:
                 self._lru[ent.name] = None
                 self._lru.move_to_end(ent.name)
                 self.stats["loads"] += 1
+                if from_host:
+                    self.host_hits += 1
+                else:
+                    self.orbax_loads += 1
                 ms = (time.perf_counter() - t0) * 1e3
                 self.load_ms.append(ms)
                 if len(self.load_ms) > 512:

@@ -244,6 +244,8 @@ def cmd_serve(args):
             "--fleet_prefix_mb", str(args.fleet_prefix_mb),
             "--fleet_handoff", str(int(args.fleet_handoff)),
             "--fleet_spill", str(int(args.fleet_spill)),
+            "--tenants_config", args.tenants_config,
+            "--host_adapter_cache_mb", str(args.host_adapter_cache_mb),
         ]
         if args.workdir:
             argv += ["--workdir", args.workdir]
@@ -269,6 +271,8 @@ def cmd_serve(args):
         "--spec_k", str(args.spec_k),
         "--spec_mode", args.spec_mode,
         "--prefill_token_budget", str(args.prefill_token_budget),
+        "--tenants_config", args.tenants_config,
+        "--host_adapter_cache_mb", str(args.host_adapter_cache_mb),
     ]
     if args.role:
         # single server: one role, not a cycle (serving.server validates)
@@ -474,6 +478,16 @@ def main(argv=None):
     vp.add_argument("--fleet_spill", type=int, default=0,
                     help="gateway: 1 = spill preemption-parked sessions "
                          "to peers with free KV blocks")
+    vp.add_argument("--tenants_config", default="",
+                    help="tenant directory (JSON file path or inline JSON "
+                         "object): enables the multi-tenant QoS plane — "
+                         "pinned/standard/bulk tiers, weighted-fair "
+                         "admission shares, per-tenant KV block quotas "
+                         "(empty = plane off, byte-identical serving)")
+    vp.add_argument("--host_adapter_cache_mb", type=float, default=0.0,
+                    help="host-RAM adapter tier budget in MB: evicted "
+                         "pool adapters reload from host arrays instead "
+                         "of orbax (0 = off)")
     vp.add_argument("--replicas", type=int, default=1,
                     help="replica count; > 1 puts the gateway in front")
     vp.add_argument("--gateway", action="store_true",

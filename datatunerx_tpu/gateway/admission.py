@@ -76,15 +76,19 @@ def estimate_prompt_tokens(
 class Ticket:
     """An admitted request's reservation; release exactly once."""
 
-    def __init__(self, controller: "AdmissionController", tokens: int):
+    def __init__(self, controller: "AdmissionController", tokens: int,
+                 tenant: str = "", blocks: int = 0):
         self._controller = controller
         self.tokens = tokens
+        self.tenant = tenant
+        self.blocks = blocks
         self._released = False
 
     def release(self):
         if not self._released:
             self._released = True
-            self._controller._release(self.tokens)
+            self._controller._release(self.tokens, tenant=self.tenant,
+                                      blocks=self.blocks)
 
     def __enter__(self):
         return self
@@ -101,7 +105,8 @@ class AdmissionController:
                  count_tokens: Optional[Callable[[str], int]] = None,
                  fleet_blocks_fn: Optional[Callable[[], Optional[dict]]] = None,
                  decode_headroom_tokens: int = 64,
-                 pending_window_s: float = 2.0):
+                 pending_window_s: float = 2.0,
+                 share_enforce_util: float = 0.8):
         self.max_queue = max_queue
         self.token_budget = token_budget
         self.min_retry_after_s = min_retry_after_s
@@ -119,6 +124,12 @@ class AdmissionController:
         # whole request lifetime would double-count every running session)
         self.pending_window_s = pending_window_s
         self._pending_blocks: List[tuple] = []  # (t_admit, blocks)
+        # weighted-fair shares only bite once the GLOBAL budget is this
+        # contended — an idle gateway lets any tenant burst past its share
+        # (work-conserving, the smooth-WRR property the router already has)
+        self.share_enforce_util = share_enforce_util
+        self._tenant_tokens: dict = {}  # tenant -> in-flight prefill tokens
+        self._tenant_blocks: dict = {}  # tenant -> in-flight priced blocks
         self._depth = 0
         self._tokens = 0
         self._shed = 0
@@ -162,7 +173,12 @@ class AdmissionController:
         return -(-(tokens + self.decode_headroom_tokens) // bs)
 
     def try_admit(self, messages: List[dict],
-                  tokens: Optional[int] = None) -> Ticket:
+                  tokens: Optional[int] = None,
+                  tenant: Optional[dict] = None) -> Ticket:
+        """Admit or shed. ``tenant`` (when the gateway runs a tenant
+        directory) is ``{"name", "share", "share_total", "kv_block_quota"}``
+        — the resolved tenant's pricing row. ``None`` takes exactly the
+        pre-tenancy path, byte for byte."""
         n = tokens if tokens is not None else self.estimate(messages)
         fleet = None
         if self.fleet_blocks_fn is not None:
@@ -170,6 +186,7 @@ class AdmissionController:
                 fleet = self.fleet_blocks_fn()
             except Exception:  # noqa: BLE001 — a stats fault must not shed 500s
                 fleet = None
+        t_name = str(tenant.get("name", "")) if tenant else ""
         with self._lock:
             if fleet and fleet.get("total"):
                 self._note_fleet_locked(fleet)
@@ -184,6 +201,40 @@ class AdmissionController:
                     f"prefill token budget exhausted ({self._tokens}+{n}"
                     f">{self.token_budget})",
                     self._retry_after_locked())
+            if tenant:
+                # weighted-fair share: once the global budget is contended,
+                # tenant i holds at most share_i/Σshares of it. Below the
+                # contention watermark any tenant may burst (work-conserving).
+                share = float(tenant.get("share", 1) or 1)
+                total = float(tenant.get("share_total", share) or share)
+                contended = (self._tokens + n
+                             > self.share_enforce_util * self.token_budget)
+                cap = int(self.token_budget * share / max(total, share))
+                held = self._tenant_tokens.get(t_name, 0)
+                if contended and held + n > cap:
+                    self._shed += 1
+                    raise Overloaded(
+                        f"tenant {t_name} over fair share "
+                        f"({held}+{n}>{cap} tokens, share {share:g}/"
+                        f"{total:g})",
+                        self._retry_after_locked())
+            t_blocks = 0
+            if tenant:
+                # KV-block quota is enforced whether or not the fleet
+                # publishes a block signal — without one the default block
+                # size prices the admit, so a quota'd tenant is still
+                # capped on a dense fleet
+                quota = int(tenant.get("kv_block_quota", 0) or 0)
+                bs = (fleet.get("block_size") or 16) if fleet else 16
+                t_blocks = self.blocks_for_admit(n, bs)
+                t_held = self._tenant_blocks.get(t_name, 0)
+                if quota > 0 and t_held + t_blocks > quota:
+                    self._shed += 1
+                    raise Overloaded(
+                        f"tenant {t_name} KV block quota exhausted "
+                        f"({t_held}+{t_blocks}>{quota} blocks)",
+                        self._retry_after_locked(
+                            block_deficit=t_held + t_blocks - quota))
             if fleet and fleet.get("total"):
                 now = time.monotonic()
                 self._pending_blocks = [
@@ -203,13 +254,31 @@ class AdmissionController:
                 self._pending_blocks.append((now, need))
             self._depth += 1
             self._tokens += n
-        return Ticket(self, n)
+            if tenant:
+                self._tenant_tokens[t_name] = (
+                    self._tenant_tokens.get(t_name, 0) + n)
+                self._tenant_blocks[t_name] = (
+                    self._tenant_blocks.get(t_name, 0) + t_blocks)
+        return Ticket(self, n, tenant=t_name if tenant else "",
+                      blocks=t_blocks)
 
-    def _release(self, tokens: int):
+    def _release(self, tokens: int, tenant: str = "", blocks: int = 0):
         now = time.monotonic()
         with self._lock:
             self._depth = max(0, self._depth - 1)
             self._tokens = max(0, self._tokens - tokens)
+            if tenant in self._tenant_tokens:
+                left = self._tenant_tokens[tenant] - tokens
+                if left > 0:
+                    self._tenant_tokens[tenant] = left
+                else:
+                    self._tenant_tokens.pop(tenant, None)
+            if tenant in self._tenant_blocks:
+                left = self._tenant_blocks[tenant] - blocks
+                if left > 0:
+                    self._tenant_blocks[tenant] = left
+                else:
+                    self._tenant_blocks.pop(tenant, None)
             dt = max(1e-3, now - self._last_release)
             self._last_release = now
             inst = tokens / dt
@@ -261,3 +330,10 @@ class AdmissionController:
     def shed_count(self) -> int:
         with self._lock:
             return self._shed
+
+    def tenant_usage(self) -> dict:
+        """Per-tenant in-flight reservations (tokens and priced blocks)
+        — the gateway restates these as dtx_gateway_tenant_* gauges."""
+        with self._lock:
+            return {"tokens": dict(self._tenant_tokens),
+                    "blocks": dict(self._tenant_blocks)}

@@ -395,10 +395,14 @@ class InProcessReplica(Replica):
 
     def _trace_kwargs(self, kwargs: dict) -> dict:
         """Engines that keep span timelines (BatchedEngine) take the trace
-        id; duck-typed stand-ins get it popped like before."""
+        id; duck-typed stand-ins get it popped like before. Same rule for
+        the tenant tag: only engines running a tenant directory take it."""
         trace_id = kwargs.pop("trace_id", "")
         if trace_id and getattr(self.engine, "trace_store", None) is not None:
             kwargs["trace_id"] = trace_id
+        tenant = kwargs.pop("tenant", "")
+        if tenant and getattr(self.engine, "tenants", None) is not None:
+            kwargs["tenant"] = tenant
         return kwargs
 
     def _note_engine_usage(self, messages):
@@ -651,10 +655,13 @@ class HTTPReplica(Replica):
         self._stats_at = 0.0
 
     # ------------------------------------------------------------------ http
-    def _post(self, path: str, payload: dict, trace_id: str = ""):
+    def _post(self, path: str, payload: dict, trace_id: str = "",
+              tenant: str = ""):
         headers = {"Content-Type": "application/json"}
         if trace_id:
             headers["X-DTX-Trace-Id"] = trace_id
+        if tenant:
+            headers["X-DTX-Tenant"] = tenant
         req = urllib.request.Request(
             self.base_url + path, data=json.dumps(payload).encode(),
             headers=headers, method="POST")
@@ -686,9 +693,11 @@ class HTTPReplica(Replica):
 
     def chat(self, messages, **kwargs) -> str:
         trace_id = kwargs.pop("trace_id", "")
+        tenant = kwargs.pop("tenant", "")
         try:
             with self._post("/chat/completions",
-                            self._payload(messages, kwargs), trace_id) as r:
+                            self._payload(messages, kwargs), trace_id,
+                            tenant=tenant) as r:
                 body = json.load(r)
             self._note_wire_usage(messages, body.get("usage"))
             return body["choices"][0]["message"]["content"]
@@ -706,10 +715,12 @@ class HTTPReplica(Replica):
 
     def chat_stream(self, messages, **kwargs):
         trace_id = kwargs.pop("trace_id", "")
+        tenant = kwargs.pop("tenant", "")
         payload = self._payload(messages, kwargs)
         payload["stream"] = True
         try:
-            resp = self._post("/chat/completions", payload, trace_id)
+            resp = self._post("/chat/completions", payload, trace_id,
+                              tenant=tenant)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500:
                 raise ValueError(f"HTTP {e.code}") from e

@@ -31,6 +31,7 @@ import sys
 import threading
 import time
 import uuid
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
@@ -55,6 +56,7 @@ from datatunerx_tpu.obs.metrics import (
 from datatunerx_tpu.obs.slo import SLOEvaluator, default_slos, load_slos
 from datatunerx_tpu.obs.trace import Span, Tracer, TraceStore
 from datatunerx_tpu.serving.local_backend import _free_port
+from datatunerx_tpu.tenancy import load_tenants
 
 
 # an import may PARK on the target's scheduler this long waiting for
@@ -137,7 +139,8 @@ class Gateway:
                  prefill_threshold: int = 0,
                  fleet_prefix_bytes: int = 0,
                  fleet_handoff: bool = False,
-                 fleet_spill: bool = False):
+                 fleet_spill: bool = False,
+                 tenants=None):
         self.pool = pool
         self.router = Router(pool, policy=policy,
                              prefill_threshold=prefill_threshold)
@@ -222,6 +225,29 @@ class Gateway:
                 pool, self._handoff.put,
                 prefix_budget_bytes=fleet_prefix_bytes,
                 handoff=fleet_handoff, spill=fleet_spill)
+        # multi-tenant QoS plane (datatunerx_tpu/tenancy/): same gating
+        # contract as the fleet plane — no tenant config means no
+        # directory, no per-tenant admission pricing, no dtx_gateway_
+        # tenant_* families, and an exposition byte-identical to a
+        # tenancy-less build.
+        self.tenants = load_tenants(tenants)
+        # adapter → checkpoint catalog for prefetch-on-route, merged
+        # lazily (and stickily) from replicas' adapter_inventory() — the
+        # serving side's adapter_catalog() over the wire
+        self._adapter_catalog: dict = {}
+        self._catalog_lock = threading.Lock()
+        self._tenant_lock = threading.Lock()
+        # per-tenant TTFT observations (ms) for the /autoscale burn
+        # branch; bounded deques keyed by directory names only
+        self._tenant_ttft: dict = {}
+        self._tenant_outcomes: dict = {}  # (tenant, outcome) -> count
+        # distinct tenant label values, capped like router.adapter_requests
+        # (PR 10): every name becomes a Prometheus series, and a directory
+        # grown through POST /admin/tenants must not grow the exposition
+        # without bound
+        self._tenant_seen: set = set()
+        self._tenant_series_cap = 1024
+        self._prefetches = 0
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -257,6 +283,142 @@ class Gateway:
         replica.breaker.record_failure()
         self.router.forget_replica(replica.name)
 
+    # -------------------------------------------------------------- tenancy
+    def _resolve_tenant(self, tenant: str, adapter: str):
+        """The request's TenantSpec (header first, adapter mapping second)
+        or None — anonymous requests take the pre-tenancy path exactly."""
+        if self.tenants is None:
+            return None
+        return self.tenants.resolve(tenant=tenant, adapter=adapter)
+
+    def _admission_tenant(self, spec) -> Optional[dict]:
+        """A resolved tenant's admission pricing row; share_total is the
+        directory-wide Σshares the weighted-fair cap divides by."""
+        if spec is None:
+            return None
+        return {"name": spec.name, "share": spec.share,
+                "share_total": sum(self.tenants.shares().values()) or 1.0,
+                "kv_block_quota": spec.kv_block_quota}
+
+    def _catalog_checkpoint(self, adapter: str) -> Optional[str]:
+        """adapter → checkpoint, merged lazily (and stickily) from the
+        replicas: in-process replicas expose the engine's FULL
+        adapter_catalog(); remote ones their resident inventory."""
+        with self._catalog_lock:
+            ckpt = self._adapter_catalog.get(adapter)
+        if ckpt:
+            return ckpt
+        for r in self.pool.replicas():
+            cat = None
+            fn = getattr(getattr(r, "engine", None), "adapter_catalog",
+                         None)
+            if callable(fn):
+                try:
+                    cat = dict(fn())
+                except Exception:  # noqa: BLE001 — catalog is best-effort
+                    cat = None
+            if cat is None:
+                try:
+                    cat = r.adapter_inventory()
+                except Exception:  # noqa: BLE001
+                    cat = None
+            if cat:
+                with self._catalog_lock:
+                    for n, c in cat.items():
+                        self._adapter_catalog.setdefault(n, c)
+        with self._catalog_lock:
+            return self._adapter_catalog.get(adapter)
+
+    def note_adapter_checkpoint(self, adapter: str, checkpoint: str):
+        """Seed the prefetch catalog (admin adapter registration path)."""
+        if adapter and checkpoint:
+            with self._catalog_lock:
+                self._adapter_catalog[adapter] = checkpoint
+
+    def _maybe_prefetch(self, adapter: str, root: Span):
+        """Prefetch-on-route: when NO replica holds the adapter resident,
+        fire its load on the least-loaded available replica in parallel
+        with admission — by the time the request clears admission and
+        routes, the load-on-miss it would have paid is already in
+        flight. Purely an optimization: any fault is swallowed and the
+        request proceeds down the ordinary load-on-miss path."""
+        try:
+            candidates = self.pool.available()
+            if not candidates:
+                return
+            for r in candidates:
+                try:
+                    st = r.stats_snapshot()
+                except Exception:  # noqa: BLE001 — stats are advisory
+                    st = {}
+                if adapter in (st.get("resident_adapters") or ()):
+                    return  # warm somewhere — the router will find it
+            ckpt = self._catalog_checkpoint(adapter)
+            if not ckpt:
+                return
+            target = min(candidates, key=lambda c: c.inflight)
+            root.event("adapter_prefetch", replica=target.name,
+                       adapter=adapter)
+            with self._tenant_lock:
+                self._prefetches += 1
+            threading.Thread(
+                target=self._prefetch_worker, args=(target, adapter, ckpt),
+                name=f"dtx-prefetch-{adapter}", daemon=True).start()
+        except Exception:  # noqa: BLE001 — prefetch must never fail a request
+            pass
+
+    @staticmethod
+    def _prefetch_worker(replica, adapter: str, checkpoint: str):
+        try:
+            replica.preload_adapter(adapter, checkpoint)
+        except Exception:  # noqa: BLE001 — best-effort warm
+            pass
+
+    def _tenant_observe(self, name: str, outcome: str,
+                        ttft_ms: Optional[float] = None):
+        if self.tenants is None or not name:
+            return
+        with self._tenant_lock:
+            if name not in self._tenant_seen:
+                if len(self._tenant_seen) >= self._tenant_series_cap:
+                    return
+                self._tenant_seen.add(name)
+            key = (name, outcome)
+            self._tenant_outcomes[key] = self._tenant_outcomes.get(key, 0) + 1
+            if ttft_ms is not None:
+                dq = self._tenant_ttft.get(name)
+                if dq is None:
+                    dq = self._tenant_ttft[name] = deque(maxlen=256)
+                dq.append(float(ttft_ms))
+
+    def _tenant_ttft_p95(self, name: str) -> Optional[float]:
+        with self._tenant_lock:
+            window = list(self._tenant_ttft.get(name) or ())
+        if not window:
+            return None
+        window.sort()
+        return window[min(len(window) - 1, int(0.95 * len(window)))]
+
+    def _tenant_burn(self) -> Optional[dict]:
+        """Worst per-tenant TTFT-objective burn, shaped like _slo_burn's
+        verdict — tenants with a ttft_p95_ms objective drive /autoscale
+        even when no gateway-wide SLO doc is configured."""
+        if self.tenants is None:
+            return None
+        worst: Optional[dict] = None
+        for name in self.tenants.names():
+            spec = self.tenants.get(name)
+            if spec is None or spec.ttft_p95_ms <= 0:
+                continue
+            p95 = self._tenant_ttft_p95(name)
+            if p95 is None:
+                continue
+            burn = p95 / spec.ttft_p95_ms
+            if worst is None or burn > worst["burn_rate"]:
+                worst = {"name": f"tenant/{name}:ttft_p95_ms",
+                         "burn_rate": round(burn, 4)}
+        return worst
+
     # -------------------------------------------------------------- tracing
     def _begin_request_span(self, name: str, trace_id: str,
                             adapter: str) -> Span:
@@ -277,7 +439,7 @@ class Gateway:
 
     # ----------------------------------------------------------- non-stream
     def chat(self, req: dict, trace_id: str = "",
-             session_id: Optional[str] = None) -> str:
+             session_id: Optional[str] = None, tenant: str = "") -> str:
         """Complete a non-streamed chat request with failover. Raises
         Overloaded / NoReplicaAvailable / ValueError(client error)."""
         messages = req.get("messages")
@@ -287,10 +449,20 @@ class Gateway:
         kwargs = self._kwargs_from(req)
         if adapter:
             kwargs["adapter"] = adapter
+        t_spec = self._resolve_tenant(tenant, adapter)
+        if t_spec is not None:
+            kwargs["tenant"] = t_spec.name
         t0 = time.monotonic()
         root = self._begin_request_span("gateway.request", trace_id, adapter)
+        if t_spec is not None:
+            root.set(tenant=t_spec.name)
         try:
-            with self.admission.try_admit(messages) as ticket:
+            if self.tenants is not None and adapter:
+                # fired BEFORE admission so the adapter load overlaps it
+                self._maybe_prefetch(adapter, root)
+            admit_kw = ({"tenant": self._admission_tenant(t_spec)}
+                        if t_spec is not None else {})
+            with self.admission.try_admit(messages, **admit_kw) as ticket:
                 root.event("admitted")
                 tried: set = set()
                 last: Optional[Exception] = None
@@ -313,6 +485,10 @@ class Gateway:
                             continue
                         self._latency.observe(time.monotonic() - t0,
                                               trace_id=root.trace_id)
+                        if t_spec is not None:
+                            self._tenant_observe(
+                                t_spec.name, "ok",
+                                ttft_ms=(time.monotonic() - t0) * 1e3)
                         root.set(replica=entry.get("target"),
                                  attempts=attempt + 1, handoff=True)
                         self._finish_request_span(root)
@@ -343,6 +519,10 @@ class Gateway:
                             True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0,
                                               trace_id=root.trace_id)
+                        if t_spec is not None:
+                            self._tenant_observe(
+                                t_spec.name, "ok",
+                                ttft_ms=(time.monotonic() - t0) * 1e3)
                         root.set(replica=replica.name, attempts=attempt + 1)
                         self._finish_request_span(root)
                         return text
@@ -367,12 +547,16 @@ class Gateway:
                 raise NoReplicaAvailable(
                     f"all {len(tried)} attempted replicas failed: {last}")
         except BaseException as e:
+            if t_spec is not None:
+                self._tenant_observe(
+                    t_spec.name,
+                    "shed" if isinstance(e, Overloaded) else "error")
             self._finish_request_span(root, status="error", error=e)
             raise
 
     # --------------------------------------------------------------- stream
     def chat_stream(self, req: dict, trace_id: str = "",
-                    session_id: Optional[str] = None):
+                    session_id: Optional[str] = None, tenant: str = ""):
         """Yield text deltas with MID-STREAM failover: when a replica dies
         after emitting part of the answer, the request restarts on another
         replica and the already-emitted character prefix is skipped — the
@@ -386,12 +570,23 @@ class Gateway:
         kwargs = self._kwargs_from(req)
         if adapter:
             kwargs["adapter"] = adapter
+        t_spec = self._resolve_tenant(tenant, adapter)
+        if t_spec is not None:
+            kwargs["tenant"] = t_spec.name
         t0 = time.monotonic()
         root = self._begin_request_span("gateway.stream", trace_id, adapter)
+        if t_spec is not None:
+            root.set(tenant=t_spec.name)
         try:
-            with self.admission.try_admit(messages) as ticket:
+            if self.tenants is not None and adapter:
+                # fired BEFORE admission so the adapter load overlaps it
+                self._maybe_prefetch(adapter, root)
+            admit_kw = ({"tenant": self._admission_tenant(t_spec)}
+                        if t_spec is not None else {})
+            with self.admission.try_admit(messages, **admit_kw) as ticket:
                 root.event("admitted")
                 emitted = ""
+                t_first: Optional[float] = None
                 tried: set = set()
                 expect_handoff = False
                 for attempt in range(self.max_attempts):
@@ -408,12 +603,18 @@ class Gateway:
                                 if not emitted:
                                     root.event("first_delta",
                                                replica=entry.get("target"))
+                                    t_first = time.monotonic()
                                 emitted += delta
                                 yield delta
                         except ReplicaError:
                             continue  # next attempt: the cold path
                         self._latency.observe(time.monotonic() - t0,
                                               trace_id=root.trace_id)
+                        if t_spec is not None:
+                            self._tenant_observe(
+                                t_spec.name, "ok",
+                                ttft_ms=((t_first or time.monotonic())
+                                         - t0) * 1e3)
                         root.set(replica=entry.get("target"),
                                  attempts=attempt + 1, chars=len(emitted),
                                  handoff=True)
@@ -446,6 +647,7 @@ class Gateway:
                             if not emitted:
                                 root.event("first_delta",
                                            replica=replica.name)
+                                t_first = time.monotonic()
                             emitted += delta
                             yield delta
                         replica.breaker.record_success()
@@ -454,6 +656,11 @@ class Gateway:
                             True, (time.monotonic() - t_attempt) * 1e3)
                         self._latency.observe(time.monotonic() - t0,
                                               trace_id=root.trace_id)
+                        if t_spec is not None:
+                            self._tenant_observe(
+                                t_spec.name, "ok",
+                                ttft_ms=((t_first or time.monotonic())
+                                         - t0) * 1e3)
                         root.set(replica=replica.name, attempts=attempt + 1,
                                  chars=len(emitted))
                         self._finish_request_span(root)
@@ -480,6 +687,10 @@ class Gateway:
                 raise NoReplicaAvailable(
                     f"stream failed over {len(tried)} replicas")
         except BaseException as e:
+            if t_spec is not None:
+                self._tenant_observe(
+                    t_spec.name,
+                    "shed" if isinstance(e, Overloaded) else "error")
             # GeneratorExit included: a client hanging up mid-stream still
             # closes the gateway's span (status error, error=GeneratorExit)
             self._finish_request_span(root, status="error", error=e)
@@ -766,6 +977,14 @@ class Gateway:
         with self._scrape_lock:
             shed_recent = shed_total - self._shed_at_last_hint
             self._shed_at_last_hint = shed_total
+        slo_burn = self._slo_burn() if self.slo_configured else None
+        # a tenant with a ttft_p95_ms objective burns the same branch —
+        # the hint's reason names the tenant and objective
+        t_burn = self._tenant_burn()
+        if t_burn is not None and (slo_burn is None
+                                   or t_burn["burn_rate"]
+                                   > slo_burn["burn_rate"]):
+            slo_burn = t_burn
         return autoscale_hint(
             replicas=len(self.pool.replicas()),
             available_replicas=len(self.pool.available()),
@@ -774,7 +993,7 @@ class Gateway:
             shed_count=shed_total,
             shed_recent=shed_recent,
             p95_latency_s=self._latency.percentile(0.95),
-            slo_burn=self._slo_burn() if self.slo_configured else None,
+            slo_burn=slo_burn,
             # the hint derives from blocks, not slots: the same live
             # free-block sum admission sheds on
             fleet_blocks=self.fleet_kv_blocks(),
@@ -960,7 +1179,59 @@ class Gateway:
             a_resident.set(n, {"adapter": a})
         if self.fleet is not None:
             self._restate_fleet_locked()
+        if self.tenants is not None:
+            self._restate_tenants_locked()
         return self.registry.expose(with_exemplars=with_exemplars)
+
+    def _restate_tenants_locked(self):
+        """dtx_gateway_tenant_* series, restated from the tenancy plane's
+        counters at scrape time. Only emitted when a tenant directory is
+        configured — a tenant-less gateway's exposition is unchanged down
+        to the byte. Label values are resolved directory names plus the
+        bounded outcome enum, so cardinality is operator-controlled."""
+        g = self.registry.gauge
+        t_reqs = self.registry.counter(
+            "dtx_gateway_tenant_requests_total",
+            "Requests per tenant by terminal outcome (ok/shed/error).")
+        t_tokens = g("dtx_gateway_tenant_inflight_tokens",
+                     "Admitted prefill tokens currently held per tenant "
+                     "(the weighted-fair share ledger).")
+        t_blocks = g("dtx_gateway_tenant_inflight_blocks",
+                     "Admission-priced KV blocks currently held per "
+                     "tenant (the kv_block_quota ledger).")
+        t_share = g("dtx_gateway_tenant_share",
+                    "Configured weighted-fair share per tenant.")
+        t_ttft = g("dtx_gateway_tenant_ttft_p95_ms",
+                   "Observed per-tenant TTFT p95 over the rolling "
+                   "window (absent until a tenant has traffic).")
+        prefetch = self.registry.counter(
+            "dtx_gateway_adapter_prefetch_total",
+            "Adapter loads fired on route (prefetch-on-route) in "
+            "parallel with admission.")
+        t_reqs.clear()
+        t_tokens.clear()
+        t_blocks.clear()
+        t_share.clear()
+        t_ttft.clear()
+        with self._tenant_lock:
+            outcomes = dict(self._tenant_outcomes)
+            prefetch.set(self._prefetches)
+        for (name, outcome), n in sorted(outcomes.items()):
+            t_reqs.set(n, {"tenant": name, "outcome": outcome})
+        usage = (self.admission.tenant_usage()
+                 if hasattr(self.admission, "tenant_usage") else {})
+        for name, n in sorted((usage.get("tokens") or {}).items()):
+            t_tokens.set(n, {"tenant": name})
+        for name, n in sorted((usage.get("blocks") or {}).items()):
+            t_blocks.set(n, {"tenant": name})
+        for name in self.tenants.names():
+            spec = self.tenants.get(name)
+            if spec is None:
+                continue
+            t_share.set(spec.share, {"tenant": name})
+            p95 = self._tenant_ttft_p95(name)
+            if p95 is not None:
+                t_ttft.set(round(p95, 3), {"tenant": name})
 
     def _restate_fleet_locked(self):
         """dtx_fleet_* series, restated from the fleet plane's counters
@@ -1413,6 +1684,13 @@ def make_handler(gw: Gateway):
                     self._json(404, {"error": "fleet plane not enabled"})
                 else:
                     self._json(200, self.gateway.fleet.stats())
+            elif self.path == "/admin/tenants":
+                if self.gateway.tenants is None:
+                    self._json(404, {"error": "tenancy plane not enabled"})
+                else:
+                    self._json(200, {
+                        "tenants": self.gateway.tenants.to_dict(),
+                        "generation": self.gateway.tenants.generation})
             elif self.path.startswith("/debug/trace/"):
                 tid = self.path[len("/debug/trace/"):]
                 doc = self.gateway.trace(tid) if tid else None
@@ -1443,6 +1721,8 @@ def make_handler(gw: Gateway):
                 self._drain(req, trace_id)
             elif self.path == "/admin/promote":
                 self._promote(req, trace_id)
+            elif self.path == "/admin/tenants":
+                self._tenants_admin(req, trace_id)
             elif self.path == "/debug/profile":
                 self._profile(req, trace_id)
             else:
@@ -1452,6 +1732,10 @@ def make_handler(gw: Gateway):
             return (self.headers.get("X-DTX-Session-Id")
                     or req.get("session_id") or req.get("user"))
 
+        def _tenant(self, req: dict) -> str:
+            return (self.headers.get("X-DTX-Tenant")
+                    or req.get("tenant") or "")
+
         def _chat(self, req: dict, trace_id: str):
             session_id = self._session_id(req)
             try:
@@ -1459,7 +1743,8 @@ def make_handler(gw: Gateway):
                     self._chat_sse(req, trace_id, session_id)
                     return
                 text = self.gateway.chat(req, trace_id=trace_id,
-                                         session_id=session_id)
+                                         session_id=session_id,
+                                         tenant=self._tenant(req))
                 self._json(200, {
                     "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
                     "object": "chat.completion",
@@ -1487,7 +1772,8 @@ def make_handler(gw: Gateway):
             rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
             try:
                 deltas = self.gateway.chat_stream(req, trace_id=trace_id,
-                                                  session_id=session_id)
+                                                  session_id=session_id,
+                                                  tenant=self._tenant(req))
                 first = next(deltas, None)
             except Overloaded as e:
                 self._json(429, {"error": f"overloaded: {e.reason}"},
@@ -1550,6 +1836,31 @@ def make_handler(gw: Gateway):
                 self._json(503, {"error": str(e)}, trace_id)
             except Exception as e:  # noqa: BLE001 — replica fault
                 self._json(502, {"error": str(e)}, trace_id)
+
+        def _tenants_admin(self, req: dict, trace_id: str):
+            gw_t = self.gateway.tenants
+            if gw_t is None:
+                self._json(404, {"error": "tenancy plane not enabled "
+                                          "(start with --tenants_config)"},
+                           trace_id)
+                return
+            name = req.get("name") or ""
+            try:
+                if req.get("remove"):
+                    if not gw_t.remove(name):
+                        self._json(404, {"error": f"no tenant {name!r}"},
+                                   trace_id)
+                        return
+                else:
+                    entry = {k: v for k, v in req.items()
+                             if k in ("tier", "adapters", "share",
+                                      "kv_block_quota", "ttft_p95_ms")}
+                    gw_t.upsert(name, entry)
+            except ValueError as e:
+                self._json(400, {"error": str(e)}, trace_id)
+                return
+            self._json(200, {"tenants": gw_t.to_dict(),
+                             "generation": gw_t.generation}, trace_id)
 
         def _scale(self, req: dict, trace_id: str):
             try:
@@ -1688,6 +1999,19 @@ def main(argv=None):
                    help="comma-separated role cycle for spawned replicas "
                         "(e.g. 'prefill,decode' alternates; entries from "
                         "prefill/decode/mixed); empty = all mixed")
+    p.add_argument("--tenants_config", default="",
+                   help="tenant directory: a JSON file path or inline "
+                        "JSON object mapping tenant -> {tier, adapters, "
+                        "share, kv_block_quota, ttft_p95_ms}. Enables "
+                        "the multi-tenant QoS plane (weighted-fair "
+                        "admission, per-tenant KV quotas, pinned adapter "
+                        "tiers); empty (default) leaves the gateway "
+                        "byte-identical to a tenant-less build")
+    p.add_argument("--host_adapter_cache_mb", type=float, default=0.0,
+                   help="per-replica host-RAM adapter tier budget in MB "
+                        "(spawn mode pass-through): evicted adapters "
+                        "re-load from host arrays instead of orbax. "
+                        "0 (default) disables the tier")
     p.add_argument("--session_handoff", type=int, default=1,
                    help="1 (default): drain exports every in-flight KV "
                         "session from the leaving replica and imports it "
@@ -1765,7 +2089,8 @@ def main(argv=None):
                  prefill_threshold=args.prefill_threshold,
                  fleet_prefix_bytes=int(args.fleet_prefix_mb * 1024 * 1024),
                  fleet_handoff=bool(args.fleet_handoff),
-                 fleet_spill=bool(args.fleet_spill))
+                 fleet_spill=bool(args.fleet_spill),
+                 tenants=args.tenants_config or None)
     if args.slo_sample_s > 0:
         gw.slo.start(args.slo_sample_s)
     if gw.fleet is not None:
@@ -1796,6 +2121,11 @@ def main(argv=None):
                        "--prefill_chunk", str(args.prefill_chunk),
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]
+        if args.tenants_config:
+            server_args += ["--tenants_config", args.tenants_config]
+        if args.host_adapter_cache_mb > 0:
+            server_args += ["--host_adapter_cache_mb",
+                            str(args.host_adapter_cache_mb)]
         gw.replica_set = ManagedReplicaSet(
             pool, server_args, workdir=args.workdir or "gateway-replicas",
             roles=roles)

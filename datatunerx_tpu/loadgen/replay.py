@@ -82,12 +82,15 @@ class HTTPClient:
                    "stream": True}
         if event.get("model"):
             payload["model"] = event["model"]
+        headers = {"Content-Type": "application/json",
+                   "X-DTX-Trace-Id": trace_id,
+                   "X-DTX-Session-Id": event.get("session") or ""}
+        if event.get("tenant"):
+            headers["X-DTX-Tenant"] = event["tenant"]
         req = urllib.request.Request(
             self.base_url + "/chat/completions",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json",
-                     "X-DTX-Trace-Id": trace_id,
-                     "X-DTX-Session-Id": event.get("session") or ""},
+            headers=headers,
             method="POST")
         t0 = time.perf_counter()
         ttft = None
@@ -148,7 +151,8 @@ class LocalClient:
         try:
             for delta in self.gateway.chat_stream(
                     req, trace_id=trace_id,
-                    session_id=event.get("session")):
+                    session_id=event.get("session"),
+                    tenant=event.get("tenant") or ""):
                 if ttft is None:
                     ttft = time.perf_counter() - t0
                 chars += len(delta)
@@ -198,6 +202,8 @@ class ReplayRunner:
             out = self.client.send(event, trace_id)
             out["trace_id"] = trace_id
             out["session"] = event.get("session")
+            if event.get("tenant"):
+                out["tenant"] = event["tenant"]
             self._requests.inc({"code": str(out["code"])})
             if out["ttft_ms"] is not None:
                 self._ttft.observe(out["ttft_ms"], trace_id=trace_id)
@@ -256,6 +262,30 @@ class ReplayRunner:
             "latency_ms_p95": round(sample_percentile(lats, 0.95), 2),
             "latency_ms_p99": round(sample_percentile(lats, 0.99), 2),
         }
+        by_tenant: dict = {}
+        for r in results:
+            if r.get("tenant"):
+                by_tenant.setdefault(r["tenant"], []).append(r)
+        if by_tenant:
+            # per-tenant QoS breakdown — the isolation evidence: a pinned
+            # tenant's tail must hold while a bulk tenant gets shed
+            rep["tenants"] = {}
+            for name in sorted(by_tenant):
+                rs = by_tenant[name]
+                tt = [r["ttft_ms"] for r in rs if r["ttft_ms"] is not None]
+                lat = [r["latency_ms"] for r in rs
+                       if r.get("latency_ms") is not None]
+                rep["tenants"][name] = {
+                    "requests": len(rs),
+                    "ok": sum(1 for r in rs if r["code"] < 400),
+                    "shed": sum(1 for r in rs if r["code"] == 429),
+                    "errors": sum(1 for r in rs if r["code"] >= 500),
+                    "ttft_ms_p95": round(sample_percentile(tt, 0.95), 2),
+                    # empty completions (tiny models sampling EOS first)
+                    # leave ttft None; latency is always measured, so
+                    # consumers can fall back to it
+                    "latency_ms_p95": round(sample_percentile(lat, 0.95), 2),
+                }
         if chaos is not None:
             rep["chaos"] = chaos.report()
         return rep
@@ -418,15 +448,36 @@ class _FakeEngine:
         return not self.fail
 
 
+#: the two-tier tenant selftest: a pinned tenant with a TTFT objective
+#: and a bulk tenant whose KV-block quota is deliberately tight, so the
+#: bulk flood sheds at admission instead of queueing in front of the
+#: pinned tenant's traffic.
+SELFTEST_TENANTS = {
+    "plat": {"tier": "pinned", "adapters": ["tenant-a"], "share": 8.0,
+             "ttft_p95_ms": 500.0},
+    "batch": {"tier": "bulk", "adapters": ["tenant-b"], "share": 1.0,
+              "kv_block_quota": 8},
+}
+
+#: the matching workload mix: the bulk tenant arrives 4x as often — the
+#: overload is the experiment, the pinned tenant's p95 is the verdict
+SELFTEST_TENANT_MIX = {
+    "plat": {"adapters": ["tenant-a"], "weight": 1.0},
+    "batch": {"adapters": ["tenant-b"], "weight": 4.0},
+}
+
+
 def build_selftest_fleet(adapters: Optional[List[str]] = None,
                          session_handoff: bool = True,
                          delay_s: float = 0.002,
                          roles: Optional[List[str]] = None,
-                         prefill_steps: int = 0):
+                         prefill_steps: int = 0,
+                         tenants: Optional[dict] = None):
     """2 in-process fake replicas behind a real Gateway — the CI smoke
     fleet. Returns (gateway, engines). ``roles`` assigns disaggregation
     roles by replica index and turns the fleet handoff plane on, so a
-    drain ships mid-prefill tails instead of skipping them."""
+    drain ships mid-prefill tails instead of skipping them. ``tenants``
+    turns the multi-tenant QoS plane on (directory config, tenancy/)."""
     from datatunerx_tpu.gateway.replica_pool import (
         InProcessReplica,
         ReplicaPool,
@@ -444,7 +495,8 @@ def build_selftest_fleet(adapters: Optional[List[str]] = None,
         for i, e in enumerate(engines)])
     gw = Gateway(pool, model_name="selftest",
                  session_handoff=session_handoff,
-                 fleet_handoff=bool(roles))
+                 fleet_handoff=bool(roles),
+                 tenants=tenants)
     return gw, engines
 
 
@@ -595,6 +647,16 @@ def main(argv=None) -> int:
                         "roles by replica index (e.g. 'prefill,decode') — "
                         "turns the fleet handoff plane on and points the "
                         "default drain chaos at the first prefill replica")
+    p.add_argument("--tenants", choices=["on", "off"], default="off",
+                   help="selftest: turn the multi-tenant QoS plane on — a "
+                        "pinned tenant (plat, TTFT objective) and a bulk "
+                        "tenant (batch, tight KV-block quota) share the "
+                        "fleet, with the bulk tenant arriving 4x as often")
+    p.add_argument("--expect_tenant_qos", action="store_true",
+                   help="fail (exit 1) unless the pinned tenant's ttft p95 "
+                        "held under its objective with zero sheds/5xx "
+                        "while the bulk overload was shed at admission — "
+                        "the multi-tenant isolation CI assertion")
     p.add_argument("--selftest_prefill", type=int, default=0,
                    help="selftest: silent prefill steps per session before "
                         "the first token; with --roles + --expect_handoff "
@@ -620,7 +682,8 @@ def main(argv=None) -> int:
             requests=args.requests, sessions=args.sessions, rps=args.rps,
             seed=args.seed,
             adapters=adapters or (["tenant-a", "tenant-b"]
-                                  if args.selftest else []))
+                                  if args.selftest else []),
+            tenants=SELFTEST_TENANT_MIX if args.tenants == "on" else None)
         events = model.generate()
         meta = model.meta()
         print(f"[replay] generated workload: {summarize(events)}")
@@ -652,7 +715,8 @@ def main(argv=None) -> int:
             gw, engines = build_selftest_fleet(
                 adapters or None, session_handoff=args.handoff == "on",
                 delay_s=args.selftest_delay, roles=roles or None,
-                prefill_steps=args.selftest_prefill)
+                prefill_steps=args.selftest_prefill,
+                tenants=SELFTEST_TENANTS if args.tenants == "on" else None)
             client = LocalClient(gw)
             # with roles on, the interesting drain is the prefill
             # specialist — caught mid-prompt, its tail must ship
@@ -691,6 +755,10 @@ def main(argv=None) -> int:
             print(f"[replay] session handoff "
                   f"({'on' if gw.session_handoff else 'off'}): "
                   f"{report['handoff'] or 'no sessions moved'}")
+        for name, st in sorted((report.get("tenants") or {}).items()):
+            print(f"[replay] tenant {name}: {st['requests']} requests "
+                  f"ok={st['ok']} shed={st['shed']} errors={st['errors']} "
+                  f"ttft p95={st['ttft_ms_p95']}ms")
         verdict = slo_epilogue(evaluator, since_t=t_start - 1.0)
         report["slo"] = verdict
         report["workload"] = meta
@@ -724,6 +792,37 @@ def main(argv=None) -> int:
             else:
                 print("[replay] handoff assertion PASSED: sessions moved, "
                       "zero cold fallbacks, zero drops")
+        if args.expect_tenant_qos:
+            problems = []
+            ts = report.get("tenants") or {}
+            plat, batch = ts.get("plat") or {}, ts.get("batch") or {}
+            if not plat.get("requests") or not batch.get("requests"):
+                problems.append("both selftest tenants must see traffic "
+                                "(run with --selftest --tenants on)")
+            else:
+                objective = SELFTEST_TENANTS["plat"]["ttft_p95_ms"]
+                if plat.get("shed") or plat.get("errors"):
+                    problems.append(
+                        "pinned tenant was not isolated: "
+                        f"shed={plat['shed']} errors={plat['errors']}")
+                if plat.get("ttft_ms_p95", 0.0) > objective:
+                    problems.append(
+                        f"pinned tenant ttft p95 {plat['ttft_ms_p95']}ms "
+                        f"blew its {objective:g}ms objective under bulk "
+                        "overload")
+                if not batch.get("shed"):
+                    problems.append(
+                        "bulk tenant was never shed — the overload this "
+                        "assertion exists to survive did not happen")
+            for p_ in problems:
+                print(f"[replay] tenant QoS assertion FAILED: {p_}")
+            if problems:
+                rc = 1
+            else:
+                print("[replay] tenant QoS assertion PASSED: pinned p95 "
+                      f"{plat['ttft_ms_p95']}ms held its objective; bulk "
+                      f"shed {batch['shed']}/{batch['requests']} at "
+                      "admission")
         if args.report_json:
             with open(args.report_json, "w", encoding="utf-8") as f:
                 json.dump(report, f, indent=1)

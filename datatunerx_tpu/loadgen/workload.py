@@ -64,7 +64,8 @@ class WorkloadModel:
                  base_every: int = 4,
                  prompt_chars: int = 80, prompt_cap_chars: int = 2000,
                  output_tokens: int = 16, output_cap_tokens: int = 96,
-                 tail_alpha: float = 1.5, temperature: float = 0.8):
+                 tail_alpha: float = 1.5, temperature: float = 0.8,
+                 tenants: Optional[Dict[str, dict]] = None):
         if requests < 1 or sessions < 1 or rps <= 0:
             raise ValueError("requests/sessions must be >= 1, rps > 0")
         self.requests = requests
@@ -73,6 +74,12 @@ class WorkloadModel:
         self.seed = seed
         self.adapters = list(adapters or [])
         self.base_every = max(0, base_every)
+        # multi-tenant mix: tenant -> {"adapters": [...], "weight": w}.
+        # Each event draws a tenant by arrival weight, then an adapter
+        # Zipf-weighted WITHIN that tenant's set, and carries a "tenant"
+        # tag the replay clients forward as X-DTX-Tenant. Empty = the
+        # untagged single-tenant mix, bit-identical to older traces.
+        self.tenants = {str(n): dict(e) for n, e in (tenants or {}).items()}
         self.prompt_chars = prompt_chars
         self.prompt_cap_chars = prompt_cap_chars
         self.output_tokens = output_tokens
@@ -83,14 +90,25 @@ class WorkloadModel:
         # to measure
         self.temperature = temperature
 
-    def _pick_adapter(self, rng: random.Random, i: int) -> str:
-        if not self.adapters:
+    def _pick_adapter(self, rng: random.Random, i: int,
+                      adapters: Optional[List[str]] = None) -> str:
+        pool = self.adapters if adapters is None else adapters
+        if not pool:
             return ""
         if self.base_every and i % self.base_every == 0:
             return ""  # every k-th request exercises the base model
         # Zipf-ish: weight 1/rank — hot tenants dominate, the tail churns
-        weights = [1.0 / (r + 1) for r in range(len(self.adapters))]
-        return rng.choices(self.adapters, weights=weights, k=1)[0]
+        weights = [1.0 / (r + 1) for r in range(len(pool))]
+        return rng.choices(pool, weights=weights, k=1)[0]
+
+    def _pick_tenant(self, rng: random.Random) -> Tuple[str, Optional[List[str]]]:
+        if not self.tenants:
+            return "", None
+        names = sorted(self.tenants)
+        weights = [max(0.0, float(self.tenants[n].get("weight", 1.0)))
+                   for n in names]
+        name = rng.choices(names, weights=weights, k=1)[0]
+        return name, list(self.tenants[name].get("adapters") or [])
 
     def generate(self) -> List[dict]:
         rng = random.Random(self.seed)
@@ -115,15 +133,19 @@ class WorkloadModel:
             max_tokens = _pareto_int(rng, self.output_tokens,
                                      self.tail_alpha,
                                      self.output_cap_tokens)
-            events.append({
+            tenant, tenant_adapters = self._pick_tenant(rng)
+            event = {
                 "t": round(t, 4),
                 "session": f"s{s}",
                 "turn": turns[s],
                 "messages": messages,
                 "max_tokens": max_tokens,
                 "temperature": self.temperature,
-                "model": self._pick_adapter(rng, i),
-            })
+                "model": self._pick_adapter(rng, i, tenant_adapters),
+            }
+            if tenant:
+                event["tenant"] = tenant
+            events.append(event)
             turns[s] += 1
             # the assistant's (synthetic) reply joins the history, so the
             # next turn replays a strictly-grown prefix; histories are
@@ -137,12 +159,15 @@ class WorkloadModel:
         return events
 
     def meta(self) -> dict:
-        return {
+        doc = {
             "requests": self.requests, "sessions": self.sessions,
             "rps": self.rps, "seed": self.seed,
             "adapters": list(self.adapters),
             "tail_alpha": self.tail_alpha,
         }
+        if self.tenants:
+            doc["tenants"] = {n: dict(e) for n, e in self.tenants.items()}
+        return doc
 
 
 # ------------------------------------------------- gateway trace-log import
@@ -269,7 +294,7 @@ def summarize(events: List[dict]) -> Dict[str, float]:
                    for e in events)
     adapters = {e.get("model") or "" for e in events}
     multi = sum(1 for e in events if e.get("turn", 0) > 0)
-    return {
+    out = {
         "requests": len(events),
         "duration_s": round(events[-1]["t"], 3),
         "prompt_chars_p50": chars[len(chars) // 2],
@@ -277,3 +302,7 @@ def summarize(events: List[dict]) -> Dict[str, float]:
         "multi_turn": multi,
         "adapters": len(adapters - {""}),
     }
+    tenants = {e.get("tenant") or "" for e in events} - {""}
+    if tenants:
+        out["tenants"] = len(tenants)
+    return out

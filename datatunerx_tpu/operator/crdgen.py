@@ -116,6 +116,14 @@ SPECS = {
             "fleetPrefixMb": {"type": "number"},
             "fleetHandoff": BOOL,
             "fleetSpill": BOOL,
+            # multi-tenant QoS plane (datatunerx_tpu/tenancy/): tenants is
+            # an inline tenant -> {tier, adapters, share, kvBlockQuota,
+            # ttftP95Ms} map (webhook-validated) or tenantsConfig a file
+            # path mounted into the pod; hostAdapterCacheMb bounds the
+            # host-RAM adapter tier evicted pool adapters fall back to
+            "tenants": ANY,
+            "tenantsConfig": STR,
+            "hostAdapterCacheMb": {"type": "number"},
         }),
     }, required=["finetune"]),
     "FinetuneExperiment": obj({

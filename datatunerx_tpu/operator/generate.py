@@ -15,6 +15,7 @@ generate_plugin_scoring   → Scoring CR with user plugin (generate.go:343-358)
 
 from __future__ import annotations
 
+import json
 import random
 import string
 from typing import List, Optional
@@ -255,7 +256,27 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         "fleet_prefix_mb": serve_cfg.get("fleetPrefixMb"),
         "fleet_handoff": bool(serve_cfg.get("fleetHandoff")),
         "fleet_spill": bool(serve_cfg.get("fleetSpill")),
+        # multi-tenant QoS plane (datatunerx_tpu/tenancy/): the inline map
+        # renders to one --tenants_config JSON argument (camelCase keys
+        # mapped onto the directory schema); tenantsConfig is a mounted
+        # file path passed through verbatim
+        "tenants_config": _tenants_config_from(serve_cfg),
+        "host_adapter_cache_mb": serve_cfg.get("hostAdapterCacheMb"),
     }
+
+
+def _tenants_config_from(serve_cfg: dict) -> str:
+    """serveConfig.tenants (inline map) or .tenantsConfig (file path) →
+    the one --tenants_config string both servers load."""
+    inline = serve_cfg.get("tenants")
+    if isinstance(inline, dict) and inline:
+        from datatunerx_tpu.tenancy import tenant_entry_from_crd
+
+        return json.dumps({str(n): tenant_entry_from_crd(e)
+                           if isinstance(e, dict) else e
+                           for n, e in inline.items()},
+                          sort_keys=True)
+    return serve_cfg.get("tenantsConfig") or ""
 
 
 def generate_builtin_scoring(job: FinetuneJob, inference_url: str) -> Scoring:

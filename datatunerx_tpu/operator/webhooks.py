@@ -167,6 +167,30 @@ def _validate_serve_config(cfg: dict):
         _require(len(roles) == 1 or gateway,
                  "serveConfig.role cycles need the gateway (replicas > 1 "
                  "or gateway=true) to distribute them")
+    tenants = cfg.get("tenants")
+    if tenants is not None:
+        from datatunerx_tpu.tenancy import (
+            tenant_entry_from_crd,
+            validate_tenant_entry,
+        )
+
+        _require(isinstance(tenants, dict) and bool(tenants),
+                 "serveConfig.tenants must be a non-empty object mapping "
+                 "tenant name to its policy")
+        _require(cfg.get("tenantsConfig") in (None, ""),
+                 "serveConfig.tenants and tenantsConfig are mutually "
+                 "exclusive (inline map or mounted file, not both)")
+        for name, entry in tenants.items():
+            entry = (tenant_entry_from_crd(entry)
+                     if isinstance(entry, dict) else entry)
+            try:
+                validate_tenant_entry(str(name), entry)
+            except ValueError as e:
+                _require(False, f"serveConfig.tenants: {e}")
+    if cfg.get("hostAdapterCacheMb") is not None:
+        _require(_num(cfg["hostAdapterCacheMb"],
+                      "serveConfig.hostAdapterCacheMb") >= 0,
+                 "serveConfig.hostAdapterCacheMb must be >= 0")
 
 
 def validate_finetuneexperiment(obj: CustomResource):

@@ -250,7 +250,8 @@ class Request:
     def __init__(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
                  stop_ids: Sequence[int], adapter: int,
-                 adapter_name: str = "", trace_id: str = ""):
+                 adapter_name: str = "", trace_id: str = "",
+                 tenant: str = "", tenant_tier: str = "standard"):
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -265,6 +266,12 @@ class Request:
         # resolves (and pins) the NAME to a pool slot via the registry
         self.adapter = adapter
         self.adapter_name = adapter_name
+        # tenancy plane: resolved at submit from the engine's directory
+        # (header name first, adapter mapping second). "" = anonymous —
+        # scheduled exactly like a pre-tenancy request. tenant_tier feeds
+        # the overcommit preemption order; see _reclaim_for.
+        self.tenant = tenant
+        self.tenant_tier = tenant_tier
         # residency at FIRST admission attempt (None until then) — the
         # trace's loaded flag must reflect whether this request paid the
         # load, not the state after its own load completed
@@ -602,6 +609,8 @@ class BatchedEngine:
         trace_ring: int = 256,  # completed traces kept for /debug/trace
         trace_log_path: Optional[str] = None,  # optional JSONL span log
         prefix_keep_warm: bool = False,  # publish prompt blocks on preempt
+        tenants=None,  # TenantDirectory / dict / path / inline JSON
+        host_adapter_cache_mb: float = 0.0,  # host-RAM adapter tier budget
     ):
         # serving is single-program: clear any mesh a Trainer left in the
         # process-global flash context before the engine's jits first trace
@@ -627,6 +636,19 @@ class BatchedEngine:
                 self.params = jax.device_put(state["params"])
         self._static_adapter_ids: Dict[str, int] = {"": 0}  # 0 = base
         self.lora_stack: Optional[tuple] = None
+        # multi-tenant QoS plane (datatunerx_tpu/tenancy/): tenant → tier /
+        # adapter set / share / KV quota. None (the default) keeps every
+        # path below — eviction order, preemption order, /metrics bytes —
+        # identical to a tenancy-less build (the PR 15/16 gating pattern).
+        from datatunerx_tpu.tenancy import load_tenants
+
+        self.tenants = load_tenants(tenants)
+        self.host_adapter_cache_mb = float(host_adapter_cache_mb or 0.0)
+        # per-tenant usage counters (dtx_serving_tenant_*); capped like
+        # adapter_requests so tenant churn can't grow the exposition
+        self._tenant_lock = threading.Lock()
+        self.tenant_stats: Dict[str, Dict[str, int]] = {}
+        self._tenant_stats_cap = 1024
         # dynamic pooled mode (adapter_pool > 0): adapters are DATA — a
         # fixed-geometry device pool + host registry with load-on-miss /
         # LRU eviction / refcount pinning (datatunerx_tpu/adapters/).
@@ -642,15 +664,25 @@ class BatchedEngine:
                 self.cfg, pool_slots=int(adapter_pool),
                 rank_max=int(adapter_rank_max) or 8,
                 targets=tuple(adapter_targets or DEFAULT_TARGETS))
+            host_tier = None
+            if self.host_adapter_cache_mb > 0:
+                from datatunerx_tpu.tenancy import HostAdapterTier
+
+                host_tier = HostAdapterTier(
+                    int(self.host_adapter_cache_mb * 1024 * 1024))
             self.adapter_registry = AdapterRegistry(
                 self.adapter_store,
                 # lazy closures: both attributes exist before any load runs
                 load_observer=lambda ms: self._h_adapter_load.observe(ms),
                 # an async load resolving wakes the scheduler so the
                 # FIFO-head admits immediately instead of on the next poll
-                on_load_done=lambda: self._wake.set())
+                on_load_done=lambda: self._wake.set(),
+                host_tier=host_tier)
             for aname, path in named.items():
                 self.adapter_registry.register(aname, path)
+            if self.tenants is not None:
+                self.adapter_registry.set_pinned(
+                    self.tenants.pinned_adapters())
         elif named:
             self._build_adapter_stack(named)
         # per-adapter request counters (dtx_serving_adapter_requests_total).
@@ -1126,6 +1158,60 @@ class BatchedEngine:
         if self.adapter_registry is None:
             return None
         return self.adapter_registry.resident()
+
+    # ------------------------------------------------------------- tenancy
+    def _tenant_count(self, tenant: str, key: str, n: int):
+        """Bump a per-tenant usage counter under the cap (the PR 10
+        adapter_requests pattern): known tenants always count, new label
+        values stop landing once 1024 distinct tenants exist — a client-
+        controlled header must not grow the exposition unboundedly."""
+        with self._tenant_lock:
+            row = self.tenant_stats.get(tenant)
+            if row is None:
+                if len(self.tenant_stats) >= self._tenant_stats_cap:
+                    return
+                row = self.tenant_stats[tenant] = {
+                    "requests": 0, "tokens_in": 0, "tokens_out": 0}
+            row[key] = row.get(key, 0) + n
+
+    def tenant_usage(self) -> Optional[dict]:
+        """Per-tenant usage + live occupancy for stats()//metrics, or None
+        when the tenancy plane is off (consumers gate their exposition on
+        this, keeping the no-config scrape byte-identical)."""
+        if self.tenants is None:
+            return None
+        with self._tenant_lock:
+            usage = {t: dict(row) for t, row in self.tenant_stats.items()}
+        # live KV blocks per tenant: racy slot-list reads, same contract
+        # as every other scrape-path stats read
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or not getattr(req, "tenant", ""):
+                continue
+            row = usage.setdefault(
+                req.tenant,
+                {"requests": 0, "tokens_in": 0, "tokens_out": 0})
+            row["kv_blocks"] = (row.get("kv_blocks", 0)
+                                + len(self._slot_blocks[s]))
+        # adapter residency per tenant (how many of the tenant's adapters
+        # are pool-resident right now)
+        resident = set(self.adapter_registry.resident()) \
+            if self.adapter_registry is not None else set()
+        for name in self.tenants.names():
+            spec = self.tenants.get(name)
+            if spec is None:
+                continue
+            row = usage.setdefault(
+                name, {"requests": 0, "tokens_in": 0, "tokens_out": 0})
+            row["tier"] = spec.tier
+            row["adapters_resident"] = len(resident & set(spec.adapters))
+        return usage
+
+    def refresh_tenant_pins(self):
+        """Re-sync the registry's pin set after a directory change (the
+        serving admin plane calls this on tenant upserts)."""
+        if self.tenants is not None and self.adapter_registry is not None:
+            self.adapter_registry.set_pinned(self.tenants.pinned_adapters())
 
     # ------------------------------------------------------------ scheduler
     def _prefix_key(self, ids, plen, n_prompt, akey):
@@ -1640,6 +1726,8 @@ class BatchedEngine:
                 self._h_tpot.observe(
                     (req.last_token_ts - req.first_token_ts) / (n - 1) * 1e3,
                     trace_id=tid)
+        if self.tenants is not None and getattr(req, "tenant", ""):
+            self._tenant_count(req.tenant, "tokens_out", n)
         if self.tracing:
             span = build_request_span(
                 req.trace_id, req.t_submit, req.timeline,
@@ -2702,17 +2790,46 @@ class BatchedEngine:
                    if self._decode_ready[s]
                    and self._slot_req[s] is not None
                    and self._slot_req[s].seq > req.seq]
+        victims = self._tenant_filter_victims(req, victims, self._slot_req)
         if victims:
             self._preempt_slot(
-                max(victims, key=lambda s: self._slot_req[s].seq))
+                self._pick_victim(victims, self._slot_req))
             return True
         pend = [s for s in list(self._pending)
                 if self._pending[s]["req"].seq > req.seq]
+        pend = self._tenant_filter_victims(
+            req, pend, {s: self._pending[s]["req"] for s in pend})
         if pend:
             self._unadmit_pending(
-                max(pend, key=lambda s: self._pending[s]["req"].seq))
+                self._pick_victim(
+                    pend, {s: self._pending[s]["req"] for s in pend}))
             return True
         return False
+
+    def _tenant_filter_victims(self, req: Request, slots, req_of):
+        """Tenancy guard on the victim pool: a BULK-tier requester may
+        never preempt a pinned-tier tenant's session — pinned tenants
+        paid for isolation from throughput traffic. No directory (or a
+        non-bulk requester) passes the pool through untouched, keeping
+        the tenancy-off preemption order byte-identical."""
+        if self.tenants is None:
+            return slots
+        if getattr(req, "tenant_tier", "standard") != "bulk":
+            return slots
+        return [s for s in slots
+                if getattr(req_of[s], "tenant_tier", "standard") != "pinned"]
+
+    def _pick_victim(self, slots, req_of):
+        """Which victim pays: tenancy off → youngest (the pre-tenancy
+        order, exactly). Tenancy on → lowest tier first (bulk gives way
+        before standard before pinned), youngest within the tier."""
+        if self.tenants is None:
+            return max(slots, key=lambda s: req_of[s].seq)
+        from datatunerx_tpu.tenancy.directory import TIER_RANK
+
+        return min(slots, key=lambda s: (
+            TIER_RANK.get(getattr(req_of[s], "tenant_tier", "standard"), 1),
+            -req_of[s].seq))
 
     def _install_growth(self, slot: int, new_blocks: List[int]):
         blocks = self._slot_blocks[slot]
@@ -3127,6 +3244,7 @@ class BatchedEngine:
         stop_ids: Optional[set] = None,
         adapter: str = "",
         trace_id: str = "",
+        tenant: str = "",
     ) -> Request:
         known = self.adapter_ids
         if adapter not in known:
@@ -3144,6 +3262,18 @@ class BatchedEngine:
                     < self._adapter_requests_cap):
                 self.adapter_requests[adapter] = \
                     self.adapter_requests.get(adapter, 0) + 1
+        # tenancy: resolve the request's tenant (explicit name wins, else
+        # the adapter maps through the directory); an unknown/absent tenant
+        # stays anonymous and schedules exactly like a pre-tenancy request
+        tenant_name, tier = "", "standard"
+        if self.tenants is not None:
+            spec = self.tenants.resolve(tenant=tenant, adapter=adapter)
+            if spec is not None:
+                tenant_name, tier = spec.name, spec.tier
+            self._tenant_count(tenant_name or (tenant or ""),
+                               "requests", 1)
+            self._tenant_count(tenant_name or (tenant or ""),
+                               "tokens_in", len(prompt_ids))
         stops = {int(s) for s in (stop_ids or set())}
         stops.add(int(self.tokenizer.eos_token_id))
         # every request gets a trace id (callers without one — bench, bare
@@ -3152,7 +3282,9 @@ class BatchedEngine:
         # InProcessReplica so one id follows the request end to end
         req = Request(prompt_ids, max_new_tokens, temperature, top_p, seed,
                       sorted(stops), idx, adapter_name=adapter,
-                      trace_id=trace_id or f"dtx-{uuid.uuid4().hex[:16]}")
+                      trace_id=trace_id or f"dtx-{uuid.uuid4().hex[:16]}",
+                      tenant=tenant_name or (tenant or ""),
+                      tenant_tier=tier)
         self._waiting.put(req)
         self._wake.set()
         return req
@@ -3245,23 +3377,25 @@ class BatchedEngine:
 
     def chat(self, messages: List[dict], max_new_tokens: int = 128,
              temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-             adapter: str = "", trace_id: str = "") -> str:
+             adapter: str = "", trace_id: str = "",
+             tenant: str = "") -> str:
         prompt_ids, stop_ids = self._encode_chat(messages)
         out = self.generate(prompt_ids, max_new_tokens=max_new_tokens,
                             temperature=temperature, top_p=top_p, seed=seed,
                             stop_ids=stop_ids, adapter=adapter,
-                            trace_id=trace_id)
+                            trace_id=trace_id, tenant=tenant)
         return self.tokenizer.decode(out, skip_special_tokens=True)
 
     def chat_stream(self, messages: List[dict], max_new_tokens: int = 128,
                     temperature: float = 0.0, top_p: float = 1.0,
-                    seed: int = 0, adapter: str = "", trace_id: str = ""):
+                    seed: int = 0, adapter: str = "", trace_id: str = "",
+                    tenant: str = ""):
         """Yields text deltas as tokens stream off the decode chunks."""
         prompt_ids, stop_ids = self._encode_chat(messages)
         req = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                           temperature=temperature, top_p=top_p, seed=seed,
                           stop_ids=stop_ids, adapter=adapter,
-                          trace_id=trace_id)
+                          trace_id=trace_id, tenant=tenant)
         sent = ""
         acc: List[int] = []
         while True:

@@ -94,7 +94,10 @@ class LocalServingBackend:
                         "prefill_chunk",
                         "prefill_token_budget", "adapter_pool",
                         "adapter_rank_max", "paged_kernel",
-                        "spec_draft_config", "spec_k", "spec_mode"):
+                        "spec_draft_config", "spec_k", "spec_mode",
+                        # multi-tenant QoS plane: both servers accept these
+                        # (the gateway forwards them to spawned replicas)
+                        "tenants_config", "host_adapter_cache_mb"):
                 if spec.get(key):
                     argv += [f"--{key}", str(spec[key])]
             from datatunerx_tpu.operator.backends import _pkg_root

@@ -306,6 +306,67 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
             s_exp.set(n, {"outcome": outcome})
         for outcome, n in sorted((sess_stats.get("import") or {}).items()):
             s_imp.set(n, {"outcome": outcome})
+    # multi-tenant QoS plane: per-tenant usage + the host-RAM adapter
+    # tier's load split. BOTH families are created only when their plane
+    # is configured — a tenancy-less engine's scrape must stay
+    # byte-identical (the PR 15/16 gating contract).
+    usage_fn = getattr(eng, "tenant_usage", None)
+    usage = usage_fn() if callable(usage_fn) else None
+    if usage is not None:
+        t_reqs = reg.counter("dtx_serving_tenant_requests_total",
+                             "Requests per tenant ('' = anonymous).")
+        t_toks = reg.counter("dtx_serving_tenant_tokens_total",
+                             "Tokens per tenant by direction (in = "
+                             "prompt, out = generated).")
+        t_blocks = reg.gauge("dtx_serving_tenant_kv_blocks",
+                             "Live paged KV blocks held by the tenant's "
+                             "in-flight sessions.")
+        t_res = reg.gauge("dtx_serving_tenant_adapters_resident",
+                          "The tenant's adapters currently resident in "
+                          "the pool.")
+        t_tier = reg.gauge("dtx_serving_tenant_tier",
+                           "Tenant tier, one-hot by label "
+                           "(pinned / standard / bulk).")
+        for m in (t_reqs, t_toks, t_blocks, t_res, t_tier):
+            m.clear()
+        for tname, row in sorted(usage.items()):
+            lbl = {"tenant": tname}
+            t_reqs.set(row.get("requests", 0), lbl)
+            t_toks.set(row.get("tokens_in", 0),
+                       {"tenant": tname, "direction": "in"})
+            t_toks.set(row.get("tokens_out", 0),
+                       {"tenant": tname, "direction": "out"})
+            if "kv_blocks" in row:
+                t_blocks.set(row["kv_blocks"], lbl)
+            if "adapters_resident" in row:
+                t_res.set(row["adapters_resident"], lbl)
+            if row.get("tier"):
+                t_tier.set(1, {"tenant": tname, "tier": row["tier"]})
+    host_fn = getattr(getattr(eng, "adapter_registry", None),
+                      "host_tier_stats", None)
+    host = host_fn() if callable(host_fn) else None
+    if host is not None:
+        h_hits = reg.counter("dtx_serving_adapter_host_hits_total",
+                             "Adapter loads served from the host-RAM "
+                             "tier (no orbax read).")
+        h_orbax = reg.counter("dtx_serving_adapter_orbax_loads_total",
+                              "Adapter loads that paid the orbax "
+                              "checkpoint read.")
+        h_evict = reg.counter("dtx_serving_adapter_host_evictions_total",
+                              "Host-tier entries evicted to fit newer "
+                              "weights under the byte budget.")
+        h_bytes = reg.gauge("dtx_serving_adapter_host_bytes",
+                            "Bytes of adapter weights cached in the "
+                            "host-RAM tier.")
+        h_entries = reg.gauge("dtx_serving_adapter_host_entries",
+                              "Adapters cached in the host-RAM tier.")
+        for m in (h_hits, h_orbax, h_evict, h_bytes, h_entries):
+            m.clear()
+        h_hits.set(host.get("host_hits", 0))
+        h_orbax.set(host.get("orbax_loads", 0))
+        h_evict.set(host.get("evictions", 0))
+        h_bytes.set(host.get("bytes", 0))
+        h_entries.set(host.get("entries", 0))
     # per-adapter demand: prefer the occupancy doc's LOCK-GUARDED copy
     # (dynamic engines); static engines snapshot under the engine's own
     # lock — copying the live dict bare would race a concurrent submit
@@ -753,6 +814,11 @@ class Handler(BaseHTTPRequestHandler):
             trace = self.headers.get("X-DTX-Trace-Id") or ""
             if trace and getattr(STATE.engine, "trace_store", None) is not None:
                 kwargs["trace_id"] = trace
+            # tenancy: hand the gateway's tenant name to engines running a
+            # directory (everyone else never sees the kwarg)
+            tenant = self.headers.get("X-DTX-Tenant") or ""
+            if tenant and getattr(STATE.engine, "tenants", None) is not None:
+                kwargs["tenant"] = tenant
             if req.get("stream"):
                 self._stream_chat(messages, kwargs,
                                   usage=self._prompt_usage(messages))
@@ -903,7 +969,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       prefill_chunk=256,
                       prefill_token_budget=0, paged_kernel="auto",
                       spec_draft=None, spec_k=4, spec_mode="auto",
-                      trace_ring=256, trace_log_path=None):
+                      trace_ring=256, trace_log_path=None,
+                      tenants_config=None, host_adapter_cache_mb=0.0):
     def _load():
         try:
             STATE.model_path = model_path
@@ -920,7 +987,10 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               # only "on" demands the batched paged engine;
                               # "off"/"auto" are no-ops everywhere else
                               ("--paged_kernel", paged_kernel == "on"),
-                              ("--spec_draft_config", spec_draft)):
+                              ("--spec_draft_config", spec_draft),
+                              ("--tenants_config", tenants_config),
+                              ("--host_adapter_cache_mb",
+                               host_adapter_cache_mb)):
                 if val and not batched:
                     raise ValueError(
                         f"{flag} requires the batched engine "
@@ -949,6 +1019,8 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     registry=STATE.registry,
                     trace_ring=trace_ring,
                     trace_log_path=trace_log_path or None,
+                    tenants=tenants_config or None,
+                    host_adapter_cache_mb=host_adapter_cache_mb or 0.0,
                 )
             else:
                 # single-slot path also carries serve-time quantization
@@ -1076,6 +1148,17 @@ def main(argv=None):
                         "for decode), decode = token production, mixed "
                         "(default) = role-less, routing byte-identical "
                         "to older fleets")
+    p.add_argument("--tenants_config", default="",
+                   help="multi-tenant QoS directory: a JSON file path or "
+                        "inline JSON object mapping tenant → {tier: "
+                        "pinned|standard|bulk, adapters: [...], share, "
+                        "kv_block_quota, ttft_p95_ms}. Empty (default) = "
+                        "tenancy plane off, scheduling byte-identical")
+    p.add_argument("--host_adapter_cache_mb", type=float, default=0.0,
+                   help="host-RAM adapter tier budget in MB: evicted "
+                        "adapters' host arrays stay cached so "
+                        "evict→reload skips the orbax read; 0 (default) "
+                        "= tier off")
     p.add_argument("--trace_ring", type=int, default=256,
                    help="completed request traces kept for "
                         "GET /debug/trace/<id>")
@@ -1120,7 +1203,9 @@ def main(argv=None):
                       spec_draft=args.spec_draft_config,
                       spec_k=args.spec_k, spec_mode=args.spec_mode,
                       trace_ring=args.trace_ring,
-                      trace_log_path=args.trace_log)
+                      trace_log_path=args.trace_log,
+                      tenants_config=args.tenants_config,
+                      host_adapter_cache_mb=args.host_adapter_cache_mb)
     srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"[serving] listening on :{args.port} (model loading async)", flush=True)
     try:

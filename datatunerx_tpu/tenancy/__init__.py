@@ -1,0 +1,33 @@
+"""Multi-tenant QoS plane: tenants as a first-class scheduling dimension.
+
+The serving fleet pools everything — one adapter cache (PR 10), one KV
+block pool (PR 15), one disaggregated fleet plane (PR 16) — but until this
+package every caller was one anonymous tenant. ``TenantDirectory`` maps a
+tenant to a tier (pinned / standard / bulk), its adapter set, a pool-share
+weight and a KV-block quota; ``HostAdapterTier`` is the bounded host-RAM
+LRU that turns evict→reload from an orbax read into a host→device copy.
+
+Gating contract (the PR 15/16 pattern): with no tenant config, nothing
+here is constructed and the gateway, engine and both /metrics expositions
+stay byte-identical to a tenancy-less build.
+"""
+
+from datatunerx_tpu.tenancy.directory import (
+    TIERS,
+    TenantDirectory,
+    TenantSpec,
+    load_tenants,
+    tenant_entry_from_crd,
+    validate_tenant_entry,
+)
+from datatunerx_tpu.tenancy.host_tier import HostAdapterTier
+
+__all__ = [
+    "TIERS",
+    "TenantDirectory",
+    "TenantSpec",
+    "HostAdapterTier",
+    "load_tenants",
+    "tenant_entry_from_crd",
+    "validate_tenant_entry",
+]

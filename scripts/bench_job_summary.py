@@ -134,6 +134,24 @@ def main() -> int:
                     f"errors={ov.get('errors')}")
             if cap.get("parity_checked"):
                 row += " · overcommit-vs-eager parity: checked"
+        # multi-tenant QoS twin: the pinned tenant's p95 on/off plus the
+        # host adapter tier's hit split — the isolation and the zero-orbax
+        # reload story in one row
+        tn = last.get("tenant")
+        if isinstance(tn, dict):
+            on_t = tn.get("qos_on") or {}
+            off_t = tn.get("qos_off") or {}
+            host = on_t.get("host_tier") or {}
+            row += ("\n  - tenant: pinned p95 "
+                    f"{on_t.get('plat_ttft_ms_p95')}ms qos-on vs "
+                    f"{off_t.get('plat_ttft_ms_p95')}ms off "
+                    f"(source={tn.get('p95_source')}) · "
+                    f"host tier hit_rate={tn.get('host_hit_rate')} "
+                    f"(host_hits={host.get('host_hits')} "
+                    f"orbax_loads={host.get('orbax_loads')}) · "
+                    f"pinned resident at end: "
+                    f"on={on_t.get('pinned_resident_at_end')} "
+                    f"off={off_t.get('pinned_resident_at_end')}")
         # load-replay mode: the SLO verdict IS the headline — a chaos run
         # whose objectives held, or the violated objectives by name
         rp = last.get("replay")

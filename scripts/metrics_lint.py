@@ -109,6 +109,24 @@ class _StatsEngine:
     resident_adapters = {"tenant-a": 1}
     adapter_requests = {"": 3, "tenant-a": 2, "tenant-b": 1}
 
+    # multi-tenant QoS plane: tenant_usage() turns the dtx_serving_tenant_*
+    # families on, and the registry stub's host_tier_stats() builds every
+    # dtx_serving_adapter_host_* / orbax-load series — both absent at
+    # defaults by design, so the lint must opt in here to cover them
+    class _HostTierRegistry:
+        @staticmethod
+        def host_tier_stats():
+            return {"host_hits": 2, "orbax_loads": 1, "evictions": 1,
+                    "bytes": 1 << 16, "entries": 1}
+
+    adapter_registry = _HostTierRegistry()
+
+    def tenant_usage(self):
+        return {"acme": {"requests": 3, "tokens_in": 120, "tokens_out": 40,
+                         "kv_blocks": 6, "adapters_resident": 1,
+                         "tier": "pinned"},
+                "": {"requests": 1, "tokens_in": 10, "tokens_out": 4}}
+
     def adapter_occupancy(self):
         return {"slots": 4, "free": 3, "resident": 1, "pinned": 0,
                 "rank_max": 8, "targets": ["q_proj", "v_proj"],
@@ -144,10 +162,14 @@ def gateway_exposition() -> str:
     pool = ReplicaPool([InProcessReplica("r0", _StatsEngine())])
     # fleet plane ON so the dtx_fleet_* series (prefix tier, handoff and
     # spill outcome counters) and the role-routing series are built and
-    # linted — at defaults they are absent by design
+    # linted — at defaults they are absent by design; the tenant directory
+    # likewise turns the dtx_gateway_tenant_* + prefetch families on
     gw = Gateway(pool, model_name="preset:lint", prefill_threshold=8,
                  fleet_prefix_bytes=1 << 20, fleet_handoff=True,
-                 fleet_spill=True)
+                 fleet_spill=True,
+                 tenants={"acme": {"tier": "pinned",
+                                   "adapters": ["tenant-a"],
+                                   "share": 2.0, "ttft_p95_ms": 750.0}})
     try:
         # drive one request so the labeled counters and the queue-wait
         # histogram expose real series, not just TYPE lines — and one
@@ -156,7 +178,8 @@ def gateway_exposition() -> str:
         gw.chat({"messages": [{"role": "user", "content": "hi"}]},
                 trace_id="lint-trace")
         gw.chat({"messages": [{"role": "user", "content": "hi"}],
-                 "model": "tenant-a"}, trace_id="lint-trace-adapter")
+                 "model": "tenant-a"}, trace_id="lint-trace-adapter",
+                tenant="acme")
         gw.record_request(200)
         return gw.metrics_text()
     finally:

@@ -1,0 +1,598 @@
+"""Multi-tenant QoS plane (datatunerx_tpu/tenancy/ + gateway admission +
+engine preemption + adapter pin/host tiers): tenants are a scheduling
+dimension, not a label. This file covers the directory round-trip and the
+webhook's rejects, pin-tier eviction immunity and the host-RAM adapter
+tier (including the _entry_bytes dict-shape regression), the weighted-
+fair admission math and the quota 429 naming its tenant, prefetch-on-
+route firing before admission (trace-asserted), the per-tenant metric
+families passing the metrics lint, and the gating contract: with no
+tenant config every plane behaves byte-identically to a pre-tenancy
+build — eviction order, preemption order, and exposition families."""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from datatunerx_tpu.tenancy import HostAdapterTier, load_tenants
+from datatunerx_tpu.tenancy.directory import (
+    TIER_RANK,
+    TenantDirectory,
+    TenantSpec,
+    tenant_entry_from_crd,
+    validate_tenant_entry,
+)
+from datatunerx_tpu.tenancy.host_tier import _entry_bytes
+
+MODEL = "preset:debug"
+
+
+def _metrics_lint():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "metrics_lint.py")
+    spec = importlib.util.spec_from_file_location("metrics_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ directory
+
+def test_directory_roundtrip_and_resolution(tmp_path):
+    cfg = {"plat": {"tier": "pinned", "adapters": ["plat-a"], "share": 4,
+                    "ttft_p95_ms": 500},
+           "batch": {"tier": "bulk", "share": 1, "kv_block_quota": 24}}
+    for source in (cfg, json.dumps(cfg), json.dumps({"tenants": cfg})):
+        d = load_tenants(source)
+        assert isinstance(d, TenantDirectory)
+        assert sorted(d.names()) == ["batch", "plat"]
+    # file path source (the --tenants_config flag's shape)
+    p = tmp_path / "tenants.json"
+    p.write_text(json.dumps(cfg))
+    d = load_tenants(str(p))
+    assert d.get("plat").tier == "pinned"
+    assert d.get("plat").share == 4.0
+    assert d.get("batch").kv_block_quota == 24
+    # spec round-trip through to_dict/from_dict is lossless
+    spec = d.get("plat")
+    assert (TenantSpec.from_dict("plat", spec.to_dict()).to_dict()
+            == spec.to_dict())
+    assert load_tenants(d) is d  # already-built directory passes through
+
+    # resolution precedence: explicit name > adapter mapping > anonymous
+    assert d.resolve(tenant="batch", adapter="plat-a").name == "batch"
+    assert d.resolve(adapter="plat-a").name == "plat"
+    assert d.resolve(tenant="ghost", adapter="plat-a").name == "plat"
+    assert d.resolve(tenant="ghost") is None
+    assert d.resolve() is None
+
+    assert d.pinned_adapters() == {"plat-a"}
+    assert d.shares() == {"plat": 4.0, "batch": 1.0}
+
+    # upsert/remove bump the generation (the pin-refresh trigger)
+    g0 = d.generation
+    d.upsert("batch", {"tier": "standard", "share": 2})
+    assert d.generation > g0 and d.get("batch").tier == "standard"
+    assert d.remove("batch") and not d.remove("batch")
+    assert d.get("batch") is None
+
+    # falsy config = plane off, not an empty directory
+    assert load_tenants(None) is None
+    assert load_tenants("") is None
+
+
+def test_tenant_entry_validation_and_crd_keys():
+    validate_tenant_entry("t", {"tier": "bulk", "adapters": ["a"],
+                                "share": 2, "kv_block_quota": 8,
+                                "ttft_p95_ms": 300})
+    with pytest.raises(ValueError, match="tier"):
+        validate_tenant_entry("t", {"tier": "gold"})
+    with pytest.raises(ValueError, match="adapters"):
+        validate_tenant_entry("t", {"adapters": "not-a-list"})
+    with pytest.raises(ValueError, match="adapters"):
+        validate_tenant_entry("t", {"adapters": [""]})
+    with pytest.raises(ValueError, match="share"):
+        validate_tenant_entry("t", {"share": 0})
+    with pytest.raises(ValueError, match="kv_block_quota"):
+        validate_tenant_entry("t", {"kv_block_quota": -1})
+    with pytest.raises(ValueError, match="ttft_p95_ms"):
+        validate_tenant_entry("t", {"ttft_p95_ms": -5})
+    # CRD camelCase keys map onto the python entry shape
+    entry = tenant_entry_from_crd({"tier": "pinned", "kvBlockQuota": 8,
+                                   "ttftP95Ms": 250})
+    assert entry["kv_block_quota"] == 8 and entry["ttft_p95_ms"] == 250
+    validate_tenant_entry("t", entry)
+
+
+def test_webhook_rejects_bad_tenant_config():
+    from datatunerx_tpu.operator.webhooks import (
+        AdmissionError,
+        _validate_serve_config,
+    )
+
+    _validate_serve_config({"tenants": {"plat": {"tier": "pinned",
+                                                 "kvBlockQuota": 8}}})
+    _validate_serve_config({"hostAdapterCacheMb": 64})
+    with pytest.raises(AdmissionError, match="serveConfig.tenants"):
+        _validate_serve_config({"tenants": {"p": {"tier": "gold"}}})
+    with pytest.raises(AdmissionError, match="non-empty"):
+        _validate_serve_config({"tenants": {}})
+    with pytest.raises(AdmissionError, match="mutually"):
+        _validate_serve_config({"tenants": {"p": {"tier": "bulk"}},
+                                "tenantsConfig": "/etc/tenants.json"})
+    with pytest.raises(AdmissionError, match="hostAdapterCacheMb"):
+        _validate_serve_config({"hostAdapterCacheMb": -1})
+
+
+# ------------------------------------------------------------ host tier
+
+def test_host_tier_entry_bytes_dict_shape_regression():
+    """The registry loader hands the tier its {target: {"a": arr, "b":
+    arr}} layer tree — a flat-iteration sizing saw nested dicts as
+    0-byte objects and refused every put. The walk must recurse."""
+    arr = np.zeros((4, 8), np.float32)
+    assert _entry_bytes({"q_proj": {"a": arr, "b": arr},
+                         "v_proj": {"a": arr, "b": arr}}) == 4 * arr.nbytes
+    assert _entry_bytes([arr, (arr, arr)]) == 3 * arr.nbytes
+    assert _entry_bytes({"q": [{"a": arr}]}) == arr.nbytes
+    assert _entry_bytes({}) == 0
+    # ...and therefore a real-shaped entry is accepted by put()
+    tier = HostAdapterTier(max_bytes=8 * arr.nbytes)
+    assert tier.put("t", "ck:t", {"q_proj": {"a": arr, "b": arr}}, 2.0)
+    assert tier.stats()["bytes"] == 2 * arr.nbytes
+
+
+def test_host_tier_lru_bounds_and_drop():
+    arr = np.ones((16, 16), np.float32)  # 1 KiB
+    one = arr.nbytes
+    tier = HostAdapterTier(max_bytes=int(2.5 * one))
+    assert tier.put("a", "ck:a", {"q": {"a": arr}}, 1.0)
+    assert tier.put("b", "ck:b", {"q": {"a": arr}}, 1.0)
+    assert tier.get("a", "ck:a") is not None  # refresh: b is now coldest
+    assert tier.put("c", "ck:c", {"q": {"a": arr}}, 1.0)  # evicts b
+    assert tier.get("b", "ck:b") is None
+    assert tier.get("a", "ck:a") is not None
+    s = tier.stats()
+    assert s["evictions"] == 1 and s["entries"] == 2
+    assert s["bytes"] <= s["max_bytes"]
+    # an entry bigger than the whole budget is refused, not thrashed in
+    big = np.ones((64, 16), np.float32)
+    assert not tier.put("big", "ck:big", {"q": {"a": big}}, 1.0)
+    # keyed by (name, checkpoint): a rebind can't serve stale weights
+    assert tier.get("a", "ck:other") is None
+    assert tier.drop("a") == 1 and tier.get("a", "ck:a") is None
+
+
+def test_registry_pin_immunity_and_host_tier_reload():
+    """Pinned-tier adapters never LRU-evict, and an evicted standard
+    adapter reloads from the host tier with zero checkpoint reads."""
+    from datatunerx_tpu.adapters import AdapterRegistry, AdapterStore
+    from datatunerx_tpu.models import get_config
+    from datatunerx_tpu.models.lora import target_dims
+
+    cfg = get_config("debug")
+    store = AdapterStore(cfg, pool_slots=2, rank_max=8)
+    loads = []
+
+    def loader(path):
+        name = path.split(":", 1)[1]
+        loads.append(name)
+        out = {}
+        for t in ("q_proj", "v_proj"):
+            d_in, d_out = target_dims(cfg, t)
+            out[t] = {"a": np.full((cfg.num_layers, d_in, 2), 0.5,
+                                   np.float32),
+                      "b": np.full((cfg.num_layers, 2, d_out), 0.5,
+                                   np.float32)}
+        return {"lora": {"layers": out}, "_scaling": 4.0}
+
+    tier = HostAdapterTier(max_bytes=64 << 20)
+    reg = AdapterRegistry(store, loader=loader, host_tier=tier)
+    for n in ("p", "a", "b"):
+        reg.register(n, f"ck:{n}")
+    reg.set_pinned({"p"})
+    assert reg.acquire("p", wait=True) is not None
+    reg.release("p")
+    assert reg.acquire("a", wait=True) is not None
+    reg.release("a")
+    # pool full; p is the LRU-coldest but PINNED → a is the victim
+    assert reg.acquire("b", wait=True) is not None
+    reg.release("b")
+    res = reg.resident()
+    assert "p" in res and "a" not in res, res
+    # evict→reload of a: served from the host tier, no second orbax read
+    assert reg.acquire("a", wait=True) is not None
+    reg.release("a")
+    assert loads == ["p", "a", "b"]  # a loaded from checkpoint ONCE
+    assert reg.host_hits == 1 and reg.orbax_loads == 3
+    hs = reg.host_tier_stats()
+    assert hs["host_hits"] == 1 and hs["entries"] >= 1
+    # every slot pinned → preload reports exhaustion instead of hanging
+    reg.set_pinned({"p", "a"})
+    assert "a" in reg.resident() and "p" in reg.resident()
+    with pytest.raises(RuntimeError, match="exhausted"):
+        reg.preload("b")
+    # unregister purges host-tier copies: a deleted adapter can't resurrect
+    reg.set_pinned({"p"})
+    reg.unregister("a")
+    assert tier.get("a", "ck:a") is None
+
+
+# ------------------------------------------------------------- admission
+
+def test_weighted_fair_admission_math():
+    from datatunerx_tpu.gateway.admission import (
+        AdmissionController,
+        Overloaded,
+    )
+
+    ac = AdmissionController(max_queue=16, token_budget=100)
+    small = {"name": "small", "share": 1.0, "share_total": 4.0,
+             "kv_block_quota": 0}
+    big = {"name": "big", "share": 3.0, "share_total": 4.0,
+           "kv_block_quota": 0}
+    msgs = [{"role": "user", "content": "x"}]
+    # below the 80% contention watermark any tenant bursts past its share
+    # (work-conserving): 50 > cap of 25 but the pool is idle
+    t1 = ac.try_admit(msgs, tokens=50, tenant=small)
+    # contended now (50+40 > 80): small's cap is 100*1/4 = 25 → shed,
+    # and the message names the tenant, the math, and the shares
+    with pytest.raises(Overloaded, match=r"tenant small over fair share "
+                                         r"\(50\+40>25 tokens, "
+                                         r"share 1/4\)"):
+        ac.try_admit(msgs, tokens=40, tenant=small)
+    # the HIGH-share tenant still fits under ITS cap (75) while contended
+    t2 = ac.try_admit(msgs, tokens=40, tenant=big)
+    usage = ac.tenant_usage()
+    assert usage["tokens"] == {"small": 50, "big": 40}
+    assert usage["blocks"]["small"] > 0  # admits are always block-priced
+    t2.release()
+    t1.release()
+    # zeroed reservations are pruned — no dead series linger
+    assert ac.tenant_usage()["tokens"] == {}
+    # anonymous traffic is never share-gated (the pre-tenancy path)
+    with ac.try_admit(msgs, tokens=90):
+        assert ac.tenant_usage()["tokens"] == {}
+
+
+def test_kv_block_quota_shed_names_tenant():
+    from datatunerx_tpu.gateway.admission import (
+        AdmissionController,
+        Overloaded,
+    )
+
+    ac = AdmissionController(max_queue=16, token_budget=4096)
+    msgs = [{"role": "user", "content": "q"}]
+    # blocks_for_admit(16, 16) = ceil((16 + 64 headroom)/16) = 5
+    bulk = {"name": "bulkco", "share": 1.0, "share_total": 1.0,
+            "kv_block_quota": 9}
+    t1 = ac.try_admit(msgs, tokens=16, tenant=bulk)
+    assert ac.tenant_usage()["blocks"]["bulkco"] == 5
+    with pytest.raises(Overloaded) as ei:
+        ac.try_admit(msgs, tokens=16, tenant=bulk)
+    assert "tenant bulkco KV block quota exhausted" in str(ei.value)
+    assert "(5+5>9 blocks)" in str(ei.value)
+    # releasing the first reservation re-opens the quota
+    t1.release()
+    with ac.try_admit(msgs, tokens=16, tenant=bulk):
+        pass
+    # quota 0 = unlimited
+    free = {"name": "free", "share": 1.0, "share_total": 1.0,
+            "kv_block_quota": 0}
+    for _ in range(4):
+        ac.try_admit(msgs, tokens=16, tenant=free)
+
+
+# ------------------------------------------- engine preemption + parity
+
+def test_tier_aware_preemption_token_exact(tmp_path):
+    """The isolation contract end to end on a starved pool: a pinned
+    tenant's session — deliberately the YOUNGEST, i.e. exactly the
+    session the pre-tenancy youngest-first policy kills first — survives
+    a bulk preemption storm un-preempted, bulk sessions preempted under
+    pressure resume TOKEN-EXACTLY (the PR 15 park/resume fabric), and
+    the tenancy-off control engine preempts that same youngest session,
+    proving the tier filter (not luck) is what saved it."""
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    tenants = {"plat": {"tier": "pinned", "share": 4},
+               "batch": {"tier": "bulk", "share": 1}}
+    # admission reserves blocks for the BUCKET-padded prompt (64) plus
+    # one tick's advance → 5 blocks of 16 per session: four sessions on
+    # a 20-block pool admit concurrently with ZERO free blocks. The
+    # 60-token bulk prompts outgrow their reservation within ~5 decode
+    # ticks, so reclaim fires while the pinned session (~14 ticks of
+    # life, never growing) is mid-decode — deterministically.
+    ref = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=4, decode_chunk=4, kv_block_size=16)
+    engines = {
+        "qos": BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                             slots=4, decode_chunk=4, kv_block_size=16,
+                             kv_blocks=20, kv_overcommit="on",
+                             tenants=tenants),
+        "control": BatchedEngine(MODEL, template="vanilla",
+                                 max_seq_len=256, slots=4, decode_chunk=4,
+                                 kv_block_size=16, kv_blocks=20,
+                                 kv_overcommit="on"),
+    }
+    try:
+        # pairwise-distinct prompts: a shared prefix would admit later
+        # sessions through the prefix-cache/COW path with a SMALLER
+        # reservation, collapsing the geometry this test is built on
+        bulk_prompts = [
+            list(ref.tokenizer.encode(f"storm lane {i} bulk probe " * 15)[:60])
+            for i in range(3)]
+        assert all(len(p) == 60 for p in bulk_prompts)
+        # all four sessions decode in lock-step from the padded cursor
+        # (64), so block demand crosses the 5-block reservation for
+        # EVERYONE at the same tick. At that tick the oldest bulk's
+        # reclaim fires: tenancy-off picks the youngest victim (the
+        # pin); tenancy-on filters pinned out and a bulk pays instead,
+        # and the pin's own one-block growth succeeds from the freed
+        # pool. max_new=28 keeps the pin alive at that tick (>16) but
+        # finished before the SECOND contention tick (≤32), where a
+        # youngest-with-no-younger-victims session must self-preempt
+        pin_prompt = list(ref.tokenizer.encode("pinned latency probe " * 5)[:17])
+        assert len(pin_prompt) == 17
+        kws = [{}, {"temperature": 0.8, "top_p": 0.9, "seed": 3}, {}]
+        want_bulk = [ref.generate(p, max_new_tokens=80, **kw)
+                     for p, kw in zip(bulk_prompts, kws)]
+        want_pin = ref.generate(pin_prompt, max_new_tokens=28)
+        for mode, eng in engines.items():
+            reqs = [eng.submit(p, max_new_tokens=80, tenant="batch", **kw)
+                    for p, kw in zip(bulk_prompts, kws)]
+            pin = eng.submit(pin_prompt, max_new_tokens=28, tenant="plat")
+            for i, r in enumerate(reqs):
+                assert r.done.wait(300), f"{mode}: bulk {i} stalled"
+                assert r.error is None, (mode, i, r.error)
+                assert r.tokens == want_bulk[i], \
+                    f"{mode}: bulk {i} diverged after preempt/resume"
+            assert pin.done.wait(300) and pin.error is None
+            assert pin.tokens == want_pin, f"{mode}: pinned diverged"
+            preempted = {e[2] for e in eng.sched_trace
+                         if e[0] in ("preempt", "preempt_prefill")}
+            assert preempted, f"{mode}: pool never contended — vacuous"
+            if mode == "qos":
+                # the storm never touched the pinned tenant...
+                assert pin.seq not in preempted, \
+                    "bulk requester preempted a pinned tenant"
+                # ...and it DID park bulk sessions that resumed exactly
+                assert preempted & {r.seq for r in reqs}
+                usage = eng.tenant_usage()
+                assert usage["plat"]["requests"] == 1
+                assert usage["plat"]["tier"] == "pinned"
+                assert usage["batch"]["requests"] == 3
+            else:
+                # tenancy off: the same youngest session is the victim —
+                # the pre-tenancy order, byte-identical
+                assert pin.seq in preempted, \
+                    "control engine spared the youngest (test is vacuous)"
+                assert eng.tenant_usage() is None
+    finally:
+        ref.close()
+        for eng in engines.values():
+            eng.close()
+
+
+# ---------------------------------------------------------- gateway e2e
+
+def test_gateway_prefetch_quota_and_tenant_metrics(tmp_path):
+    """Prefetch-on-route fires BEFORE admission completes (trace-event
+    order), the quota 429 names the tenant on the gateway path, and both
+    planes' dtx_*_tenant_* families render and pass the metrics lint."""
+    from datatunerx_tpu.gateway.admission import Overloaded
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.serving import server as serving
+    from datatunerx_tpu.serving.adapters import make_adapter_checkpoint
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    ck = make_adapter_checkpoint(str(tmp_path / "t"), MODEL, seed=7, rank=4)
+    tenants = {"acme": {"tier": "pinned", "adapters": ["t-a"], "share": 3},
+               "bulkco": {"tier": "bulk", "share": 1, "kv_block_quota": 1}}
+    eng = BatchedEngine(MODEL, adapters={"t-a": ck}, adapter_pool=2,
+                        template="vanilla", max_seq_len=256, slots=2,
+                        decode_chunk=4, kv_block_size=16, tenants=tenants,
+                        host_adapter_cache_mb=64)
+    pool = ReplicaPool([InProcessReplica("r0", eng)])
+    gw = Gateway(pool, model_name=MODEL, tenants=tenants)
+    try:
+        # adapter registered but not resident → the route prefetches, and
+        # the trace shows the prefetch event BEFORE the admission event
+        req = {"messages": [{"role": "user", "content": "hello acme"}],
+               "model": "t-a", "max_tokens": 4}
+        # "" is a legal completion (the tiny debug model can sample EOS
+        # first); only None would mean the request failed
+        assert gw.chat(dict(req), trace_id="dtx-tn-1") is not None
+        doc = gw.trace("dtx-tn-1")
+        root = next(sp for sp in doc["spans"]
+                    if sp["name"] == "gateway.request")
+        names = [e.get("name") for e in (root.get("events") or [])]
+        assert "adapter_prefetch" in names and "admitted" in names
+        assert names.index("adapter_prefetch") < names.index("admitted"), \
+            f"prefetch did not precede admission: {names}"
+        assert root["attrs"]["tenant"] == "acme"
+
+        # the weighted-fair pricing row divides by the directory Σshares
+        row = gw._admission_tenant(gw.tenants.get("acme"))
+        assert row == {"name": "acme", "share": 3.0, "share_total": 4.0,
+                       "kv_block_quota": 0}
+
+        # quota 429 on the gateway path names the tenant and the quota
+        with pytest.raises(Overloaded, match="tenant bulkco KV block "
+                                             "quota exhausted"):
+            gw.chat({"messages": [{"role": "user", "content": "flood"}],
+                     "max_tokens": 4}, tenant="bulkco")
+
+        # gateway exposition: per-tenant families present + lint-clean
+        lint = _metrics_lint()
+        gw_text = gw.metrics_text()
+        assert "dtx_gateway_tenant_requests_total" in gw_text
+        assert 'tenant="acme"' in gw_text
+        assert "dtx_gateway_tenant_share" in gw_text
+        assert lint.lint_exposition(gw_text, "gateway") == []
+
+        # serving exposition: usage + host-tier families + lint-clean.
+        # A FRESH ServingState: the module-global registry accretes
+        # families across tests, and this render must reflect only this
+        # engine's planes
+        old_state = serving.STATE
+        serving.STATE = serving.ServingState()
+        serving.STATE.engine, serving.STATE.model_path = eng, MODEL
+        try:
+            sv_text = serving.metrics_text()
+        finally:
+            serving.STATE = old_state
+        assert "dtx_serving_tenant_requests_total" in sv_text
+        assert "dtx_serving_tenant_tier" in sv_text
+        assert 'tenant="acme"' in sv_text
+        assert lint.lint_exposition(sv_text, "serving") == []
+    finally:
+        gw.close()
+
+
+def test_admin_tenants_http_contract(tmp_path):
+    """GET/POST /admin/tenants over a real loopback server: read the
+    directory, upsert with validation, remove, and 404 when off."""
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway, make_handler
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    def _req(url, method="GET", body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        rq = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(rq, timeout=60) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16)
+    gw = Gateway(ReplicaPool([InProcessReplica("r0", eng)]),
+                 model_name=MODEL,
+                 tenants={"plat": {"tier": "pinned", "share": 2}})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(gw))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        code, doc = _req(url + "/admin/tenants")
+        assert code == 200 and doc["tenants"]["plat"]["tier"] == "pinned"
+        gen0 = doc["generation"]
+        # upsert a tenant; the generation advances (pin-refresh signal)
+        code, doc = _req(url + "/admin/tenants", "POST",
+                         {"name": "batch", "tier": "bulk", "share": 1,
+                          "kv_block_quota": 16})
+        assert code == 200 and doc["generation"] > gen0
+        assert doc["tenants"]["batch"]["kv_block_quota"] == 16
+        # validation errors surface as 400 naming the field
+        code, doc = _req(url + "/admin/tenants", "POST",
+                         {"name": "bad", "tier": "gold"})
+        assert code == 400 and "tier" in doc["error"]
+        # remove round-trips; unknown removals 404
+        code, doc = _req(url + "/admin/tenants", "POST",
+                         {"name": "batch", "remove": True})
+        assert code == 200 and "batch" not in doc["tenants"]
+        code, _ = _req(url + "/admin/tenants", "POST",
+                       {"name": "batch", "remove": True})
+        assert code == 404
+    finally:
+        srv.shutdown()
+        gw.close()
+
+    # tenancy off → the surface says so rather than faking an empty plane
+    eng2 = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                         slots=2, decode_chunk=4, kv_block_size=16)
+    gw2 = Gateway(ReplicaPool([InProcessReplica("r0", eng2)]),
+                  model_name=MODEL)
+    srv2 = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(gw2))
+    threading.Thread(target=srv2.serve_forever, daemon=True).start()
+    url2 = f"http://127.0.0.1:{srv2.server_address[1]}"
+    try:
+        code, doc = _req(url2 + "/admin/tenants")
+        assert code == 404 and "not enabled" in doc["error"]
+        code, _ = _req(url2 + "/admin/tenants", "POST",
+                       {"name": "x", "tier": "bulk"})
+        assert code == 404
+    finally:
+        srv2.shutdown()
+        gw2.close()
+
+
+# ---------------------------------------------------- no-config identity
+
+def test_no_tenant_config_byte_identity():
+    """The gating contract: with NO tenant config, every tenancy hook is
+    inert — a tenant header changes nothing (not the tokens, not the
+    victim order, not a single exposition family)."""
+    from datatunerx_tpu.gateway.replica_pool import (
+        InProcessReplica,
+        ReplicaPool,
+    )
+    from datatunerx_tpu.gateway.server import Gateway
+    from datatunerx_tpu.serving import server as serving
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16)
+    gw = Gateway(ReplicaPool([InProcessReplica("r0", eng)]),
+                 model_name=MODEL)
+    try:
+        assert eng.tenants is None and gw.tenants is None
+        assert eng.tenant_usage() is None
+        prompt = eng.tokenizer.encode("identity probe")
+        plain = eng.generate(prompt, max_new_tokens=8)
+        assert eng.generate(prompt, max_new_tokens=8,
+                            tenant="ghost") == plain
+        # victim selection is the pre-tenancy order, exactly: the filter
+        # passes victims through untouched and the pick is youngest-first
+        class _R:
+            def __init__(self, seq, tier):
+                self.seq, self.tenant_tier = seq, tier
+
+        req_of = {0: _R(5, "bulk"), 1: _R(9, "pinned"), 2: _R(7, "bulk")}
+        assert eng._tenant_filter_victims(_R(1, "bulk"), [0, 1, 2],
+                                          req_of) == [0, 1, 2]
+        assert eng._pick_victim([0, 1, 2], req_of) == 1  # youngest wins
+        # a tenant header through the gateway is inert, never a 4xx
+        # ("" is a legal completion for the tiny debug model)
+        assert gw.chat({"messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4}, tenant="ghost") is not None
+        assert gw.admission.tenant_usage() == {"tokens": {}, "blocks": {}}
+        # neither plane grows a tenant family without config (fresh
+        # ServingState: the module registry is sticky across tests)
+        assert "dtx_gateway_tenant_" not in gw.metrics_text()
+        old_state = serving.STATE
+        serving.STATE = serving.ServingState()
+        serving.STATE.engine, serving.STATE.model_path = eng, MODEL
+        try:
+            sv_text = serving.metrics_text()
+        finally:
+            serving.STATE = old_state
+        assert "dtx_serving_tenant_" not in sv_text
+        assert "dtx_serving_adapter_host_" not in sv_text
+    finally:
+        gw.close()
+
+
+def test_tier_rank_order_is_the_scheduling_contract():
+    """TIER_RANK is load-bearing in _pick_victim: bulk must give way
+    before standard before pinned, and every directory tier has a rank."""
+    assert TIER_RANK["bulk"] < TIER_RANK["standard"] < TIER_RANK["pinned"]
+    from datatunerx_tpu.tenancy.directory import TIERS
+
+    assert set(TIERS) == set(TIER_RANK)
