@@ -548,11 +548,18 @@ def child_serve_spec(preflight=None):
       demonstrably DISABLES speculation (plain pending-form fallback) so
       TPOT cannot regress vs spec-off.
 
-    Before the clock starts, the spec-on engine's greedy outputs are
+    A TREE sub-run rides along (``--spec_tree WxD`` at depth D == chain k,
+    so the draft cost is identical): a contested mediocre-draft regime
+    compares tree vs chain accept-length p50 (the tree must not lose — its
+    branch 0 IS the chain path) and TPOT ratios, and an adversarial tree
+    run asserts the controller stands tree speculation down too.
+
+    Before the clock starts, every spec-on engine's greedy outputs are
     asserted token-identical to the spec-off twin (the PR 13 kernel-gate
     pattern): a fast-but-wrong number must be unreportable. The JSON line
-    carries ``spec_mode``/``spec_draft``/``decode_path`` provenance next to
-    ``platform``/``cpu_fallback``. CPU numbers are smoke-only.
+    carries ``spec_mode``/``spec_draft``/``spec_tree``/``decode_path``
+    provenance next to ``platform``/``cpu_fallback``. CPU numbers are
+    smoke-only.
     """
     import dataclasses
 
@@ -581,14 +588,15 @@ def child_serve_spec(preflight=None):
         decode_chunk=int(os.environ.get("DTX_BENCH_DECODE_CHUNK", "8")),
         kv_block_size=block)
 
-    def align_params(params):
+    def align_params(params, alpha):
         """Scale post-draft layers' OUTPUT projections toward zero: the
         residual stream passes through them near-unchanged, so take:N
         approximates the full target while the target still pays every
         layer's compute. Layers < take are untouched, so the draft (sliced
         at engine construction) stays numerically identical to the
-        target's early layers."""
-        alpha = 1e-3
+        target's early layers. alpha sets how faithful the draft is:
+        1e-3 ~ trained-draft regime, ~0.3 a mediocre draft whose chain
+        proposals diverge early (the regime tree drafts exist for)."""
         layers_t = dict(params["layers"])
         for name in ("o_proj", "down_proj"):
             sub = dict(layers_t[name])
@@ -647,14 +655,21 @@ def child_serve_spec(preflight=None):
             "tpot_ms_p95": round(pct(tpots, 0.95) * 1e3, 2),
         }
 
-    def run_pair(aligned):
+    def run_pair(alpha, tree=None, mode="auto"):
+        from datatunerx_tpu.obs.metrics import (
+            Registry,
+            spec_accept_len_histogram,
+        )
+
+        reg = Registry()
         off = BatchedEngine("preset:bench-spec", **engine_kw)
         on = BatchedEngine("preset:bench-spec", spec_draft=f"take:{take}",
-                           spec_k=k, spec_mode="auto", **engine_kw)
+                           spec_k=k, spec_mode=mode, spec_tree=tree,
+                           registry=reg, **engine_kw)
         try:
-            if aligned:
-                off.params = align_params(off.params)
-                on.params = align_params(on.params)
+            if alpha is not None:
+                off.params = align_params(off.params, alpha)
+                on.params = align_params(on.params, alpha)
             tok = off.tokenizer
             probes = [tok.encode("a quick question about the weather today"),
                       tok.encode("tell me something entirely different")]
@@ -672,6 +687,7 @@ def child_serve_spec(preflight=None):
             proposed = info.get("proposed", 0)
             accepted = info.get("accepted", 0)
             row_steps = info.get("row_steps", 0)
+            h_len = spec_accept_len_histogram(reg)
             out = {
                 "parity_checked": True,
                 "accept_rate": (round(accepted / proposed, 3)
@@ -681,6 +697,10 @@ def child_serve_spec(preflight=None):
                 # ACTUAL per-step k, so accepted*k/proposed would inflate)
                 "mean_accept_len": (round(accepted / row_steps, 2)
                                     if row_steps else None),
+                # per-row accepted-length p50 from the same histogram the
+                # server exports — the tree-vs-chain comparison statistic
+                "accept_len_p50": (round(h_len.percentile(0.5), 2)
+                                   if h_len.count else None),
                 "spec_steps": info.get("spec_steps", 0),
                 "plain_steps": info.get("plain_steps", 0),
                 "controller_active": bool(info.get("active")),
@@ -690,13 +710,16 @@ def child_serve_spec(preflight=None):
                     round(on_stats["tpot_ms_p50"] / off_stats["tpot_ms_p50"],
                           3) if off_stats["tpot_ms_p50"] else None),
             }
+            if tree is not None:
+                out["tree_steps"] = info.get("tree_steps", 0)
+                out["tree"] = info.get("tree")
             return out, on.decode_path
         finally:
             off.close()
             on.close()
 
-    aligned, decode_path = run_pair(aligned=True)
-    adversarial, _ = run_pair(aligned=False)
+    aligned, decode_path = run_pair(alpha=1e-3)
+    adversarial, _ = run_pair(alpha=None)
     # the adaptive controller's contract: on the adversarial workload
     # speculation must demonstrably stand down (plain fallback carries the
     # traffic), so its TPOT cannot drift from the spec-off twin's
@@ -704,7 +727,49 @@ def child_serve_spec(preflight=None):
         "adaptive-k controller failed to disable spec on the adversarial "
         f"workload: {adversarial}")
     adversarial["controller_disabled"] = True
-    tag = (f"bench-spec,L{layers},take{take},k{k},slots{slots},bs{block}")
+
+    # ---- tree-draft sub-run: same draft cost (depth D == chain k draft
+    # forwards), contested regime (mediocre draft, spec pinned on so both
+    # shapes keep drafting). Greedy tree branch 0 IS the chain path, so per
+    # row tree acceptance dominates chain acceptance structurally — the
+    # accept-length lift is the tree's whole value proposition.
+    tree_spec_s = os.environ.get("DTX_BENCH_SPEC_TREE", f"2x{k}")
+    contested_alpha = float(os.environ.get("DTX_BENCH_SPEC_ALPHA", "0.12"))
+    chain_c, _ = run_pair(alpha=contested_alpha, mode="on")
+    tree_c, _ = run_pair(alpha=contested_alpha, tree=tree_spec_s, mode="on")
+    tree_adv, _ = run_pair(alpha=None, tree=tree_spec_s)
+    # never-slower carries over to trees: adversarial drafts stand down
+    assert tree_adv["plain_steps"] >= tree_adv["spec_steps"], (
+        "adaptive controller failed to disable TREE spec on the "
+        f"adversarial workload: {tree_adv}")
+    tree_adv["controller_disabled"] = True
+    if (tree_c["accept_len_p50"] is not None
+            and chain_c["accept_len_p50"] is not None):
+        # 0.5 slack: p50 is bucketed and concurrent submits batch rows
+        # slightly differently between the twin runs
+        assert tree_c["accept_len_p50"] >= chain_c["accept_len_p50"] - 0.5, (
+            "tree drafts failed to lift accept_len p50 over the chain at "
+            f"equal draft cost: tree={tree_c['accept_len_p50']} "
+            f"chain={chain_c['accept_len_p50']}")
+    tree_block = {
+        "spec_tree": tree_spec_s,
+        "contested_alpha": contested_alpha,
+        "chain_contested": chain_c,
+        "contested": tree_c,
+        "adversarial": tree_adv,
+        "accept_len_p50_lift": (
+            round(tree_c["accept_len_p50"] - chain_c["accept_len_p50"], 2)
+            if (tree_c["accept_len_p50"] is not None
+                and chain_c["accept_len_p50"] is not None) else None),
+        # TPOT p50 ratio vs the spec-off twin: tree should sit at or below
+        # the chain's ratio (reported, not asserted — CPU timing is noise)
+        "tpot_ratio_le_chain": (
+            tree_c["tpot_p50_ratio"] <= chain_c["tpot_p50_ratio"]
+            if (tree_c["tpot_p50_ratio"] is not None
+                and chain_c["tpot_p50_ratio"] is not None) else None),
+    }
+    tag = (f"bench-spec,L{layers},take{take},k{k},tree{tree_spec_s},"
+           f"slots{slots},bs{block}")
     line = {
         "metric": f"serve_spec_tokens_per_sec[{tag}]",
         "value": aligned["on"]["tokens_per_sec"],
@@ -718,8 +783,10 @@ def child_serve_spec(preflight=None):
         "decode_path": decode_path,
         "spec_mode": "auto",
         "spec_draft": f"take:{take}",
+        "spec_tree": tree_spec_s,
         "spec": {"k": k, "target_layers": layers, "draft_layers": take,
-                 "aligned": aligned, "adversarial": adversarial},
+                 "aligned": aligned, "adversarial": adversarial,
+                 "tree": tree_block},
     }
     if preflight is not None:
         line["preflight"] = preflight

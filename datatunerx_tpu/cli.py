@@ -234,6 +234,7 @@ def cmd_serve(args):
             "--spec_draft_config", args.spec_draft_config,
             "--spec_k", str(args.spec_k),
             "--spec_mode", args.spec_mode,
+            "--spec_tree", args.spec_tree,
             "--prefill_token_budget", str(args.prefill_token_budget),
             "--replicas", str(max(args.replicas, 1)),
             "--policy", args.policy,
@@ -270,6 +271,7 @@ def cmd_serve(args):
         "--spec_draft_config", args.spec_draft_config,
         "--spec_k", str(args.spec_k),
         "--spec_mode", args.spec_mode,
+        "--spec_tree", args.spec_tree,
         "--prefill_token_budget", str(args.prefill_token_budget),
         "--tenants_config", args.tenants_config,
         "--host_adapter_cache_mb", str(args.host_adapter_cache_mb),
@@ -459,6 +461,11 @@ def main(argv=None):
                     choices=["auto", "on", "off"],
                     help="speculative decoding: auto = adaptive, on = "
                          "pinned, off = plain decode")
+    vp.add_argument("--spec_tree", default="",
+                    help="tree drafts 'WxD' (width x depth, e.g. 4x3): one "
+                         "batched verify over W branches, accept the "
+                         "longest surviving path; needs "
+                         "--spec_draft_config; empty = chain drafts")
     vp.add_argument("--prefill_token_budget", type=int, default=0,
                     help="prefill tokens per scheduler tick between decode "
                          "chunks (0 = unbounded)")

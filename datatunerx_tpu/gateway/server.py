@@ -2046,6 +2046,7 @@ def main(argv=None):
     p.add_argument("--spec_k", type=int, default=4)
     p.add_argument("--spec_mode", default="auto",
                    choices=["auto", "on", "off"])
+    p.add_argument("--spec_tree", default="")
     p.add_argument("--paged_kernel", default="auto",
                    choices=["auto", "on", "off"])
     p.add_argument("--prefill_chunk", type=int, default=256)
@@ -2118,6 +2119,7 @@ def main(argv=None):
                        "--spec_draft_config", args.spec_draft_config,
                        "--spec_k", str(args.spec_k),
                        "--spec_mode", args.spec_mode,
+                       "--spec_tree", args.spec_tree,
                        "--prefill_chunk", str(args.prefill_chunk),
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from datatunerx_tpu.models.config import ModelConfig
 from datatunerx_tpu.ops.attention import (
     attention,
+    attention_allow,
     cache_positions_update,
     kv_cache_update,
     kv_cache_width,
@@ -197,12 +198,22 @@ def forward(
     neftune_alpha: float = 0.0,
     return_hidden: bool = False,
     skip_logits: bool = False,
+    window_mask: Optional[jnp.ndarray] = None,  # [B, T, WN] bool — see below
+    window_start: Optional[jnp.ndarray] = None,  # [B] linear window start
 ):
     """Returns (logits [B, T, V] float32, new_cache | None); with
     ``return_hidden`` also the final-norm hidden states [B, T, D].
     ``skip_logits`` (requires return_hidden) returns logits=None — value-head
     consumers (rm/ppo) skip the [T, V] lm_head matmul entirely and project
-    only the positions they need via ``lm_logits``."""
+    only the positions they need via ``lm_logits``.
+
+    ``window_mask``/``window_start`` (tree-draft speculative verification,
+    serving/speculative.py): an extra attendability mask over the WN cache
+    lanes starting at ``window_start`` (this step's own writes — tree
+    branches sharing rope positions attend only their own root-to-leaf
+    path). ``window_start`` defaults to the pre-step ``cache["len"]``.
+    Outside the window, masking is untouched; ``None`` is byte-identical
+    to before the parameter existed."""
     if skip_logits and not return_hidden:
         raise ValueError("skip_logits without return_hidden returns nothing")
     B, T = tokens.shape
@@ -234,16 +245,25 @@ def forward(
 
     # Pallas in-place decode: single-token steps over a paged cache read the
     # K/V blocks through the block table inside the kernel — no gathered
-    # [B, W, KV, d] view, no [B, 1, T, W] bias tensor. Everything else
-    # (prefill, chunked prefill, sliding window, dense caches) keeps the
-    # gather path, which doubles as the kernel's parity oracle.
-    paged_kernel = (
+    # [B, W, KV, d] view, no [B, 1, T, W] bias tensor. Multi-token steps
+    # over a paged cache (chunked-prefill chunks, spec verify-k columns,
+    # tree-verify windows) ride the multi-token variant, which consumes the
+    # oracle's own attendability tensor as a mask operand. Everything else
+    # (prefill into dense caches, sliding window, packed segments) keeps
+    # the gather path, which doubles as the kernels' parity oracle.
+    _paged_cfg = (
         cache is not None
         and "block_tables" in cache
         and getattr(cfg, "paged_kernel", False)
-        and T == 1
         and cfg.sliding_window is None
     )
+    paged_kernel = _paged_cfg and T == 1 and window_mask is None
+    paged_kernel_mt = (_paged_cfg and not paged_kernel
+                       and segment_ids is None)
+    if window_mask is not None and window_start is None:
+        if cache is None:
+            raise ValueError("window_mask without a cache needs window_start")
+        window_start = jnp.broadcast_to(cache["len"], (B,))
     if cache is None:
         kv_positions = positions
         kv_valid = attention_mask.astype(bool) if attention_mask is not None else None
@@ -269,8 +289,20 @@ def forward(
         and (cfg.attention_impl != "ring" or segment_ids is None)
         and (cfg.attention_impl != "flash" or T % 128 == 0 or T < 128)
     )
+    allow = None
     if _flash_ok or paged_kernel:
         bias = None
+    elif paged_kernel_mt:
+        # the oracle's boolean, handed to the kernel instead of a bias —
+        # mask parity with the gather path holds by construction
+        bias = None
+        allow = attention_allow(
+            positions,
+            kv_positions,
+            kv_valid,
+            window_mask=window_mask,
+            window_start=window_start,
+        )
     else:
         bias = make_causal_bias(
             positions,
@@ -279,6 +311,8 @@ def forward(
             sliding_window=cfg.sliding_window,
             q_segment_ids=segment_ids,
             kv_segment_ids=kv_seg,
+            window_mask=window_mask,
+            window_start=window_start,
         )
 
     lora_layers, lora_scale = (None, 0.0)
@@ -330,6 +364,18 @@ def forward(
                 cache, ck, cv, cks, cvs, k, v)
             attn = paged_attention_decode_step(
                 q, ck, cv, cks, cvs, cache, cache_pos, positions)
+        elif ck is not None and paged_kernel_mt:
+            # multi-token in-place: same scatter-then-read-through-the-table
+            # scheme with the precomputed attendability operand standing in
+            # for the oracle's bias
+            from datatunerx_tpu.ops.pallas_paged_attention import (
+                paged_attention_multitoken_step,
+            )
+
+            ck, cv, cks, cvs = kv_cache_write_paged(
+                cache, ck, cv, cks, cvs, k, v)
+            attn = paged_attention_multitoken_step(
+                q, ck, cv, cks, cvs, cache, allow)
         else:
             if ck is not None:
                 # dense (scalar/per-slot cursor) or paged (block-table)

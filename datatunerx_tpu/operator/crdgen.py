@@ -106,6 +106,9 @@ SPECS = {
             "specK": INT,
             "specMode": {"type": "string",
                          "enum": ["", "auto", "on", "off"]},
+            # tree-draft verification (serving --spec_tree): 'WxD' flattens
+            # a W-wide, D-deep token tree into one batched verify forward
+            "specTree": STR,
             # disaggregated fleet plane (gateway/server.py): role is a
             # single role for one server or a comma cycle the gateway
             # assigns across spawned replicas; prompts >= the threshold

@@ -248,6 +248,7 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         "spec_draft_config": serve_cfg.get("specDraft") or "",
         "spec_k": serve_cfg.get("specK"),
         "spec_mode": serve_cfg.get("specMode") or "",
+        "spec_tree": serve_cfg.get("specTree") or "",
         # disaggregated fleet plane (gateway/server.py --role /
         # --prefill_threshold / --fleet_*): replica roles, the shared
         # prefix tier, prefill→decode handoff, peer KV spill

@@ -146,6 +146,23 @@ def _validate_serve_config(cfg: dict):
     if cfg.get("specMode") not in (None, ""):
         _require(str(cfg["specMode"]) in ("auto", "on", "off"),
                  "serveConfig.specMode must be auto, on, or off")
+    if cfg.get("specTree") not in (None, ""):
+        # validated here (not just at engine start) so a bad tree spec is
+        # refused at admission instead of crash-looping replicas. Format
+        # mirrors serving.speculative.parse_spec_tree — kept dependency-
+        # free because the webhook must not import jax.
+        _require(cfg.get("specDraft") not in (None, ""),
+                 "serveConfig.specTree requires specDraft (tree drafts "
+                 "are proposed by the draft model)")
+        parts = str(cfg["specTree"]).lower().split("x")
+        ok = (len(parts) == 2 and parts[0].strip().isdigit()
+              and parts[1].strip().isdigit())
+        _require(ok, "serveConfig.specTree must be 'WxD' (branch width x "
+                     "draft depth, e.g. '4x3')")
+        w, d = int(parts[0]), int(parts[1])
+        _require(1 <= w <= 64 and 1 <= d <= 16,
+                 "serveConfig.specTree width must be 1..64 and depth "
+                 "1..16")
     for key in ("specK", "prefillThreshold"):
         if cfg.get(key) is not None:
             v = _num(cfg[key], f"serveConfig.{key}")

@@ -26,9 +26,56 @@ from datatunerx_tpu.ops.paged_attention import (
     POS_SENTINEL,
     paged_kv_update,
     paged_kv_write,
+    paged_linear_targets,
     paged_record_positions,
     paged_view_width,
 )
+
+
+def attention_allow(
+    q_positions: jnp.ndarray,  # [B, T] absolute positions of queries
+    kv_positions: jnp.ndarray,  # [B, S] absolute positions of keys
+    kv_valid: jnp.ndarray | None = None,  # [B, S] bool — False for padding
+    *,
+    sliding_window: int | None = None,
+    q_segment_ids: jnp.ndarray | None = None,  # [B, T] for packed sequences
+    kv_segment_ids: jnp.ndarray | None = None,  # [B, S]
+    window_mask: jnp.ndarray | None = None,  # [B, T, WN] bool — see below
+    window_start: jnp.ndarray | None = None,  # [B] linear start of the window
+) -> jnp.ndarray:
+    """The boolean attendability tensor [B, T, S] behind the causal bias.
+
+    ``window_mask``/``window_start`` carve a per-step WINDOW out of the KV
+    lanes — the ``WN`` linear cache positions starting at ``window_start``
+    (a multi-token verify/draft step's own writes). Inside the window a
+    lane must pass the mask column AND the causal check (tree siblings
+    share a rope position, so causality alone cannot separate branches —
+    and the causal check still excludes unwritten sentinel lanes); outside
+    it, plain causal masking applies unchanged. A lower-triangular mask
+    reproduces the chain behavior exactly, so chain verify never sets one.
+
+    Factored out of ``make_causal_bias`` so the Pallas multi-token kernel
+    consumes the SAME boolean tensor the XLA oracle biases with — mask
+    parity between the two paths holds by construction."""
+    ok = kv_positions[:, None, :] <= q_positions[:, :, None]  # causal
+    if sliding_window is not None:
+        ok &= kv_positions[:, None, :] > q_positions[:, :, None] - sliding_window
+    if kv_valid is not None:
+        ok &= kv_valid[:, None, :]
+    if q_segment_ids is not None and kv_segment_ids is not None:
+        ok &= q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+    if window_mask is not None:
+        B, T, WN = window_mask.shape
+        S = kv_positions.shape[1]
+        lane = jnp.arange(S, dtype=jnp.int32)[None, :]
+        w = lane - window_start.astype(jnp.int32)[:, None]  # [B, S]
+        inside = (w >= 0) & (w < WN)
+        wc = jnp.clip(w, 0, WN - 1)
+        allowed = jnp.take_along_axis(
+            window_mask.astype(bool),
+            jnp.broadcast_to(wc[:, None, :], (B, T, S)), axis=2)
+        ok &= ~inside[:, None, :] | allowed
+    return ok
 
 
 def make_causal_bias(
@@ -39,16 +86,16 @@ def make_causal_bias(
     sliding_window: int | None = None,
     q_segment_ids: jnp.ndarray | None = None,  # [B, T] for packed sequences
     kv_segment_ids: jnp.ndarray | None = None,  # [B, S]
+    window_mask: jnp.ndarray | None = None,  # [B, T, WN] branch/window mask
+    window_start: jnp.ndarray | None = None,  # [B]
     dtype=jnp.float32,
 ) -> jnp.ndarray:
     """Additive bias [B, 1, T, S]: 0 where attendable, -inf-ish otherwise."""
-    ok = kv_positions[:, None, :] <= q_positions[:, :, None]  # causal
-    if sliding_window is not None:
-        ok &= kv_positions[:, None, :] > q_positions[:, :, None] - sliding_window
-    if kv_valid is not None:
-        ok &= kv_valid[:, None, :]
-    if q_segment_ids is not None and kv_segment_ids is not None:
-        ok &= q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+    ok = attention_allow(
+        q_positions, kv_positions, kv_valid,
+        sliding_window=sliding_window, q_segment_ids=q_segment_ids,
+        kv_segment_ids=kv_segment_ids, window_mask=window_mask,
+        window_start=window_start)
     neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
     return jnp.where(ok, jnp.zeros((), dtype), neg)[:, None, :, :]
 
@@ -188,6 +235,70 @@ def kv_cache_update(cache: dict, ck, cv, cks, cvs, k, v):
     else:
         k_att, v_att = ck.astype(k.dtype), cv.astype(v.dtype)
     return ck, cv, cks, cvs, k_att, v_att
+
+
+def compact_window(cache: dict, participate: jnp.ndarray, len0: jnp.ndarray,
+                   src_cols: jnp.ndarray, keep: jnp.ndarray,
+                   pos0: jnp.ndarray, width: int) -> dict:
+    """Collapse a tree-verify window back into chain-invariant lanes.
+
+    A tree-verify forward writes ``width`` KV lanes per row starting at the
+    pre-step cursor ``len0``: column 0 is the pending token, the rest the
+    flattened tree nodes — SIBLINGS SHARING ROPE POSITIONS. After
+    acceptance, only the chosen root-to-leaf path may survive: a stale
+    sibling lane (rope pos ``p+1`` parked at linear lane ``len0+2``) would
+    pass the plain causal check of any later read, which is exactly the
+    corruption chain mode can never produce (its lane order == rope order).
+
+    This moves the accepted path's K/V into the contiguous cursor lanes
+    (``len0+1 … len0+keep``; lane ``len0`` already holds the pending token)
+    and rewrites every window lane's position — ``pos0+i`` where kept,
+    POS_SENTINEL otherwise — restoring the chain invariant the settle /
+    export / migration paths assume. Works on both cache layouts.
+
+    ``src_cols [B, D]`` is the window column of the path's depth-(i+1)
+    node, ``keep [B]`` the accepted path length (≤ D), ``pos0 [B]`` the
+    pending token's rope position. Rows with ``participate`` False are
+    untouched (targets go out of bounds, the default scatter drop).
+    ``len`` is NOT advanced here — the caller owns cursor math."""
+    B, D = src_cols.shape
+    depth_i = jnp.arange(1, D + 1, dtype=jnp.int32)[None, :]  # [1, D]
+    move = participate[:, None] & (depth_i <= keep[:, None])
+    src_lin = len0[:, None] + src_cols
+    dst_lin = len0[:, None] + depth_i
+    lane = jnp.arange(width, dtype=jnp.int32)[None, :]
+    lane_lin = len0[:, None] + lane
+    lane_valid = jnp.broadcast_to(participate[:, None], lane_lin.shape)
+    vals = jnp.where(lane <= keep[:, None], pos0[:, None] + lane,
+                     POS_SENTINEL)
+    out = dict(cache)
+    kv_keys = [k for k in ("k", "v", "k_scale", "v_scale") if k in cache]
+    if "block_tables" in cache:
+        tables = cache["block_tables"]
+        num_blocks, block_size = cache["pos"].shape
+        src_phys, src_off = paged_linear_targets(
+            tables, src_lin, block_size, num_blocks, move)
+        src_phys = jnp.minimum(src_phys, num_blocks - 1)  # gather in bounds
+        dst_phys, dst_off = paged_linear_targets(
+            tables, dst_lin, block_size, num_blocks, move)
+        for key in kv_keys:
+            leaf = cache[key]
+            out[key] = leaf.at[:, dst_phys, dst_off].set(
+                leaf[:, src_phys, src_off])
+        lane_phys, lane_off = paged_linear_targets(
+            tables, lane_lin, block_size, num_blocks, lane_valid)
+        out["pos"] = cache["pos"].at[lane_phys, lane_off].set(vals)
+        return out
+    W = cache["pos"].shape[1]
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    src_idx = jnp.clip(src_lin, 0, W - 1)
+    dst_idx = jnp.where(move, dst_lin, W)  # OOB = dropped
+    for key in kv_keys:
+        leaf = cache[key]
+        out[key] = leaf.at[:, rows, dst_idx].set(leaf[:, rows, src_idx])
+    lane_idx = jnp.where(lane_valid, lane_lin, W)
+    out["pos"] = cache["pos"].at[rows, lane_idx].set(vals)
+    return out
 
 
 def attention(

@@ -220,6 +220,22 @@ def _write_targets(tables: jnp.ndarray, lens: jnp.ndarray, T: int,
     return phys, off
 
 
+def paged_linear_targets(tables: jnp.ndarray, lin: jnp.ndarray,
+                         block_size: int, num_blocks: int,
+                         valid: jnp.ndarray):
+    """Physical (block, offset) for ARBITRARY linear positions ``lin``
+    [B, N] — ``_write_targets`` generalized beyond a cursor-contiguous run
+    (tree-verify window compaction moves non-contiguous window columns).
+    Positions with ``valid`` False, past the table, or backed by no block
+    get physical index ``num_blocks`` so scatters drop them."""
+    blk, off = lin // block_size, lin % block_size
+    nbps = tables.shape[1]
+    tbl = jnp.take_along_axis(tables, jnp.clip(blk, 0, nbps - 1), axis=1)
+    phys = jnp.where(valid & (blk >= 0) & (blk < nbps) & (tbl >= 0),
+                     tbl, num_blocks)
+    return phys, off
+
+
 def _gather_tables(tables: jnp.ndarray) -> jnp.ndarray:
     """Table with -1 entries clamped to block 0 (gather must stay in
     bounds; the garbage it reads is masked via sentinel positions)."""

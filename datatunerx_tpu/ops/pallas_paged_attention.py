@@ -260,3 +260,207 @@ def paged_attention_decode_step(q, ck, cv, cks, cvs, cache: dict,
         q[:, 0], ck, cv, cks, cvs, cache["block_tables"], pos_pool,
         positions[:, 0], interpret=interpret)
     return out[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Multi-token q (chunked prefill / verify-k / tree-verify columns)
+# ---------------------------------------------------------------------------
+#
+# Same block-table walk and two-phase online softmax as the decode kernel,
+# for a bucketed q_len > 1. Masking changes shape, not mechanism: instead of
+# the in-kernel ``kv_pos <= q_pos`` compare (one scalar per slot), the host
+# precomputes the full boolean attendability tensor ``allow [B, T, W]`` with
+# ``ops.attention.attention_allow`` — the SAME tensor the XLA oracle turns
+# into its additive bias — and the kernel streams the block's [T, bs] tile
+# of it alongside K/V. That one operand encodes per-row causal offsets,
+# POS_SENTINEL lanes, ragged lens, sliding windows, and tree-branch
+# ancestry masks uniformly, so kernel/oracle mask parity holds by
+# construction (an int32 tile costs W·T·4 bytes per slot vs the KV blocks'
+# 2·W·KV·d·itemsize — noise). Invalid table entries are still skipped
+# outright by ``pl.when``.
+#
+# q enters kv-major as ``[B, H·T, d]`` (row (kv·G + g)·T + t): per-(kv, g)
+# extraction stays a static sublane slice and each score tile is one
+# [T, d] × [d, bs] MXU pass, reusing the decode kernel's merged-trailing-dim
+# pool layout unchanged.
+#
+# Garbage contract: a fully-masked query row (inactive slot in a verify
+# batch) normalizes over NEG_INF scores — finite uniform-ish junk, like the
+# oracle's sentinel-masked softmax but not bit-equal to it. Such rows only
+# exist where the engine's emit mask discards them; parity is asserted on
+# rows with at least one attendable lane.
+
+
+def _multitoken_kernel(tables_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                       allow_ref, o_ref, acc_ref, m_ref, l_ref,
+                       *, nbps: int, kv_heads: int, group: int, q_len: int,
+                       scale: float, quant: bool):
+    """One (slot, table-entry, phase) grid step for q_len query rows."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    jj = j - (j // nbps) * nbps
+    stats_phase = j < nbps
+    entry = tables_ref[b, jj]
+    d = o_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _heads(ref, scale_ref):
+        """The block's per-head [bs, d] tiles (see the decode kernel)."""
+        full = ref[0]  # [bs, KV·d]
+        if quant:
+            full = full.astype(jnp.float32)
+        out = []
+        for kv in range(kv_heads):
+            h = full[:, kv * d:(kv + 1) * d]
+            if quant:
+                h = (h * scale_ref[0][:, kv:kv + 1]).astype(o_ref.dtype)
+            out.append(h.astype(jnp.float32))
+        return out
+
+    def _masked_scores(k_heads):
+        """Masked f32 score tiles: one ([q_len, bs], row slice) per head."""
+        mask = allow_ref[0] != 0  # [q_len, bs] — the oracle's bias == 0
+        out = []
+        for kv in range(kv_heads):
+            for g in range(group):
+                rows = slice((kv * group + g) * q_len,
+                             (kv * group + g + 1) * q_len)
+                qg = q_ref[0, rows, :].astype(jnp.float32)
+                s = jax.lax.dot_general(
+                    qg, k_heads[kv], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                out.append((rows, kv, jnp.where(mask, s, NEG_INF)))
+        return out
+
+    @pl.when((entry >= 0) & stats_phase)
+    def _stats():
+        for rows, _, s in _masked_scores(_heads(k_ref, ks_ref)):
+            m_prev = m_ref[rows, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[rows, :] = (l_ref[rows, :] * corr
+                              + jnp.sum(jnp.exp(s - m_new), axis=1,
+                                        keepdims=True))
+            m_ref[rows, :] = jnp.broadcast_to(m_new,
+                                              (q_len, m_ref.shape[1]))
+
+    @pl.when((entry >= 0) & ~stats_phase)
+    def _weighted_sum():
+        v_heads = _heads(v_ref, vs_ref)
+        for rows, kv, s in _masked_scores(_heads(k_ref, ks_ref)):
+            l_row = jnp.maximum(l_ref[rows, 0:1], 1e-30)
+            # oracle rounding: normalize THEN cast before the PV product
+            p = (jnp.exp(s - m_ref[rows, 0:1]) / l_row).astype(o_ref.dtype)
+            acc_ref[rows, :] += jax.lax.dot_general(
+                p.astype(jnp.float32), v_heads[kv],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(j == 2 * nbps - 1)
+    def _finish():
+        o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+
+
+def paged_multitoken_attention(
+    q: jnp.ndarray,          # [B, T, H, d] — the step's query columns
+    k_pool: jnp.ndarray,     # [NB, bs, KV, d] one layer's block pool
+    v_pool: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray],  # [NB, bs, KV] f32 (int8 pools) | None
+    v_scale: Optional[jnp.ndarray],
+    tables: jnp.ndarray,     # [B, nbps] int32, -1 = unallocated
+    allow: jnp.ndarray,      # [B, T, nbps·bs] bool/int — attendability per
+                             # (query row, linear cache lane), POST-write
+    *,
+    interpret=None,
+) -> jnp.ndarray:
+    """In-place paged attention for q_len > 1: out ``[B, T, H, d]``.
+
+    ``allow`` must be ``attention_allow(...)`` over the POST-write gathered
+    kv positions — the one tensor the gather oracle biases with."""
+    B, T, H, d = q.shape
+    NB, bs, KV, _ = k_pool.shape
+    nbps = tables.shape[1]
+    G = H // KV
+    quant = k_scale is not None
+    assert allow.shape == (B, T, nbps * bs), (
+        f"allow {allow.shape} != {(B, T, nbps * bs)}")
+
+    scale = float(np.float32(1.0) / np.sqrt(np.float32(d)))  # dtxlint: disable=DTX001 — host numpy scalar (d is a static shape), no device sync
+    kernel = functools.partial(
+        _multitoken_kernel, nbps=nbps, kv_heads=KV, group=G, q_len=T,
+        scale=scale, quant=quant)
+
+    def kv_index(b, j, tables_ref):
+        return (jnp.maximum(tables_ref[b, j - (j // nbps) * nbps], 0), 0, 0)
+
+    scale_index = kv_index
+
+    def v_index(b, j, tables_ref):
+        jj = j - (j // nbps) * nbps
+        return (jnp.maximum(tables_ref[b, jj], 0) * (j >= nbps), 0, 0)
+
+    def allow_index(b, j, tables_ref):
+        # the allow tensor is laid out linearly — column block jj of slot b
+        return (b, 0, j - (j // nbps) * nbps)
+
+    # q kv-major [B, H·T, d]: row (kv·G + g)·T + t, so per-(kv, g) rows are
+    # a static sublane slice; pools keep the merged (KV, d) trailing dims
+    q_km = q.transpose(0, 2, 1, 3).reshape(B, H * T, d)
+    in_specs = [
+        pl.BlockSpec((1, H * T, d), lambda b, j, t: (b, 0, 0)),
+        pl.BlockSpec((1, bs, KV * d), kv_index),
+        pl.BlockSpec((1, bs, KV * d), v_index),
+    ]
+    args = [q_km, k_pool.reshape(NB, bs, KV * d),
+            v_pool.reshape(NB, bs, KV * d)]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, KV), scale_index),
+                     pl.BlockSpec((1, bs, KV), scale_index)]
+        args += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, T, bs), allow_index))
+    args.append(allow.astype(jnp.int32))
+
+    kernel_args = kernel if quant else functools.partial(
+        _no_scale_mt_kernel, kernel)
+    out = pl.pallas_call(
+        kernel_args,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, 2 * nbps),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, H * T, d), lambda b, j, t: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((H * T, d), jnp.float32),
+                pltpu.VMEM((H * T, _LANES), jnp.float32),
+                pltpu.VMEM((H * T, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H * T, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(tables.astype(jnp.int32), *args)
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+def _no_scale_mt_kernel(kernel, tables_ref, q_ref, k_ref, v_ref, allow_ref,
+                        o_ref, acc_ref, m_ref, l_ref):
+    """Arity shim for the unquantized pools: no scale refs in the call."""
+    kernel(tables_ref, q_ref, k_ref, v_ref, None, None, allow_ref,
+           o_ref, acc_ref, m_ref, l_ref)
+
+
+def paged_attention_multitoken_step(q, ck, cv, cks, cvs, cache: dict,
+                                    allow, *, interpret=None):
+    """Model-facing wrapper: q ``[B, T, H, d]`` (chunk / verify columns),
+    the layer-peeled pools, the live cache dict, and the POST-write
+    ``allow [B, T, S]`` attendability tensor. Returns ``[B, T, H, d]`` in
+    q.dtype — drop-in for the gather + ``xla_attention`` pair."""
+    return paged_multitoken_attention(
+        q, ck, cv, cks, cvs, cache["block_tables"], allow,
+        interpret=interpret)

@@ -602,6 +602,7 @@ class BatchedEngine:
         spec_draft: Optional[str] = None,  # draft model: path|preset:|take:N
         spec_k: int = 4,  # proposals per verify step (adaptive ceiling)
         spec_mode: str = "auto",  # auto (adaptive) | on (pinned) | off
+        spec_tree: Optional[str] = None,  # "WxD" tree drafts (None = chain)
         prefill_chunk: int = 256,  # chunked-prefill program length (paged)
         prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
         registry: Optional[Registry] = None,  # shared /metrics registry
@@ -796,10 +797,28 @@ class BatchedEngine:
         self.spec_mode = smode
         self.spec_k = max(1, int(spec_k))
         self.spec = None
-        # verify-k writes up to spec_k+1 tokens past a row's cursor; paged
-        # admission reserves that overshoot so every verify write stays
-        # physical (ops.paged_attention.blocks_for_depth caps at the table
-        # width). 0 when spec is off — reserve math byte-identical to today.
+        self.spec_tree = None
+        if spec_tree and smode != "off":
+            from datatunerx_tpu.serving import speculative as spec_mod
+
+            if not spec_draft:
+                raise ValueError("--spec_tree requires --spec_draft_config")
+            self.spec_tree = spec_mod.parse_spec_tree(spec_tree)
+            if self.spec_tree.step_tokens >= self.max_seq_len:
+                raise ValueError(
+                    f"spec_tree {self.spec_tree} writes "
+                    f"{self.spec_tree.step_tokens} tokens per step — does "
+                    f"not fit max_seq_len {self.max_seq_len}")
+        # one verify step writes up to step-token-count tokens past a row's
+        # cursor (chain: pending + k proposals; tree: pending + W*D nodes);
+        # paged admission reserves that overshoot so every verify write
+        # stays physical (ops.paged_attention.blocks_for_depth caps at the
+        # table width). Sizing it from the ACTUAL per-step token count —
+        # not a chain-shaped spec_k+1 — is what keeps tree mode from
+        # under-reserving blocks. 0 when spec is off — reserve math
+        # byte-identical to today.
+        self._spec_step_tokens = (self.spec_tree.step_tokens
+                                  if self.spec_tree else self.spec_k + 1)
         self._spec_overshoot = 0
         if spec_draft and smode != "off":
             from datatunerx_tpu.serving import speculative as spec_mod
@@ -817,8 +836,9 @@ class BatchedEngine:
                 "programs": spec_mod.spec_programs(
                     self.cfg, dcfg, self.max_seq_len, self.kv_quant),
             }
-            self.spec_ctrl = spec_mod.AdaptiveK(self.spec_k, mode=smode)
-            self._spec_overshoot = self.spec_k + 1
+            self.spec_ctrl = spec_mod.AdaptiveK(self.spec_k, mode=smode,
+                                                tree=self.spec_tree)
+            self._spec_overshoot = self._spec_step_tokens
             self._spec_pending = jnp.zeros((slots,), jnp.int32)
             self._spec_form = [False] * slots   # slot is in pending form
             self._spec_primed = [False] * slots  # draft row holds the context
@@ -826,19 +846,24 @@ class BatchedEngine:
             # the step-mix; written by the scheduler thread only
             self.spec_stats = {"proposed": 0, "accepted": 0,
                                "row_steps": 0,  # per-row verify events
-                               "spec_steps": 0, "plain_steps": 0}
+                               "spec_steps": 0, "plain_steps": 0,
+                               "tree_steps": 0}
             # per-adapter acceptance EMA ('' = base) for /metrics + routing
             self._spec_adapter_ema: Dict[str, float] = {}
+            # per-slot accepted-path-length EMA (tree mode): pruned on
+            # release like the slot acceptance EMAs, capped on export
+            self._spec_tree_slot_path: Dict[int, float] = {}
             self._h_accept_len = None  # bound after the registry exists
 
         # ---- overcommit scheduler state. _tick_advance = the most cache
         # lanes one scheduler tick can consume per slot (a plain decode
-        # chunk, or a verify-k step), and growth must additionally keep the
-        # spec write overshoot physical — together the per-tick capacity
-        # target the grower maintains ahead of every cursor.
+        # chunk, or a verify step — chain or tree), and growth must
+        # additionally keep the spec write overshoot physical — together
+        # the per-tick capacity target the grower maintains ahead of every
+        # cursor.
         self._tick_advance = self.chunk
         if self.spec is not None:
-            self._tick_advance = max(self.chunk, self.spec_k + 1)
+            self._tick_advance = max(self.chunk, self._spec_step_tokens)
         # preempted sessions, parked host-side as dtx-kv-session payloads
         # (raw-numpy bodies — no b64 for in-process parking), oldest first;
         # owned by the scheduler thread
@@ -2713,6 +2738,9 @@ class BatchedEngine:
             self._spec_form[slot] = False
             self._spec_primed[slot] = False
             self.spec_ctrl.reset_slot(slot)
+            # prune-on-release, like the slot acceptance EMAs: per-slot
+            # tree-path series never outlive the tenant that produced them
+            self._spec_tree_slot_path.pop(slot, None)
         name, self._slot_adapter[slot] = self._slot_adapter[slot], None
         if name is not None and self.adapter_registry is not None:
             self.adapter_registry.release(name)
@@ -3084,7 +3112,7 @@ class BatchedEngine:
                 and self.spec_ctrl.slot_enabled(s))
 
         if spec_rows.any() and self.spec_ctrl.use_spec():
-            k = self.spec_ctrl.current_k()
+            plan = self.spec_ctrl.current_plan()
             # static batch mode (bounded compiled variants): all-greedy
             # batches verify by argmax alone — no distributions, no
             # full-vocab sort; top_p-free sampled batches use plain
@@ -3097,16 +3125,31 @@ class BatchedEngine:
                 mode = "topp"
             else:
                 mode = "simple"
-            with jax.profiler.TraceAnnotation("dtx_engine_spec_step"):
-                (emitted, acc, self._cache, sp["dcache"],
-                 self._spec_pending, self._pos, self._remaining,
-                 self._active, self._rng) = progs.step(
-                    self.params, sp["dparams"], self._lora_arg(),
-                    self._cache, sp["dcache"], self._spec_pending,
-                    self._pos, self._remaining, self._active, self._rng,
-                    self._temps, self._top_ps, self._stops,
-                    self._adapter_idx, jnp.asarray(spec_rows), k=k,
-                    mode=mode)
+            if plan[0] == "tree":
+                _, width, k = plan  # per-row depth plays the chain k role
+                with jax.profiler.TraceAnnotation("dtx_engine_spec_tree"):
+                    (emitted, acc, self._cache, sp["dcache"],
+                     self._spec_pending, self._pos, self._remaining,
+                     self._active, self._rng) = progs.tree_step(
+                        self.params, sp["dparams"], self._lora_arg(),
+                        self._cache, sp["dcache"], self._spec_pending,
+                        self._pos, self._remaining, self._active,
+                        self._rng, self._temps, self._top_ps, self._stops,
+                        self._adapter_idx, jnp.asarray(spec_rows),
+                        width=width, depth=k, mode=mode)
+                self.spec_stats["tree_steps"] += 1
+            else:
+                k = plan[1]
+                with jax.profiler.TraceAnnotation("dtx_engine_spec_step"):
+                    (emitted, acc, self._cache, sp["dcache"],
+                     self._spec_pending, self._pos, self._remaining,
+                     self._active, self._rng) = progs.step(
+                        self.params, sp["dparams"], self._lora_arg(),
+                        self._cache, sp["dcache"], self._spec_pending,
+                        self._pos, self._remaining, self._active,
+                        self._rng, self._temps, self._top_ps, self._stops,
+                        self._adapter_idx, jnp.asarray(spec_rows), k=k,
+                        mode=mode)
             out_rows.append(np.asarray(emitted).T)  # [k+1, S]  # dtxlint: disable=DTX001
             acc_np = np.asarray(acc)  # dtxlint: disable=DTX001
             # acc_np is host numpy already — no device sync here
@@ -3120,6 +3163,11 @@ class BatchedEngine:
                 self.spec_stats["accepted"] += a
                 if self._h_accept_len is not None:
                     self._h_accept_len.observe(a)
+                if plan[0] == "tree":
+                    ema_t = self._spec_tree_slot_path.get(s)
+                    self._spec_tree_slot_path[s] = (
+                        a * 1.0 if ema_t is None
+                        else ema_t + self.spec_ctrl.alpha * (a - ema_t))
                 req = self._slot_req[s]
                 name = req.adapter_name if req is not None else ""
                 ema = self._spec_adapter_ema.get(name)
@@ -3167,6 +3215,17 @@ class BatchedEngine:
             "active": self.spec_ctrl.use_spec(),
             "disabled_events": snap["disabled_events"],
         }
+        if self.spec_tree is not None:
+            plan = snap.get("plan") or []
+            info["tree"] = {
+                "spec": str(self.spec_tree),
+                "width": self.spec_tree.width,
+                "depth": self.spec_tree.depth,
+                "plan_width": (plan[1] if len(plan) == 3 else
+                               self.spec_tree.width),
+                "slot_path_len": {s: round(v, 4) for s, v in
+                                  dict(self._spec_tree_slot_path).items()},
+            }
         info.update(self.spec_stats)
         return info
 

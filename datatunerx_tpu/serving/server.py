@@ -60,6 +60,11 @@ class ServingState:
 
 STATE = ServingState()
 
+# ceiling on slot-labeled series per family in the exposition: slots are a
+# small fixed pool, so this never binds on a healthy engine — it is a guard
+# against unbounded label cardinality if a spec_info document goes wrong
+_SLOT_SERIES_CAP = 1024
+
 
 def slo_evaluator() -> SLOEvaluator:
     """The server's evaluator, created on first use with the default
@@ -269,8 +274,23 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
     sp_steps = reg.counter("dtx_serving_spec_steps_total",
                            "Decode programs run by path (spec = draft/"
                            "verify, plain = pending-form fallback).")
+    # tree-draft families: declared every scrape like the rest (stable
+    # zeros on chain-only engines), restated from spec_info()["tree"]
+    sp_tree_steps = reg.counter("dtx_serving_spec_tree_steps_total",
+                                "Verify steps that ran the tree-draft "
+                                "program (vs chain draft/verify).")
+    sp_tree_width = reg.gauge("dtx_serving_spec_tree_width",
+                              "Current tree branch width (adaptive, <= "
+                              "the --spec_tree W; 0 = chain drafts).")
+    sp_tree_depth = reg.gauge("dtx_serving_spec_tree_depth",
+                              "Configured tree draft depth D (0 = chain "
+                              "drafts).")
+    sp_tree_path = reg.gauge("dtx_serving_spec_tree_slot_path_len",
+                             "Accepted root-to-leaf path length EMA per "
+                             "live cache slot.")
     for m in (sp_enabled, sp_active, sp_k, sp_rate, sp_rate_adapter,
-              sp_rate_slot, sp_prop, sp_acc, sp_steps):
+              sp_rate_slot, sp_prop, sp_acc, sp_steps, sp_tree_steps,
+              sp_tree_width, sp_tree_depth, sp_tree_path):
         m.clear()
     spec_fn = getattr(eng, "spec_info", None)
     spec_doc = spec_fn() if callable(spec_fn) else None
@@ -283,13 +303,25 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
         for name, v in sorted(
                 (spec_doc.get("adapter_accept_rate") or {}).items()):
             sp_rate_adapter.set(v, {"adapter": name})
+        # per-slot series are pruned on slot release engine-side; the cap
+        # here bounds exposition cardinality even if an engine misbehaves
         for slot, v in sorted(
-                (spec_doc.get("slot_accept_rate") or {}).items()):
+                (spec_doc.get("slot_accept_rate") or {}).items()
+                )[:_SLOT_SERIES_CAP]:
             sp_rate_slot.set(v, {"slot": str(slot)})
         sp_prop.set(spec_doc.get("proposed", 0))
         sp_acc.set(spec_doc.get("accepted", 0))
         sp_steps.set(spec_doc.get("spec_steps", 0), {"path": "spec"})
         sp_steps.set(spec_doc.get("plain_steps", 0), {"path": "plain"})
+        sp_tree_steps.set(spec_doc.get("tree_steps", 0))
+        tree_doc = spec_doc.get("tree")
+        if tree_doc:
+            sp_tree_width.set(tree_doc.get("plan_width", 0))
+            sp_tree_depth.set(tree_doc.get("depth", 0))
+            for slot, v in sorted(
+                    (tree_doc.get("slot_path_len") or {}).items()
+                    )[:_SLOT_SERIES_CAP]:
+                sp_tree_path.set(v, {"slot": str(slot)})
     # KV migration fabric: session export/import outcomes (restated from
     # the engine's scheduler-thread counters, cleared first like the rest)
     s_exp = reg.counter("dtx_serving_session_export_total",
@@ -969,6 +1001,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       prefill_chunk=256,
                       prefill_token_budget=0, paged_kernel="auto",
                       spec_draft=None, spec_k=4, spec_mode="auto",
+                      spec_tree=None,
                       trace_ring=256, trace_log_path=None,
                       tenants_config=None, host_adapter_cache_mb=0.0):
     def _load():
@@ -988,6 +1021,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               # "off"/"auto" are no-ops everywhere else
                               ("--paged_kernel", paged_kernel == "on"),
                               ("--spec_draft_config", spec_draft),
+                              ("--spec_tree", spec_tree),
                               ("--tenants_config", tenants_config),
                               ("--host_adapter_cache_mb",
                                host_adapter_cache_mb)):
@@ -1012,6 +1046,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     paged_kernel=paged_kernel or "auto",
                     spec_draft=spec_draft or None,
                     spec_k=spec_k, spec_mode=spec_mode or "auto",
+                    spec_tree=spec_tree or None,
                     prefill_chunk=prefill_chunk,
                     prefill_token_budget=prefill_token_budget,
                     # the server's registry: engine TTFT/TPOT/prefill-chunk
@@ -1130,6 +1165,13 @@ def main(argv=None):
                         "fall back to plain decode when acceptance "
                         "collapses), on = always draft, off = exactly "
                         "today's decode path")
+    p.add_argument("--spec_tree", default="",
+                   help="tree-draft speculative verification: 'WxD' (branch "
+                        "width x draft depth, e.g. 4x3) flattens a per-slot "
+                        "token tree into one batched verify forward and "
+                        "accepts the longest surviving root-to-leaf path. "
+                        "Requires --spec_draft_config. Empty (default) = "
+                        "chain drafts, byte-identical to before")
     p.add_argument("--prefill_chunk", type=int, default=256,
                    help="chunked-prefill program length in tokens (paged "
                         "engine); long prompts prefill in chunks "
@@ -1202,6 +1244,7 @@ def main(argv=None):
                       paged_kernel=args.paged_kernel,
                       spec_draft=args.spec_draft_config,
                       spec_k=args.spec_k, spec_mode=args.spec_mode,
+                      spec_tree=args.spec_tree,
                       trace_ring=args.trace_ring,
                       trace_log_path=args.trace_log,
                       tenants_config=args.tenants_config,

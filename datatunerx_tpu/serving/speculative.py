@@ -36,11 +36,89 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from datatunerx_tpu.models.llama import forward, init_cache
+from datatunerx_tpu.ops.attention import compact_window
 from datatunerx_tpu.serving.engine import _sample_jit
 
 SPEC_MODES = ("auto", "on", "off")
+
+
+# ------------------------------------------------------------- tree topology
+@dataclasses.dataclass(frozen=True)
+class TreeSpec:
+    """``--spec_tree WxD``: W parallel draft chains of depth D sharing the
+    pending root. The verify window flattens depth-major: column 0 is the
+    pending token, node (depth j, branch b) sits at column
+    ``1 + (j-1)*W + b`` with rope position ``pos + j`` — siblings SHARE a
+    rope position, which is why tree verification needs the branch
+    ancestry mask (``tree_verify_mask``) on top of the causal check."""
+
+    width: int
+    depth: int
+
+    @property
+    def step_tokens(self) -> int:
+        """Tokens one tree step writes per slot (pending + all nodes) —
+        the overshoot / window width / verify-column count."""
+        return 1 + self.width * self.depth
+
+    def __str__(self) -> str:
+        return f"{self.width}x{self.depth}"
+
+
+def parse_spec_tree(spec: str) -> TreeSpec:
+    """Parse ``--spec_tree`` / ``serveConfig.specTree`` ``"WxD"`` strings."""
+    err = (f"spec_tree must be 'WxD' (branch width x draft depth, e.g. "
+           f"'4x3'), got {spec!r}")
+    parts = str(spec).strip().lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(err)
+    try:
+        width, depth = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(err) from None
+    if not 1 <= width <= 64 or not 1 <= depth <= 16:
+        raise ValueError(
+            f"spec_tree {spec!r} out of range: width must be in [1, 64] "
+            "and depth in [1, 16]")
+    return TreeSpec(width, depth)
+
+
+def _tree_col(j: int, b: int, width: int) -> int:
+    """Verify-window column of tree node (depth ``j`` >= 1, branch ``b``)."""
+    return 1 + (j - 1) * width + b
+
+
+def tree_verify_mask(width: int, depth: int) -> np.ndarray:
+    """Static [T, T] branch ancestry mask for the verify forward: query
+    column c may attend window column c' iff c' is on c's root-to-self
+    path. Combined with the causal check inside ``attention_allow`` (which
+    still excludes unwritten sentinel lanes), this is exactly the oracle
+    bias a sequential per-branch verify would build."""
+    T = 1 + width * depth
+    mask = np.zeros((T, T), dtype=bool)
+    mask[0, 0] = True
+    for j in range(1, depth + 1):
+        for b in range(width):
+            c = _tree_col(j, b, width)
+            mask[c, 0] = True
+            for i in range(1, j + 1):
+                mask[c, _tree_col(i, b, width)] = True
+    return mask
+
+
+def tree_draft_mask(width: int, j: int) -> np.ndarray:
+    """Static [W, 1 + j*W] window mask for the draft's depth-``j`` forward:
+    branch b's query attends the pending root, its own ancestors, and its
+    own write lane — never a sibling chain."""
+    mask = np.zeros((width, 1 + j * width), dtype=bool)
+    for b in range(width):
+        mask[b, 0] = True
+        for i in range(1, j + 1):
+            mask[b, _tree_col(i, b, width)] = True
+    return mask
 
 
 # ------------------------------------------------------------- sampling math
@@ -128,6 +206,108 @@ def accept_tokens(p_probs: jnp.ndarray, q_probs: jnp.ndarray,
     return a, extra, rng
 
 
+def accept_tree_tokens(p_cols: jnp.ndarray, q_tree: jnp.ndarray,
+                       d_toks: jnp.ndarray, temperature, rng, spec_on,
+                       *, width: int, depth: int):
+    """One row's tree acceptance (traceable; vmapped by the tree-verify
+    program, unit-tested directly).
+
+    ``p_cols`` [T, V]: target distributions at every verify column (column
+    0 = pending, node (j, b) at ``_tree_col``); ``q_tree`` [D, W, V]: the
+    draft distribution each node's token was sampled from (``q_tree[0]``
+    is the shared root distribution all depth-1 siblings were drawn iid
+    from); ``d_toks`` [D, W]. Returns ``(n_accept, branch, extra_token,
+    new_rng)`` — the row emits the chosen branch's first ``n_accept``
+    tokens then ``extra_token``.
+
+    Exactness:
+
+    - greedy (``temperature <= 0``): a node survives iff its token equals
+      the target argmax at its parent column; the deepest surviving branch
+      wins and the corrected/bonus token is the argmax at the divergence —
+      the emitted stream is token-identical to sequential greedy decode
+      (siblings are distinct by top-k, so at most one survives depth 1).
+    - sampled: SpecInfer-style recursive rejection across the depth-1
+      siblings — test each against the running residual (``r ← norm(max(r
+      - q, 0))`` after every rejection), which keeps the emitted marginal
+      EXACTLY ``p`` no matter how many siblings are tried — then the
+      standard Leviathan/Chen chain rule down the accepted branch, with
+      the usual residual at the first chain rejection and the bonus
+      distribution at full depth.
+
+    ``spec_on=False`` rows reject every sibling WITHOUT consuming residual
+    mass (the update is gated), so the final "residual" is the plain
+    target distribution ``p_0`` — the row takes an ordinary single-token
+    step inside the same program, exactly like ``accept_tokens``."""
+    W, D = width, depth
+    rng, u_key, x_key = jax.random.split(rng, 3)
+    us = jax.random.uniform(u_key, (W + D - 1,)) if W + D - 1 else \
+        jnp.zeros((0,))
+    greedy = temperature <= 0.0
+
+    # ---- sampled: W-round sibling rejection at depth 1
+    r = p_cols[0]
+    q0 = q_tree[0, 0]
+    b_star = jnp.asarray(-1, jnp.int32)
+    accepted = jnp.asarray(False)
+    for b in range(W):
+        x = d_toks[0, b]
+        q_at = q0[x]
+        ok = (~accepted) & spec_on & (q_at > 0.0) & (us[b] * q_at <= r[x])
+        b_star = jnp.where(ok, jnp.asarray(b, jnp.int32), b_star)
+        accepted = accepted | ok
+        r_new = jnp.clip(r - q0, 0.0, None)
+        tot = r_new.sum()
+        r_new = jnp.where(tot > 0.0, r_new / jnp.maximum(tot, 1e-30), r)
+        r = jnp.where((~accepted) & spec_on, r_new, r)
+
+    # ---- chain rule down the accepted branch (depths 2..D)
+    bsafe = jnp.maximum(b_star, 0)
+    toks_b = d_toks[:, bsafe]                                   # [D]
+    cols_b = 1 + jnp.arange(D, dtype=jnp.int32) * W + bsafe     # [D]
+    p_b = p_cols[cols_b]                                        # [D, V]
+    q_b = q_tree[:, bsafe]                                      # [D, V]
+    if D > 1:
+        jidx = jnp.arange(D - 1)
+        p_at = p_b[jidx, toks_b[1:]]
+        q_at = q_b[jidx + 1, toks_b[1:]]
+        ok_chain = (us[W + jidx] * q_at <= p_at) & (q_at > 0.0)
+        nacc = jnp.sum(jnp.cumprod(ok_chain.astype(jnp.int32)))
+    else:
+        nacc = jnp.asarray(0, jnp.int32)
+    a_sampled = jnp.where(accepted, 1 + nacc, 0).astype(jnp.int32)
+
+    # extra-token distribution table indexed by the acceptance count:
+    # row 0 = the post-sibling residual, rows 1..D-1 = the chain-rejection
+    # residuals, row D = the full-acceptance bonus distribution
+    resid = jnp.clip(p_b[:-1] - q_b[1:], 0.0, None)  # [D-1, V]
+    tots = resid.sum(axis=-1, keepdims=True)
+    resid = jnp.where(tots > 0.0, resid / jnp.maximum(tots, 1e-30),
+                      p_b[:-1])
+    table = jnp.concatenate([r[None], resid, p_b[-1:]], axis=0)  # [D+1, V]
+    extra_sampled = jax.random.categorical(
+        x_key, jnp.log(jnp.maximum(table[a_sampled], 1e-30))
+    ).astype(jnp.int32)
+
+    # ---- greedy: pure argmax comparison per node (never consults q)
+    tgt = jnp.argmax(p_cols, axis=-1).astype(jnp.int32)          # [T]
+    pred = np.zeros((D, W), np.int64)  # parent column of node (j+1, b)
+    for j in range(1, D):
+        for b in range(W):
+            pred[j, b] = _tree_col(j, b, W)
+    ok_g = (d_toks == tgt[pred]) & spec_on
+    a_per_b = jnp.sum(jnp.cumprod(ok_g.astype(jnp.int32), axis=0), axis=0)
+    b_greedy = jnp.argmax(a_per_b).astype(jnp.int32)  # first max wins
+    a_greedy = a_per_b[b_greedy]
+    leaf = jnp.where(a_greedy == 0, 0, 1 + (a_greedy - 1) * W + b_greedy)
+    extra_greedy = tgt[leaf]
+
+    a = jnp.where(greedy, a_greedy, a_sampled)
+    branch = jnp.where(greedy, b_greedy, bsafe)
+    extra = jnp.where(greedy, extra_greedy, extra_sampled).astype(jnp.int32)
+    return a, branch, extra, rng
+
+
 # --------------------------------------------------------------- draft model
 def build_draft(spec_draft: str, target_cfg, target_params,
                 target_vocab: Optional[int] = None):
@@ -186,10 +366,11 @@ class AdaptiveK:
 
     def __init__(self, k_max: int, mode: str = "auto", floor: float = 0.35,
                  alpha: float = 0.25, min_obs: int = 4,
-                 probe_every: int = 64):
+                 probe_every: int = 64, tree: Optional[TreeSpec] = None):
         if k_max < 1:
             raise ValueError(f"spec_k must be >= 1, got {k_max}")
         self.k_max = int(k_max)
+        self.tree = tree
         self.mode = mode
         self.floor = float(floor)
         self.alpha = float(alpha)
@@ -264,11 +445,34 @@ class AdaptiveK:
             return True
         return streak >= self.probe_every  # probe: one spec step, re-measure
 
+    def current_plan(self) -> tuple:
+        """The step shape this tick runs: ``("chain", k)`` or ``("tree",
+        width, depth)``. The tree controller degrades along WIDTH as global
+        acceptance collapses (full W while it holds, half on mediocre, a
+        width-1 chain-of-depth-D near the floor) — same thresholds, same
+        bounded-program-set property as ``current_k``. No tree configured
+        = degenerate chain = byte-identical PR 14 behavior."""
+        with self._lock:
+            return self.current_plan_locked()
+
+    def current_plan_locked(self) -> tuple:
+        if self.tree is None:
+            return ("chain", self.current_k_locked())
+        g = self.global_ema
+        if g is None or g >= 0.6:
+            w = self.tree.width
+        elif g >= 0.3:
+            w = max(1, self.tree.width // 2)
+        else:
+            w = 1
+        return ("tree", w, self.tree.depth)
+
     # ---- observability
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "k": self.current_k_locked(),
+                "plan": list(self.current_plan_locked()),
                 "global_ema": self.global_ema,
                 "slots": {s: round(e, 4)
                           for s, (e, _) in self._slot_ema.items()},
@@ -339,6 +543,9 @@ class SpecPrograms:
         self.enter = jax.jit(self._enter_impl)
         self.prime = jax.jit(self._prime_impl)
         self.step = jax.jit(self._step_impl, static_argnames=("k", "mode"))
+        self.tree_step = jax.jit(
+            self._tree_step_impl,
+            static_argnames=("width", "depth", "mode"))
         self.decode = jax.jit(self._decode_pending_impl,
                               static_argnames=("K",))
         self.settle = jax.jit(self._settle_impl)
@@ -504,6 +711,177 @@ class SpecPrograms:
         # ragged advance: each row's cursor moves by 1 + accepted (the old
         # pending plus the kept proposals); rejected-lane writes beyond the
         # new cursor are dead — masked by causal position until overwritten
+        adv = jnp.where(participate, 1 + a, 0)
+        pos = pos + adv
+        tcache = dict(tcache)
+        tcache["len"] = t_len0 + adv
+        dcache = dict(dcache)
+        dcache["len"] = d_len0 + jnp.where(drow, adv, 0)
+        return (emitted, a, tcache, dcache, pending, pos, new_remaining,
+                new_active, rng)
+
+    # ---- the tree super-step: draft W chains of depth D, verify once
+    def _tree_step_impl(self, tparams, dparams, lora, tcache, dcache,
+                       pending, pos, remaining, active, rng, temps, top_ps,
+                       stops, adapter_idx, spec_on, *, width: int,
+                       depth: int, mode: str = "topp"):
+        """The ``_step_impl`` shape with a TREE of drafts per slot: W
+        parallel chains of depth D sharing the pending root, flattened into
+        ``1 + W*D`` verify columns under the branch ancestry mask, ONE
+        target forward, longest-surviving-path acceptance
+        (``accept_tree_tokens``). Draft cost equals chain ``k = D`` — one
+        pending forward plus D width-W forwards vs D+1 single-token
+        forwards — so any acceptance-length lift is free at the draft.
+
+        Tree windows BREAK the chain's stale-lane safety argument (a
+        rejected sibling shares its rope position with an accepted one, so
+        causal masking alone would admit it on a later read); after
+        acceptance the chosen path is compacted into the contiguous cursor
+        lanes and every other window lane's position is scrubbed to the
+        sentinel (``compact_window``), restoring the chain invariant the
+        settle / export / migration paths assume."""
+        W, D = width, depth
+        T = 1 + W * D
+        S = pending.shape[0]
+        participate = active
+        drow = participate & spec_on
+        d_len0 = dcache["len"]
+        t_len0 = tcache["len"]
+        exact = mode == "topp"
+
+        # ---- draft: the pending root, then D width-W tree forwards (the
+        # last one exists only to write the leaves' KV — samples discarded)
+        dlogits, dcache = forward(
+            dparams, pending[:, None], self.dcfg, positions=pos[:, None],
+            attention_mask=drow[:, None].astype(jnp.int32),
+            cache=dcache, compute_dtype=jnp.bfloat16,
+        )
+        l0 = dlogits[:, -1]
+        if mode == "greedy":
+            # distinct top-W roots: at most one can match the target
+            # argmax, and the verify walks every branch anyway
+            _, topw = jax.lax.top_k(l0, W)
+            cur = topw.astype(jnp.int32)                        # [S, W]
+            q0 = jnp.zeros((S, 1), jnp.float32)  # placeholder, unused
+        else:
+            split = jax.vmap(lambda r: jax.random.split(r, W + 1))(rng)
+            rng = split[:, 0]
+            cur = jnp.stack(
+                [jax.vmap(_sample_jit)(l0, temps, top_ps, split[:, 1 + b])
+                 for b in range(W)], axis=1)                    # iid from q0
+            q0 = jax.vmap(
+                lambda lg, t, tp: sampling_probs(lg, t, tp,
+                                                 exact_topp=exact)
+            )(l0, temps, top_ps)
+        d_depth, q_depth = [cur], [q0]
+        for j in range(1, D + 1):
+            wmask = jnp.asarray(tree_draft_mask(W, j))
+            dlogits, dcache = forward(
+                dparams, cur, self.dcfg,
+                positions=jnp.broadcast_to((pos + j)[:, None], (S, W)),
+                attention_mask=jnp.broadcast_to(
+                    drow[:, None], (S, W)).astype(jnp.int32),
+                cache=dcache, compute_dtype=jnp.bfloat16,
+                window_mask=jnp.broadcast_to(
+                    wmask[None], (S, W, 1 + j * W)),
+                window_start=d_len0,
+            )
+            if j == D:
+                break
+            if mode == "greedy":
+                cur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                qj = jnp.zeros((S, W, 1), jnp.float32)
+            else:
+                split = jax.vmap(lambda r: jax.random.split(r, W + 1))(rng)
+                rng = split[:, 0]
+                cur = jnp.stack(
+                    [jax.vmap(_sample_jit)(dlogits[:, b], temps, top_ps,
+                                           split[:, 1 + b])
+                     for b in range(W)], axis=1)
+                qj = jax.vmap(
+                    lambda row, t, tp: jax.vmap(
+                        lambda lg: sampling_probs(lg, t, tp,
+                                                  exact_topp=exact))(row)
+                )(dlogits, temps, top_ps)                       # [S, W, V]
+            d_depth.append(cur)
+            q_depth.append(qj)
+        d_toks = jnp.stack(d_depth, axis=1)                     # [S, D, W]
+
+        # ---- verify: ONE target forward over the flattened tree
+        vtoks = jnp.concatenate(
+            [pending[:, None], d_toks.reshape(S, D * W)], axis=1)
+        depth_of = np.concatenate(
+            [[0]] + [[j] * W for j in range(1, D + 1)]).astype(np.int32)
+        vpos = pos[:, None] + jnp.asarray(depth_of)[None, :]
+        vmask = jnp.concatenate(
+            [participate[:, None],
+             jnp.broadcast_to(drow[:, None], (S, D * W))], axis=1)
+        wmask_v = jnp.asarray(tree_verify_mask(W, D))
+        vlogits, tcache = forward(
+            tparams, vtoks, self.tcfg, positions=vpos,
+            attention_mask=vmask.astype(jnp.int32), cache=tcache, lora=lora,
+            lora_adapter_idx=(adapter_idx if lora is not None else None),
+            compute_dtype=jnp.bfloat16,
+            window_mask=jnp.broadcast_to(wmask_v[None], (S, T, T)),
+            window_start=t_len0,
+        )
+        if mode == "greedy":
+            tgt = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [S, T]
+            pred = np.zeros((D, W), np.int64)  # parent column per node
+            for j in range(1, D):
+                for b in range(W):
+                    pred[j, b] = _tree_col(j, b, W)
+            ok = (d_toks == tgt[:, pred]) & drow[:, None, None]
+            a_per_b = jnp.sum(
+                jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)  # [S, W]
+            b_sel = jnp.argmax(a_per_b, axis=1).astype(jnp.int32)
+            a = jnp.take_along_axis(a_per_b, b_sel[:, None], axis=1)[:, 0]
+            leaf = jnp.where(a == 0, 0, 1 + (a - 1) * W + b_sel)
+            extra = jnp.take_along_axis(tgt, leaf[:, None], axis=1)[:, 0]
+        else:
+            p_cols = jax.vmap(
+                lambda row_logits, t, tp: jax.vmap(
+                    lambda lg: sampling_probs(lg, t, tp,
+                                              exact_topp=exact))(row_logits)
+            )(vlogits, temps, top_ps)                          # [S, T, V]
+            V = p_cols.shape[-1]
+            q_tree = jnp.stack(
+                [jnp.broadcast_to(q_depth[0][:, None], (S, W, V))]
+                + q_depth[1:], axis=1)                         # [S, D, W, V]
+            a, b_sel, extra, rng = jax.vmap(
+                lambda p, q, d, t, r, s: accept_tree_tokens(
+                    p, q, d, t, r, s, width=W, depth=D)
+            )(p_cols, q_tree, d_toks, temps, rng, drow)
+        a = jnp.where(participate, a, 0)
+        b_sel = jnp.where(drow, b_sel, 0)
+
+        # ---- emission: the chosen branch's accepted prefix + extra token
+        path = jnp.take_along_axis(
+            d_toks, b_sel[:, None, None], axis=2)[:, :, 0]      # [S, D]
+        idx = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
+        p_ext = jnp.concatenate(
+            [path, jnp.full((S, 1), -1, jnp.int32)], axis=1)
+        cand = jnp.where(idx < a[:, None], p_ext,
+                         jnp.where(idx == a[:, None], extra[:, None], -1))
+        is_stop = jnp.any(cand[:, :, None] == stops[:, None, :], axis=2) \
+            & (cand >= 0)
+        navail = a + 1
+        stop_idx = jnp.min(jnp.where(is_stop, idx, D + 2), axis=1)
+        n_emit = jnp.minimum(jnp.minimum(navail, stop_idx), remaining)
+        n_emit = jnp.where(participate, n_emit, 0)
+        emitted = jnp.where(idx < n_emit[:, None], cand, -1)
+        new_remaining = remaining - n_emit
+        new_active = participate & (n_emit == navail) & (new_remaining > 0)
+        pending = jnp.where(new_active, extra, pending)
+
+        # ---- compact the window: accepted path → contiguous cursor lanes,
+        # everything else scrubbed to the sentinel (both caches share the
+        # window column layout)
+        src_cols = 1 + jnp.arange(D, dtype=jnp.int32)[None, :] * W \
+            + b_sel[:, None]
+        tcache = compact_window(tcache, participate, t_len0, src_cols, a,
+                                pos, T)
+        dcache = compact_window(dcache, drow, d_len0, src_cols, a, pos, T)
         adv = jnp.where(participate, 1 + a, 0)
         pos = pos + adv
         tcache = dict(tcache)

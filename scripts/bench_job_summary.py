@@ -111,6 +111,26 @@ def main() -> int:
                       f"plain_steps={adv.get('plain_steps')}) · "
                       f"tpot p50 {adv_on.get('tpot_ms_p50')}ms vs "
                       f"{adv_off.get('tpot_ms_p50')}ms off")
+            # tree-draft sub-run: accept-length p50 tree vs chain at equal
+            # draft cost is the headline; the adversarial verdict proves
+            # never-slower carries over to trees
+            tr = sp.get("tree")
+            if isinstance(tr, dict):
+                tc = tr.get("contested") or {}
+                cc = tr.get("chain_contested") or {}
+                row += ("\n  - spec tree "
+                        f"`{tr.get('spec_tree')}`: accept_len p50 "
+                        f"{tc.get('accept_len_p50')} tree vs "
+                        f"{cc.get('accept_len_p50')} chain "
+                        f"(lift {tr.get('accept_len_p50_lift')}) · "
+                        f"tpot ratio {tc.get('tpot_p50_ratio')} tree vs "
+                        f"{cc.get('tpot_p50_ratio')} chain "
+                        f"(tree<=chain: {tr.get('tpot_ratio_le_chain')})")
+                tadv = tr.get("adversarial") or {}
+                row += (" · adversarial: controller "
+                        + ("**disabled tree spec**"
+                           if tadv.get("controller_disabled")
+                           else "STILL ACTIVE"))
         # KV-overcommit capacity twin: peak concurrent sessions at one
         # block budget is the headline; blocks-per-session and preemption
         # round-trips show HOW the extra sessions fit

@@ -140,13 +140,19 @@ class _StatsEngine:
         # speculative-decoding document: builds every dtx_serving_spec_*
         # series (incl. the per-adapter/per-slot EMA gauges) AND feeds the
         # gateway's per-replica acceptance gauge through replica stats
+        # the tree sub-document turns the dtx_serving_spec_tree_* families
+        # on (steps counter, width/depth gauges, per-slot path-length EMA)
+        # so both the serving pass and the gateway's replica-stats pass
+        # lint them
         return {"enabled": True, "mode": "auto", "draft": "take:2",
                 "k_max": 4, "k": 2, "accept_rate": 0.62,
                 "adapter_accept_rate": {"": 0.7, "tenant-a": 0.5},
                 "slot_accept_rate": {0: 0.62}, "slots_off": [],
                 "active": True, "disabled_events": 1,
                 "proposed": 40, "accepted": 25, "row_steps": 10,
-                "spec_steps": 10, "plain_steps": 3}
+                "spec_steps": 10, "plain_steps": 3, "tree_steps": 6,
+                "tree": {"spec": "4x3", "width": 4, "depth": 3,
+                         "plan_width": 2, "slot_path_len": {0: 1.8}}}
 
     def chat(self, messages, **kw):
         return "ok"

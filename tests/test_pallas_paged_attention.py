@@ -203,3 +203,179 @@ def test_decode_step_wrapper_shape():
         paged_attention_decode_step(
             jax.random.normal(key, (B, 2, H, d)), kp, vp, None, None, cache,
             pos, jnp.asarray([[8, 9], [3, 4]], jnp.int32))
+
+
+# --------------------------------------- multi-token (bucketed q_len) kernel
+
+from datatunerx_tpu.ops.attention import attention_allow  # noqa: E402
+from datatunerx_tpu.ops.pallas_paged_attention import (  # noqa: E402
+    paged_multitoken_attention,
+)
+
+
+def _gathered_view(kp, vp, ks, vs, tables, pos, dtype):
+    """The gather oracle's linear view: clamped-table gather, dequant,
+    sentinel-masked positions — what the model biases over."""
+    B = tables.shape[0]
+    tbl = jnp.where(tables >= 0, tables, 0)
+    k_all = kp[tbl].reshape(B, -1, kp.shape[-2], kp.shape[-1])
+    v_all = vp[tbl].reshape(B, -1, vp.shape[-2], vp.shape[-1])
+    if ks is not None:
+        k_all = kv_dequantize(k_all, ks[tbl].reshape(B, -1, ks.shape[-1]),
+                              dtype)
+        v_all = kv_dequantize(v_all, vs[tbl].reshape(B, -1, vs.shape[-1]),
+                              dtype)
+    else:
+        k_all, v_all = k_all.astype(dtype), v_all.astype(dtype)
+    kv_pos = pos[tbl]
+    kv_pos = jnp.where((tables >= 0)[:, :, None], kv_pos, POS_SENTINEL)
+    return k_all, v_all, kv_pos.reshape(B, -1)
+
+
+def _run_mt(B=2, NB=8, nbps=3, KV=2, G=2, d=16, lens=(17, 5), T=3,
+            dtype=jnp.float32, quant=False, tables=None, seed=0,
+            window=None):
+    """Multi-token kernel vs the gather oracle. Queries sit on the last T
+    written lanes per slot (the post-write verify/chunk shape), so every
+    row has a DIFFERENT causal offset on a ragged batch. ``window=WN``
+    additionally carves a random branch mask over the last WN lanes — the
+    tree-verify operand (requires lens[b] > WN so no row is fully
+    masked)."""
+    H = KV * G
+    key = jax.random.PRNGKey(seed)
+    if tables is None:
+        rows = []
+        nxt = 0
+        for b in range(B):
+            need = max(1, -(-int(lens[b]) // BS))
+            row = list(range(nxt, nxt + need)) + [-1] * (nbps - need)
+            nxt += need
+            rows.append(row)
+        tables = jnp.asarray(rows, jnp.int32)
+    kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, NB, KV, d, lens, tables,
+                                           dtype=dtype, quant=quant)
+    q = jax.random.normal(jax.random.fold_in(key, 7),
+                          (B, T, H, d)).astype(dtype)
+    q_positions = jnp.asarray(
+        [[max(int(lens[b]) - T + t, t) for t in range(T)]
+         for b in range(B)], jnp.int32)
+    k_all, v_all, kv_pos = _gathered_view(kp, vp, ks, vs, tables, pos, dtype)
+    window_mask = window_start = None
+    if window is not None:
+        assert all(int(x) > window for x in lens)
+        window_mask = jax.random.bernoulli(
+            jax.random.fold_in(key, 13), 0.6, (B, T, window))
+        window_start = jnp.asarray(
+            [int(x) - window for x in lens], jnp.int32)
+    allow = attention_allow(q_positions, kv_pos, window_mask=window_mask,
+                            window_start=window_start)
+    got = paged_multitoken_attention(q, kp, vp, ks, vs, tables, allow)
+    bias = make_causal_bias(q_positions, kv_pos, window_mask=window_mask,
+                            window_start=window_start)
+    want = xla_attention(q.astype(dtype), k_all, v_all, bias)
+    assert got.dtype == q.dtype
+    return np.asarray(got, np.float32), np.asarray(want, np.float32)
+
+
+def test_multitoken_matches_gather_f32():
+    got, want = _run_mt()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multitoken_q_len_one_degenerate():
+    """T=1 through the multi-token path must equal the oracle too — the
+    bucketed kernel's smallest bucket, not a special case."""
+    got, want = _run_mt(T=1, lens=(17, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multitoken_ragged_causal_offsets():
+    """Ragged depths: each row's T queries carry row-specific absolute
+    positions, so the per-row causal frontier differs across the batch —
+    the chunked-prefill shape."""
+    got, want = _run_mt(B=3, NB=10, nbps=4, lens=(25, 9, 4), T=4)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multitoken_gqa_int8_dequant_inside_kernel():
+    """GQA head mapping and int8 dequant together: 3 query heads share
+    each of 4 kv heads, and the kernel dequantizes the int8 pools by
+    their scales before the same two-pass arithmetic."""
+    got, want = _run_mt(KV=4, G=3, d=8, lens=(11, 20), T=3, quant=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_multitoken_bf16_matches_oracle_bitwise():
+    """The serving dtype: same per-block normalize-then-cast rounding as
+    the decode kernel, so bf16 outputs are BITWISE oracle-equal — the
+    engine token-parity guarantee for chunked prefill + verify columns."""
+    got, want = _run_mt(dtype=jnp.bfloat16, lens=(17, 6), T=3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_multitoken_bf16_int8_matches_oracle_bitwise():
+    got, want = _run_mt(dtype=jnp.bfloat16, quant=True, lens=(12, 23), T=5)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_multitoken_tree_branch_window_mask():
+    """The tree-verify operand: a random per-(row, column) branch mask over
+    the step's own window of lanes. Inside the window the mask AND causal
+    both gate (siblings share rope positions); outside, plain causal — the
+    kernel must agree with the oracle biased by the SAME allow tensor."""
+    got, want = _run_mt(lens=(17, 9), T=3, window=4)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    got, want = _run_mt(lens=(17, 9), T=3, window=4, quant=True,
+                        dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lower_triangular_window_mask_is_chain():
+    """A lower-triangular window mask over the queries' own lanes adds
+    nothing beyond causality — chain verify semantics reproduce exactly,
+    which is why the chain path never builds a mask."""
+    B, T, lens = 2, 3, (17, 9)
+    key = jax.random.PRNGKey(5)
+    tables = jnp.asarray([[0, 1, 2], [3, 4, -1]], jnp.int32)
+    kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, 8, 2, 16, lens, tables)
+    q_positions = jnp.asarray(
+        [[int(x) - T + t for t in range(T)] for x in lens], jnp.int32)
+    _, _, kv_pos = _gathered_view(kp, vp, ks, vs, tables, pos, jnp.float32)
+    tri = jnp.broadcast_to(
+        jnp.tril(jnp.ones((T, T), bool))[None], (B, T, T))
+    start = jnp.asarray([int(x) - T for x in lens], jnp.int32)
+    with_mask = attention_allow(q_positions, kv_pos, window_mask=tri,
+                                window_start=start)
+    without = attention_allow(q_positions, kv_pos)
+    np.testing.assert_array_equal(np.asarray(with_mask),
+                                  np.asarray(without))
+
+
+def test_multitoken_empty_slot_yields_finite_output():
+    tables = jnp.asarray([[0, 1, -1], [-1, -1, -1]], jnp.int32)
+    got, _ = _run_mt(B=2, NB=4, nbps=3, lens=(10, 0), T=3, tables=tables)
+    assert np.isfinite(got).all()
+    np.testing.assert_array_equal(got[1], 0.0)
+
+
+def test_multitoken_step_wrapper_shape_and_allow_contract():
+    from datatunerx_tpu.ops.pallas_paged_attention import (
+        paged_attention_multitoken_step,
+    )
+
+    B, KV, G, d, nbps, NB, T = 2, 2, 2, 8, 2, 4, 3
+    H = KV * G
+    key = jax.random.PRNGKey(3)
+    tables = jnp.asarray([[0, 1], [2, -1]], jnp.int32)
+    kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, NB, KV, d, (9, 4), tables)
+    q = jax.random.normal(key, (B, T, H, d))
+    allow = jnp.ones((B, T, nbps * BS), bool)
+    cache = {"block_tables": tables}
+    out = paged_attention_multitoken_step(q, kp, vp, None, None, cache,
+                                          allow)
+    assert out.shape == (B, T, H, d)
+    with pytest.raises(AssertionError, match="allow"):
+        paged_attention_multitoken_step(
+            q, kp, vp, None, None, cache,
+            jnp.ones((B, T + 1, nbps * BS), bool))

@@ -167,6 +167,68 @@ def test_export_import_spec_active_session(paged_pair):
         dst.close()
 
 
+def test_export_import_spec_tree_active_session(paged_pair):
+    """A TREE-SPEC-ACTIVE session exports cleanly: the settle collapses
+    the in-flight verify columns to the standard logits-form wire format
+    (no tree state on the wire), and the greedy continuation is
+    token-exact — into a tree replica, and into a PLAIN replica that has
+    never heard of trees."""
+    ref, plain_dst = paged_pair
+    # same config as test_speculative's tree engine, so the tree program
+    # family compiles once per suite run (weak take:1 draft — the export
+    # interrupts REAL rejection/rollback traffic, not an all-accept run)
+    src = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=3, decode_chunk=4, kv_block_size=16,
+                        spec_draft="take:1", spec_k=3, spec_mode="on",
+                        spec_tree="2x2")
+    # the tree importer is the EXPORTER itself: its slot freed at export,
+    # so the import lands in a fresh slot of the same tree engine
+    dst = src
+    try:
+        prompt = src.tokenizer.encode("tree sessions migrate too")
+        want = ref.generate(prompt, max_new_tokens=16)
+
+        orig = src._spec_decode_tick
+
+        def slow(*a, **k):
+            time.sleep(0.04)
+            return orig(*a, **k)
+
+        src._spec_decode_tick = slow
+        try:
+            req = src.submit(prompt, max_new_tokens=16)
+            deadline = time.monotonic() + 30
+            while len(req.tokens) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(req.tokens) >= 3
+            doc = src.export_sessions()
+        finally:
+            src._spec_decode_tick = orig
+        assert len(doc["sessions"]) == 1, doc
+        assert req.done.wait(10)
+        payload = doc["sessions"][0]
+        # the exporter really was mid-TREE decode, not chain, and the
+        # settle collapsed it; the wire format is tree-agnostic
+        assert src.spec_info()["tree_steps"] > 0
+        assert any(ev[0] == "spec_settle" for ev in src.sched_trace)
+        assert "tree" not in json.dumps(payload)
+
+        n_prime0 = sum(1 for ev in dst.sched_trace if ev[0] == "spec_prime")
+        steps0 = dst.spec_info()["tree_steps"]
+        handle, _ = _import_and_wait(dst, payload)
+        assert handle.tokens == want, (handle.tokens, want)
+        # the tree importer re-primed and kept tree-verifying after import
+        assert sum(1 for ev in dst.sched_trace
+                   if ev[0] == "spec_prime") > n_prime0
+        assert dst.spec_info()["tree_steps"] > steps0
+
+        # the SAME payload lands on a plain replica too: tree → plain
+        handle2, _ = _import_and_wait(plain_dst, payload)
+        assert handle2.tokens == want, (handle2.tokens, want)
+    finally:
+        src.close()
+
+
 def test_export_import_int8_kv_parity():
     """int8 kv_quant engines ship their cache's own int8+scale bytes —
     the 'int8 over the wire' path is EXACT for them, greedy and sampled."""

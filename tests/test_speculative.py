@@ -422,3 +422,264 @@ def test_router_prefers_spec_replicas():
     picks = {router.route(prefer_spec=False).name for _ in range(6)}
     assert picks == {"spec", "plain", "collapsed"}  # non-spec-friendly: all
     assert router.spec_routes["preferred"] > 0
+
+
+# ------------------------------------------------------- tree-draft units
+
+import jax.numpy as jnp  # noqa: E402
+
+from datatunerx_tpu.serving.speculative import (  # noqa: E402
+    TreeSpec,
+    accept_tree_tokens,
+    parse_spec_tree,
+    tree_draft_mask,
+    tree_verify_mask,
+)
+
+
+def test_parse_spec_tree_and_validation():
+    t = parse_spec_tree("4x3")
+    assert (t.width, t.depth) == (4, 3)
+    assert t.step_tokens == 13  # pending + 4*3 nodes
+    assert str(t) == "4x3"
+    assert parse_spec_tree("1X1") == TreeSpec(1, 1)
+    for bad in ("", "4", "4x", "x3", "4x3x2", "axb"):
+        with pytest.raises(ValueError, match="WxD"):
+            parse_spec_tree(bad)
+    for oob in ("0x3", "65x2", "4x0", "4x17"):
+        with pytest.raises(ValueError, match="out of range"):
+            parse_spec_tree(oob)
+
+
+def test_tree_verify_mask_ancestry():
+    # W=2, D=2 — columns: 0 pending, 1=(d1,b0), 2=(d1,b1), 3=(d2,b0),
+    # 4=(d2,b1). Each node sees the root + ITS OWN chain, never a sibling.
+    want = np.array([[1, 0, 0, 0, 0],
+                     [1, 1, 0, 0, 0],
+                     [1, 0, 1, 0, 0],
+                     [1, 1, 0, 1, 0],
+                     [1, 0, 1, 0, 1]], bool)
+    np.testing.assert_array_equal(tree_verify_mask(2, 2), want)
+    # degenerate 1-wide tree IS the chain: lower-triangular
+    np.testing.assert_array_equal(tree_verify_mask(1, 3),
+                                  np.tril(np.ones((4, 4), bool)))
+
+
+def test_tree_draft_mask_own_path_only():
+    np.testing.assert_array_equal(
+        tree_draft_mask(2, 1), np.array([[1, 1, 0], [1, 0, 1]], bool))
+    np.testing.assert_array_equal(
+        tree_draft_mask(2, 2),
+        np.array([[1, 1, 0, 1, 0], [1, 0, 1, 0, 1]], bool))
+
+
+def test_accept_tree_greedy_longest_surviving_path():
+    """Greedy tree acceptance = sequential argmax decode by construction:
+    a node survives iff its token matches the target argmax at its parent
+    column; the deepest surviving branch wins; the extra token is the
+    argmax at the divergence point."""
+    V, W, D = 8, 2, 2
+    # target argmaxes: col0→2, col1→4, col2→5, col3→1, col4→7
+    p = np.zeros((1 + W * D, V), np.float32)
+    for c, tok in enumerate((2, 4, 5, 1, 7)):
+        p[c, tok] = 1.0
+    q = jnp.zeros((D, W, V), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+
+    def run(d_toks, spec_on=True):
+        a, b, extra, _ = accept_tree_tokens(
+            jnp.asarray(p), q, jnp.asarray(d_toks, jnp.int32), 0.0, rng,
+            spec_on, width=W, depth=D)
+        return int(a), int(b), int(extra)
+
+    # branch 0 survives both depths → full path + bonus at its leaf
+    assert run([[2, 3], [4, 0]]) == (2, 0, 1)
+    # branch 1 is the survivor (branch 0 dies at depth 1)
+    assert run([[3, 2], [0, 5]]) == (2, 1, 7)
+    # branch 0 survives depth 1 only; extra = argmax at its depth-1 col
+    assert run([[2, 3], [0, 0]]) == (1, 0, 4)
+    # both branches die at depth 1 → plain step: argmax of the root dist
+    a, _, extra = run([[0, 1], [0, 0]])
+    assert (a, extra) == (0, 2)
+    # spec_on=False forces the plain step regardless of agreement
+    a, _, extra = run([[2, 3], [4, 0]], spec_on=False)
+    assert (a, extra) == (0, 2)
+
+
+def test_accept_tree_width1_matches_chain_rule():
+    """A 1-wide tree is a chain: greedy acceptance must agree with
+    accept_tokens on the same distributions (both count the agreeing
+    prefix and correct at the divergence)."""
+    V, D = 6, 3
+    rng = jax.random.PRNGKey(2)
+    p = np.zeros((D + 1, V), np.float32)
+    for i, tok in enumerate((2, 4, 1, 5)):
+        p[i, tok] = 1.0
+    q = np.zeros((D, V), np.float32)
+    q[:, 0] = 1.0
+    for d in ([2, 4, 0], [2, 4, 1], [0, 0, 0]):
+        a_c, extra_c, _ = accept_tokens(
+            jnp.asarray(p), jnp.asarray(q), jnp.asarray(d), 0.0, rng, True)
+        a_t, _, extra_t, _ = accept_tree_tokens(
+            jnp.asarray(p), jnp.asarray(q)[:, None],
+            jnp.asarray(d, jnp.int32)[:, None], 0.0, rng, True,
+            width=1, depth=D)
+        assert int(a_t) == int(a_c), d
+        assert int(extra_t) == int(extra_c), d
+
+
+def test_tree_sibling_rejection_is_distribution_exact():
+    """The SpecInfer guarantee, checked empirically: W iid siblings from a
+    badly-mismatched draft q, recursive-rejection acceptance against the
+    running residual — the emitted FIRST token's marginal over many keys
+    is EXACTLY the target p."""
+    V, W = 4, 2
+    p = np.asarray([0.5, 0.25, 0.15, 0.1], np.float32)
+    q0 = np.asarray([0.05, 0.05, 0.45, 0.45], np.float32)
+    # D=1: bonus rows never touch the FIRST emitted token
+    p_cols = jnp.asarray(np.stack([p] * (1 + W)))
+    q_tree = jnp.asarray(np.broadcast_to(q0, (1, W, V)).copy())
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    dkeys = jax.random.split(jax.random.PRNGKey(7), n)
+
+    def draw(kk):
+        k1, k2 = jax.random.split(kk)
+        return jnp.stack([jax.random.categorical(k1, jnp.log(q0)),
+                          jax.random.categorical(k2, jnp.log(q0))])
+
+    d0 = jax.vmap(draw)(dkeys).astype(jnp.int32)[:, None, :]  # [n, 1, W]
+
+    def one(key, d):
+        a, b, extra, _ = accept_tree_tokens(
+            p_cols, q_tree, d, 1.0, key, True, width=W, depth=1)
+        return jnp.where(a > 0, d[0, b], extra)
+
+    toks = np.asarray(jax.jit(jax.vmap(one))(keys, d0))
+    freq = np.bincount(toks, minlength=V) / n
+    np.testing.assert_allclose(freq, p, atol=0.04)
+
+
+def test_accept_tree_all_accept_edge():
+    """q == p → the FIRST sibling's ratio test always passes (u * q <= r
+    with r = p = q), so some branch is always accepted."""
+    V, W, D = 4, 3, 2
+    p = np.asarray([[0.4, 0.3, 0.2, 0.1]] * (1 + W * D), np.float32)
+    q = np.broadcast_to(np.asarray([0.4, 0.3, 0.2, 0.1], np.float32),
+                        (D, W, V)).copy()
+    for seed in range(8):
+        a, _, _, _ = accept_tree_tokens(
+            jnp.asarray(p), jnp.asarray(q),
+            jnp.zeros((D, W), jnp.int32), 1.0,
+            jax.random.PRNGKey(seed), True, width=W, depth=D)
+        assert int(a) >= 1
+
+
+# ------------------------------------------------ tree engine-level parity
+
+@pytest.fixture(scope="module")
+def tree_pair(paged_pair):
+    """The paged_pair's off twin plus a WEAK-draft 2x2 tree engine
+    (mode=on so the controller cannot stand down): rejections, branch
+    selection, window compaction and ragged per-row advance all run for
+    real against the identically-configured non-spec oracle."""
+    off, _ = paged_pair
+    on = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                       slots=3, decode_chunk=4, kv_block_size=16,
+                       spec_draft="take:1", spec_k=3, spec_mode="on",
+                       spec_tree="2x2")
+    yield off, on
+    on.close()
+
+
+def test_tree_greedy_token_exact_concurrent_and_no_leak(tree_pair):
+    """Greedy tree decode is token-exact vs the non-spec oracle — single
+    and concurrent ragged streams — and every block the tree's
+    (1 + W*D)-token window reservation took comes back: the
+    blocks_for_depth overshoot used the per-step token count, not the
+    chain's k+1."""
+    off, on = tree_pair
+    tok = off.tokenizer
+    free0 = on.free_kv_blocks
+    ids = tok.encode("hello world this is serving")
+    want = off.generate(ids, max_new_tokens=16)
+    got = on.generate(ids, max_new_tokens=16)
+    assert got == want, (got, want)
+    info = on.spec_info()
+    assert info["tree_steps"] > 0
+    assert info["tree"]["spec"] == "2x2"
+    # the weak draft was REJECTED sometimes — branch selection, rollback
+    # and window compaction all ran, and output still matched exactly
+    assert info["accepted"] < info["proposed"]
+
+    prompts = [tok.encode("first request about weather"),
+               tok.encode("second one"),
+               tok.encode("third request that is somewhat longer than both")]
+    want = [off.submit(p, max_new_tokens=8 + 4 * i)
+            for i, p in enumerate(prompts)]
+    got = [on.submit(p, max_new_tokens=8 + 4 * i)
+           for i, p in enumerate(prompts)]
+    for w, g in zip(want, got):
+        assert w.done.wait(180) and g.done.wait(180)
+        assert g.tokens == w.tokens, (g.tokens, w.tokens)
+    deadline = time.monotonic() + 10
+    while on.free_kv_blocks != free0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert on.free_kv_blocks == free0
+
+
+@pytest.mark.slow
+def test_tree_sampled_runs_and_respects_budget(tree_pair):
+    _, on = tree_pair
+    tok = on.tokenizer
+    ids = tok.encode("sampling prompt")
+    outs = {tuple(on.generate(ids, max_new_tokens=10, temperature=0.9,
+                              top_p=0.8, seed=s)) for s in range(2)}
+    assert all(len(o) <= 10 for o in outs)
+    assert len(outs) > 1
+
+
+def test_tree_overshoot_is_step_tokens(tree_pair):
+    """The satellite fix: reservation math takes the PER-STEP token count.
+    A 2x2 tree writes 1 + 2*2 = 5 tokens per verify step — more than the
+    chain's spec_k + 1 = 4 — so sizing overshoot by the chain formula
+    would overflow the reserved tail and corrupt a neighbor's block."""
+    _, on = tree_pair
+    assert on.spec_tree.step_tokens == 5
+    assert on._spec_overshoot == 5
+    assert on._tick_advance == 5  # max(decode_chunk=4, step_tokens)
+
+
+def test_tree_engine_validation_and_off_modes():
+    # tree without a draft is refused
+    with pytest.raises(ValueError, match="spec_draft_config"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                      spec_tree="2x2")
+    # malformed WxD is refused with the format named
+    with pytest.raises(ValueError, match="WxD"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=256, slots=2,
+                      spec_draft="take:1", spec_tree="nope")
+    # a tree that cannot fit the sequence budget is refused
+    with pytest.raises(ValueError, match="max_seq_len"):
+        BatchedEngine(MODEL, template="vanilla", max_seq_len=16, slots=2,
+                      kv_block_size=16, spec_draft="take:1",
+                      spec_tree="64x16")
+    # spec_mode=off ignores the tree entirely — byte-identical off path
+    eng = BatchedEngine(MODEL, template="vanilla", max_seq_len=256,
+                        slots=2, decode_chunk=4, kv_block_size=16,
+                        spec_draft="take:1", spec_mode="off",
+                        spec_tree="2x2")
+    try:
+        assert eng.spec is None and eng._spec_overshoot == 0
+    finally:
+        eng.close()
+
+
+def test_chain_engine_has_no_tree_surface(paged_pair):
+    """--spec_tree unset: spec_info carries no tree document and the
+    overshoot stays the chain's spec_k + 1 — the PR 14 engine unchanged."""
+    _, on = paged_pair
+    info = on.spec_info()
+    assert "tree" not in info
+    assert on.spec_tree is None
+    assert on._spec_overshoot == 4  # spec_k=3 → k+1
