@@ -85,6 +85,10 @@ class AdapterRegistry:
         # instead of polling out the FIFO-head's wait
         self._on_load_done = on_load_done
         self._lock = threading.RLock()
+        # live async loader threads (pruned on spawn, joined by close());
+        # without this a teardown mid-load leaves a worker mutating a
+        # dead registry — the SAN002 thread-leak shape
+        self._loader_threads: List[threading.Thread] = []
         self._entries: Dict[str, _Entry] = {}
         # resident names in LRU order (front = coldest); pinned entries are
         # skipped by eviction, not reordered out
@@ -268,8 +272,12 @@ class AdapterRegistry:
                     self.stats["misses"] += 1
                     ent.loading = True
                     ent.event = threading.Event()
-                    threading.Thread(target=self._load_worker,
-                                     args=(ent, slot), daemon=True).start()
+                    t = threading.Thread(target=self._load_worker,
+                                         args=(ent, slot), daemon=True)
+                    self._loader_threads = [
+                        x for x in self._loader_threads if x.is_alive()]
+                    self._loader_threads.append(t)
+                    t.start()
                 ev = ent.event
             if not wait:
                 return None
@@ -280,6 +288,18 @@ class AdapterRegistry:
             ent = self._entries.get(name)
             if ent is not None and ent.refs > 0:
                 ent.refs -= 1
+
+    def close(self, timeout: float = 10.0):
+        """Wait out in-flight async loads so no loader thread outlives the
+        registry's owner (the engine joins its scheduler first, then calls
+        this). Loads signal their waiters either way; ``timeout`` bounds a
+        wedged checkpoint read from wedging shutdown."""
+        with self._lock:
+            threads = [t for t in self._loader_threads if t.is_alive()]
+            self._loader_threads = []
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
 
     def preload(self, name: str):
         """Warm an adapter without pinning it (admin POST with load=true):

@@ -103,6 +103,12 @@ class AdapterStore:
             }
         self._scales = jnp.zeros((E,), jnp.float32)
         self.tree: Tuple[dict, jnp.ndarray] = self._republish()
+        # Warm the clear() update programs now (clearing slot 1 is a no-op
+        # on freshly zeroed buffers): the scalar .set(0.0) traces a
+        # different program than insert's array .set, and without this the
+        # FIRST eviction paid that compile mid-serving — caught by the
+        # SAN003 compile_budget(0) window around runtime load/unload.
+        self.clear(1)
 
     # ------------------------------------------------------------- geometry
     def geometry(self) -> tuple:

@@ -64,8 +64,13 @@ from datatunerx_tpu.analysis.rules.blocking import (
 )
 from datatunerx_tpu.analysis.rules.concurrency import param_disposition
 from datatunerx_tpu.analysis.rules.host_sync import sync_label
+from datatunerx_tpu.analysis.rules.lockorder import (
+    function_lock_info,
+    lock_context_id,
+    shortest_path,
+)
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3  # v3: lock_acquires / lock_edges / lock_id (DTX011)
 
 Node = Tuple[str, str]  # (abs file path, qualname)
 
@@ -88,10 +93,12 @@ def _call_sites(ctx: ModuleContext, fn_node: ast.AST,
 
 
 def _locked_calls(ctx: ModuleContext, qualname: str, fn_node: ast.AST,
-                  seen: Set[Tuple[int, int]]) -> List[dict]:
+                  seen: Set[Tuple[int, int]],
+                  cls: Optional[str] = None) -> List[dict]:
     """Calls under a lock that are NOT directly blocking (those are the
     per-module DTX009's) but resolve to a local function or an imported
-    dotted name — the program pass follows them through the graph."""
+    dotted name — the program pass follows them through the graph (DTX009
+    transitively; DTX011 via the held lock's contextualized id)."""
     out: List[dict] = []
     for call, lock in calls_under_lock(ctx, fn_node):
         key = (call.lineno, call.col_offset)
@@ -100,7 +107,8 @@ def _locked_calls(ctx: ModuleContext, qualname: str, fn_node: ast.AST,
         seen.add(key)
         if blocking_label(ctx, call):
             continue
-        entry = {"line": call.lineno, "col": call.col_offset, "lock": lock}
+        entry = {"line": call.lineno, "col": call.col_offset, "lock": lock,
+                 "lock_id": lock_context_id(ctx.module, cls, lock)}
         local = ctx.graph.call_target(call.func, qualname)
         if local:
             entry["local"] = local
@@ -137,8 +145,11 @@ def build_summary(ctx: ModuleContext) -> dict:
             "sync_sites": _call_sites(ctx, info.node, sync_label),
             "blocking_sites": _call_sites(ctx, info.node, blocking_label),
             "locked_calls": _locked_calls(ctx, qualname, info.node,
-                                          locked_seen),
+                                          locked_seen, cls=info.cls),
         }
+        acquires, lock_edges = function_lock_info(ctx, info)
+        entry["lock_acquires"] = acquires
+        entry["lock_edges"] = lock_edges
         if "." not in qualname:  # module-level fn: DTX007 adjudication data
             a = info.node.args
             entry["params"] = [p.arg for p in a.posonlyargs + a.args]
@@ -430,6 +441,114 @@ def _program_dtx009(prog: ProgramGraph) -> List[Finding]:
     return out
 
 
+def _program_dtx011(prog: ProgramGraph) -> List[Finding]:
+    """Lock-order inversions over the program graph: lexical nesting
+    edges from every module, plus call-chain edges — a call made under a
+    lock to a function whose reachable closure (call-only edges, DTX009's
+    reachability) acquires another lock. Cycles are potential ABBA
+    deadlocks; cycles provable from ONE module's lexical edges alone are
+    the per-module DTX011's and are skipped here."""
+    # lock-id edge → evidence {display, line, col, kind, mod, desc}
+    edges: Dict[Tuple[str, str], dict] = {}
+
+    def note(a: str, b: str, ev: dict):
+        edges.setdefault((a, b), ev)
+
+    # reachable lock acquisitions per node (over call-only edges)
+    acq_memo: Dict[Node, Dict[str, Tuple[Node, int]]] = {}
+
+    def reach_acquires(start: Node) -> Dict[str, Tuple[Node, int]]:
+        hit = acq_memo.get(start)
+        if hit is not None:
+            return hit
+        found: Dict[str, Tuple[Node, int]] = {}
+        seen: Set[Node] = set()
+        stack = [start]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            f = prog.records[cur[0]]["summary"]["functions"].get(cur[1])
+            if f is None:
+                continue
+            for lid, ln in f.get("lock_acquires", ()):
+                found.setdefault(lid, (cur, ln))
+            stack.extend(prog.call_edges_of(cur))
+        acq_memo[start] = found
+        return found
+
+    for path in sorted(prog.records):
+        rec = prog.records[path]
+        s = rec["summary"]
+        for q in sorted(s["functions"]):
+            f = s["functions"][q]
+            for a, b, ln in f.get("lock_edges", ()):
+                note(a, b, {"display": rec["display"], "line": ln,
+                            "col": 0, "kind": "lex", "mod": path,
+                            "desc": f"{b} acquired in {q} while holding "
+                                    f"{a}"})
+            for lc in f.get("locked_calls", ()):
+                held = lc.get("lock_id")
+                if not held:
+                    continue
+                if "local" in lc:
+                    target: Optional[Node] = (path, lc["local"])
+                    if lc["local"] not in s["functions"]:
+                        target = None
+                    name = lc["local"]
+                else:
+                    target = prog.resolve(lc["ext"])
+                    name = lc["ext"]
+                if target is None:
+                    continue
+                for lid, (leaf, lln) in sorted(reach_acquires(target)
+                                               .items()):
+                    if lid == held:
+                        continue
+                    leaf_disp = prog.records[leaf[0]]["display"]
+                    note(held, lid, {
+                        "display": rec["display"], "line": lc["line"],
+                        "col": lc["col"], "kind": "call", "mod": path,
+                        "desc": f"{name}() called in {q} while holding "
+                                f"{lc['lock']} acquires {lid} at "
+                                f"{leaf_disp}:{lln} (via the program "
+                                "call graph)"})
+
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    out: List[Finding] = []
+    seen_cycles: Set[frozenset] = set()
+    for (a, b) in sorted(edges):
+        path_ids = shortest_path(graph, b, a)
+        if path_ids is None:
+            continue
+        cycle = [a] + path_ids
+        key = frozenset(cycle)
+        if key in seen_cycles:
+            continue
+        seen_cycles.add(key)
+        cycle_edges = [edges[(cycle[i], cycle[i + 1])]
+                       for i in range(len(cycle) - 1)
+                       if (cycle[i], cycle[i + 1]) in edges]
+        if cycle_edges and all(e["kind"] == "lex" for e in cycle_edges) \
+                and len({e["mod"] for e in cycle_edges}) == 1:
+            continue  # single-module lexical cycle: per-module DTX011's
+        ev = edges[(a, b)]
+        back = edges.get((cycle[-2], a))
+        back_at = (f"{back['display']}:{back['line']}" if back else "?")
+        chain = " -> ".join(cycle)
+        out.append(Finding(
+            "DTX011", ev["display"], ev["line"], ev["col"],
+            f"lock-order inversion: {ev['desc']}, but the opposite order "
+            f"is taken at {back_at} (cycle {chain}) — two threads "
+            "interleaving these paths deadlock; acquire in one global "
+            "order",
+            "error"))
+    return out
+
+
 # -------------------------------------------------------------- the runner
 
 @dataclass
@@ -586,6 +705,8 @@ def lint_program(paths: Sequence[str], config: Optional[LintConfig] = None,
             raw.extend(_program_dtx007(prog))
         if "DTX009" in wanted and rule_enabled(config, "DTX009"):
             raw.extend(_program_dtx009(prog))
+        if "DTX011" in wanted and rule_enabled(config, "DTX011"):
+            raw.extend(_program_dtx011(prog))
         kept, suppressed = _filter_program_findings(raw, records, config)
         result.findings.extend(kept)
         result.suppressed += suppressed

@@ -9,9 +9,11 @@ from datatunerx_tpu.analysis.rules.blocking import BlockingUnderLock
 from datatunerx_tpu.analysis.rules.concurrency import LockDiscipline, ResourceLeak
 from datatunerx_tpu.analysis.rules.donation import DonatedBufferReuse
 from datatunerx_tpu.analysis.rules.host_sync import HostSyncInHotPath
+from datatunerx_tpu.analysis.rules.lockorder import LockOrderInversion
 from datatunerx_tpu.analysis.rules.prng import PRNGKeyReuse
 from datatunerx_tpu.analysis.rules.retrace import JitInLoop, ModuleImportDeviceWork
 from datatunerx_tpu.analysis.rules.sharding import MeshAxisDrift
+from datatunerx_tpu.analysis.rules.thread_shutdown import ThreadShutdownEvidence
 from datatunerx_tpu.analysis.rules.tracer import TracerControlFlow
 
 RULE_CLASSES = (
@@ -25,6 +27,8 @@ RULE_CLASSES = (
     ModuleImportDeviceWork,  # DTX008
     BlockingUnderLock,    # DTX009
     DonatedBufferReuse,   # DTX010
+    LockOrderInversion,   # DTX011
+    ThreadShutdownEvidence,  # DTX012
 )
 
 

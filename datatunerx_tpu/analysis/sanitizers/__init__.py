@@ -1,0 +1,49 @@
+"""dtxsan — the runtime sanitizer plane (ISSUE 19).
+
+dtxlint (``analysis/``) proves concurrency discipline STATICALLY; this
+package proves the dynamic half under the real test and chaos-replay
+harnesses. Three sanitizers, all stdlib-only (jax is imported lazily and
+only by the compile sanitizer):
+
+  * **SAN001 lock-order** (`lockorder.py`) — wraps ``threading.Lock`` /
+    ``RLock`` construction so every unbounded blocking acquisition
+    records held→acquired edges in a global lock-order graph keyed by
+    the locks' allocation sites; cycles are potential ABBA deadlocks and
+    are reported with BOTH acquisition stacks. ``# dtxsan: order(N)`` on
+    an allocation line declares a rank (consistent low→high edges are
+    justified; a high→low acquisition is an immediate violation).
+  * **SAN002 thread-leak** (`threads.py`) — per-test teardown audit of
+    threads that outlive the test, each named by the spawn site recorded
+    when ``Thread.start`` ran.
+  * **SAN003 compile-budget** (`compile.py`) — counts XLA compiles via
+    the ``jax.monitoring`` events and enforces declared budgets:
+    ``with compile_budget(0):`` turns the engine-memo "load/unload
+    causes ZERO recompiles" invariant into a hard error naming the
+    compile sites; module-level budgets bound a whole run.
+
+Activation: ``DTX_SAN=1`` (all) or a comma list of ``lock,thread,
+compile`` — read by the pytest plugin (`plugin.py`, loaded from
+tests/conftest.py) and by ``dtx replay`` for the chaos harness. ``dtx
+san`` (`cli.py`) wraps a pytest run and applies the dtxlint exit-code /
+``--format json`` contract; findings reuse ``analysis.baseline`` (the
+policy baseline stays EMPTY) and honor inline
+``# dtxsan: disable=SANxxx`` suppressions.
+"""
+
+from datatunerx_tpu.analysis.sanitizers.compile import (  # noqa: F401
+    CompileBudgetExceeded,
+    compile_budget,
+    register_module_budget,
+)
+from datatunerx_tpu.analysis.sanitizers.runtime import (  # noqa: F401
+    active_classes,
+    install_from_env,
+)
+
+__all__ = [
+    "CompileBudgetExceeded",
+    "compile_budget",
+    "register_module_budget",
+    "active_classes",
+    "install_from_env",
+]

@@ -1,0 +1,8 @@
+"""``python -m datatunerx_tpu.analysis.sanitizers`` == ``dtx san``."""
+
+import sys
+
+from datatunerx_tpu.analysis.sanitizers.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

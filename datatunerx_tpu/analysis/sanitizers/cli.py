@@ -1,0 +1,130 @@
+"""``dtx san`` — run pytest under the sanitizers and report like dtxlint.
+
+Wraps a pytest invocation (child process, so the wrapper's own
+interpreter stays un-instrumented), collects the raw report the plugin
+writes, partitions it against the dtxsan baseline, and emits the same
+contract as ``dtx lint``: human text or ``--format json`` with
+``{"version", "findings", "baselined", "suppressed", "failed"}``; exit
+0 clean / 1 findings-or-test-failure / 2 usage-or-infrastructure error.
+
+    dtx san                                   # whole suite, all sanitizers
+    dtx san --san lock,thread -- tests/test_gateway.py -q
+    dtx san --module-budget datatunerx_tpu/serving=64 -- tests/
+    dtx san --from-report .dtxsan-report.json --format json
+
+``--write-baseline`` snapshots current findings into the baseline file —
+policy here keeps that file EMPTY (fix or inline-annotate instead), but
+the mechanism matches dtxlint's for rule rollouts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from datatunerx_tpu.analysis.baseline import save_baseline
+from datatunerx_tpu.analysis.sanitizers import report
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtx san",
+        description="Run pytest under the dtxsan runtime sanitizers "
+                    "(SAN001 lock-order, SAN002 thread-leak, SAN003 "
+                    "compile-budget).")
+    p.add_argument("--san", default="1", metavar="CLASSES",
+                   help="sanitizer classes: 1/all or a comma list of "
+                        "lock,thread,compile (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline path (default: dtxsan-baseline.json at "
+                        "the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline entirely")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline and exit 0")
+    p.add_argument("--report", default=None, metavar="FILE",
+                   help="where the raw report is written "
+                        "(default: .dtxsan-report.json at the repo root)")
+    p.add_argument("--from-report", default=None, metavar="FILE",
+                   help="skip the pytest run; evaluate an existing raw "
+                        "report")
+    p.add_argument("--module-budget", action="append", default=[],
+                   metavar="PATH=N",
+                   help="module compile budget (repeatable); requires the "
+                        "compile sanitizer")
+    p.add_argument("--no-detail", action="store_true",
+                   help="omit evidence stacks from text output")
+    p.add_argument("pytest_args", nargs=argparse.REMAINDER,
+                   help="arguments after -- go to pytest verbatim "
+                        "(default: tests/ -q)")
+    return p
+
+
+def _run_pytest(args, report_path: str) -> int:
+    pytest_args = [a for a in args.pytest_args if a != "--"]
+    if not pytest_args:
+        pytest_args = ["tests/", "-q"]
+    env = dict(os.environ)
+    env["DTX_SAN"] = args.san
+    env["DTX_SAN_REPORT"] = report_path
+    if args.baseline:
+        env["DTX_SAN_BASELINE"] = args.baseline
+    if args.no_baseline:
+        env["DTX_SAN_NO_BASELINE"] = "1"
+    budgets = [b for b in args.module_budget if "=" in b]
+    if budgets:
+        env["DTX_SAN_MODULE_BUDGETS"] = ",".join(budgets)
+    cmd = [sys.executable, "-m", "pytest"] + pytest_args
+    return subprocess.call(cmd, env=env)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    for b in args.module_budget:
+        if "=" not in b or not b.split("=", 1)[1].strip().lstrip("-").isdigit():
+            print(f"dtx san: bad --module-budget {b!r} (want PATH=N)",
+                  file=sys.stderr)
+            return 2
+
+    report_path = args.report or report.default_report_path()
+    pytest_exit: Optional[int] = None
+    if args.from_report:
+        report_path = args.from_report
+    else:
+        pytest_exit = _run_pytest(args, report_path)
+    try:
+        findings, suppressed, counters, classes = report.load_raw(
+            report_path)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"dtx san: cannot read report {report_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = args.baseline or report.default_baseline_path()
+        save_baseline(path, [sf.finding for sf in findings])
+        print(f"dtx san: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    evaluation = report.evaluate(findings, suppressed,
+                                 baseline_path=args.baseline,
+                                 no_baseline=args.no_baseline)
+    doc = report.build_doc(evaluation, counters, classes,
+                           pytest_exit=pytest_exit)
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+    else:
+        print(report.render_text(evaluation, counters,
+                                 with_detail=not args.no_detail))
+        if pytest_exit not in (None, 0):
+            print(f"dtx san: pytest exited {pytest_exit}")
+    return 1 if doc["failed"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

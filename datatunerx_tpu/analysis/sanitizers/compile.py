@@ -1,0 +1,235 @@
+"""SAN003 — the compile-budget sanitizer.
+
+Counts XLA compiles through ``jax.monitoring``'s event-duration hooks
+(fired synchronously inside the compile path, so the call stack still
+shows which repo code triggered it) and enforces *declared budgets*:
+
+  * ``with compile_budget(n):`` — the block may trigger at most ``n``
+    fresh lowerings; a breach raises :class:`CompileBudgetExceeded`
+    naming every compile site seen inside the window, and records a
+    SAN003 finding anchored at the ``with`` line. ``compile_budget(0)``
+    is how the PR 10 "adapter load/unload causes ZERO recompiles" and
+    PR 14 memo-key invariants become hard suite-wide errors.
+  * ``register_module_budget("path/substr", n)`` — bounds the total
+    compiles attributed to sites in matching files over a whole run;
+    checked by ``scan_into`` at session finish (the pytest plugin reads
+    ``DTX_SAN_MODULE_BUDGETS=path=count,...``).
+
+The budget metric is the **lowering** count (``jaxpr_to_mlir_module``
+events): one per executable-cache miss, stable whether or not a
+persistent compilation cache later satisfies the backend compile.
+Backend compiles are tracked alongside for the report. jax is imported
+lazily — the rest of ``analysis/`` stays importable with stdlib only.
+
+NOTE for tests: building *inputs* (e.g. ``jnp.ones``) compiles tiny
+programs too — construct inputs before entering the budget window.
+"""
+
+from __future__ import annotations
+
+import _thread
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from datatunerx_tpu.analysis.sanitizers import runtime
+from datatunerx_tpu.analysis.sanitizers.runtime import (
+    REPO_ROOT,
+    SAN_COMPILE_BUDGET,
+    Collector,
+    _skippable,
+    site_str,
+    user_site,
+)
+
+Site = Tuple[str, int]
+
+_LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileBudgetExceeded(RuntimeError):
+    """A ``compile_budget`` window saw more fresh compiles than declared."""
+
+
+def _repo_site() -> Site:
+    """First frame under the repo root (excluding sanitizer machinery) —
+    the repo code that triggered this compile; ("<jax-internal>", 0)
+    when the compile never passed through repo code."""
+    try:
+        frame = sys._getframe(2)
+    except ValueError:
+        return ("<jax-internal>", 0)
+    while frame is not None:
+        fn = frame.f_code.co_filename
+        if fn.startswith(REPO_ROOT) and not _skippable(fn):
+            return (fn, frame.f_lineno)
+        frame = frame.f_back
+    return ("<jax-internal>", 0)
+
+
+class CompileSanitizer:
+    def __init__(self):
+        self.installed = False
+        self.enabled = False
+        self._mu = _thread.allocate_lock()
+        self._lowerings = 0
+        self._backend = 0
+        # event log: (seq, site) per lowering, for budget-window slicing
+        self._events: List[Site] = []
+        self._site_counts: Dict[Site, int] = {}
+        self._module_budgets: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ install
+    def install(self):
+        if self.installed:
+            self.enabled = True
+            return
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover - jax always present here
+            return
+        san = self
+
+        def _on_event(event, duration, *a, **kw):
+            if not san.enabled:
+                return
+            if event == _BACKEND_EVENT:
+                with san._mu:
+                    san._backend += 1
+                return
+            if event != _LOWER_EVENT:
+                return
+            site = _repo_site()
+            with san._mu:
+                san._lowerings += 1
+                san._events.append(site)
+                san._site_counts[site] = san._site_counts.get(site, 0) + 1
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        self.installed = True
+        self.enabled = True
+
+    def uninstall(self):
+        # jax.monitoring has no public per-listener removal; the listener
+        # stays registered but goes inert
+        self.enabled = False
+
+    def reset(self):
+        with self._mu:
+            self._lowerings = 0
+            self._backend = 0
+            self._events.clear()
+            self._site_counts.clear()
+
+    # ------------------------------------------------------------ queries
+    def counts(self) -> Dict[str, int]:
+        with self._mu:
+            return {"lowerings": self._lowerings,
+                    "backend_compiles": self._backend}
+
+    def event_index(self) -> int:
+        with self._mu:
+            return len(self._events)
+
+    def events_since(self, index: int) -> List[Site]:
+        with self._mu:
+            return list(self._events[index:])
+
+    # ------------------------------------------------------------ budgets
+    def register_module_budget(self, path_substr: str, budget: int):
+        with self._mu:
+            self._module_budgets[path_substr] = int(budget)
+
+    def scan_into(self, collector: Collector) -> List:
+        out = []
+        with self._mu:
+            budgets = dict(self._module_budgets)
+            counts = dict(self._site_counts)
+        for substr, budget in sorted(budgets.items()):
+            hits = {s: n for s, n in counts.items()
+                    if substr in s[0].replace("\\", "/")}
+            total = sum(hits.values())
+            if total <= budget:
+                continue
+            top = sorted(hits.items(), key=lambda kv: (-kv[1],
+                                                       site_str(kv[0])))[:6]
+            sites = ", ".join(f"{site_str(s)} ({n}x)" for s, n in top)
+            f = collector.add(
+                SAN_COMPILE_BUDGET, (substr, 1),
+                f"module compile budget exceeded: {total} compiles "
+                f"attributed to '{substr}' (budget {budget}) — top sites: "
+                f"{sites}",
+                detail=f"per-site counts: "
+                       + "; ".join(f"{site_str(s)}={n}"
+                                   for s, n in sorted(
+                                       hits.items(),
+                                       key=lambda kv: site_str(kv[0]))))
+            if f is not None:
+                out.append(f)
+        return out
+
+
+COMPILE_SANITIZER = CompileSanitizer()
+
+
+class compile_budget:
+    """``with compile_budget(n, "label"):`` — assert at most ``n`` fresh
+    XLA lowerings happen inside the block. Installs the compile listener
+    on first use, so it works standalone (no DTX_SAN needed). A breach
+    records a SAN003 finding at the ``with`` line and raises
+    :class:`CompileBudgetExceeded` (suppress with
+    ``# dtxsan: disable=SAN003`` on that line, or pass
+    ``raise_on_exceed=False`` to only record)."""
+
+    def __init__(self, budget: int, label: str = "",
+                 raise_on_exceed: bool = True,
+                 collector: Optional[Collector] = None):
+        self.budget = int(budget)
+        self.label = label
+        self.raise_on_exceed = raise_on_exceed
+        self.collector = collector
+        self.seen = 0
+        self.sites: List[Site] = []
+        self._start = 0
+        self._site: Site = ("<unknown>", 0)
+
+    def __enter__(self) -> "compile_budget":
+        COMPILE_SANITIZER.install()
+        self._site = user_site()
+        self._start = COMPILE_SANITIZER.event_index()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.sites = COMPILE_SANITIZER.events_since(self._start)
+        self.seen = len(self.sites)
+        if exc_type is not None or self.seen <= self.budget:
+            return False
+        counts: Dict[Site, int] = {}
+        for s in self.sites:
+            counts[s] = counts.get(s, 0) + 1
+        top = sorted(counts.items(), key=lambda kv: (-kv[1],
+                                                     site_str(kv[0])))[:6]
+        sites = ", ".join(f"{site_str(s)} ({n}x)" for s, n in top)
+        what = f" [{self.label}]" if self.label else ""
+        msg = (f"compile budget exceeded{what}: {self.seen} fresh XLA "
+               f"lowerings inside a compile_budget({self.budget}) window "
+               f"— compile sites: {sites}")
+        col = self.collector or runtime.COLLECTOR
+        f = col.add(SAN_COMPILE_BUDGET, self._site, msg,
+                    detail="each site is the nearest repo frame on the "
+                           "stack when jax lowered a new program")
+        if f is not None and self.raise_on_exceed:
+            raise CompileBudgetExceeded(msg)
+        return False
+
+
+def register_module_budget(path_substr: str, budget: int):
+    """Bound total compiles attributed to files matching ``path_substr``
+    across the whole run (checked at session finish)."""
+    COMPILE_SANITIZER.install()
+    COMPILE_SANITIZER.register_module_budget(path_substr, budget)
+
+
+__all__: Sequence[str] = ("COMPILE_SANITIZER", "CompileSanitizer",
+                          "CompileBudgetExceeded", "compile_budget",
+                          "register_module_budget")

@@ -332,6 +332,13 @@ def cmd_replay(args):
     return replay_main([])
 
 
+def cmd_san(args):
+    # unreachable like cmd_lint — main() dispatches san before argparse
+    from datatunerx_tpu.analysis.sanitizers.cli import main as san_main
+
+    return san_main([])
+
+
 def cmd_install(args):
     """One-command install (reference dtx-ctl + Helm, INSTALL.md:26-48)."""
     from datatunerx_tpu.operator.install import install, render_install_manifests
@@ -382,6 +389,11 @@ def main(argv=None):
         from datatunerx_tpu.loadgen.replay import main as replay_main
 
         return replay_main(replay_tail)
+    san_tail = _passthrough_tail(argv, "san")
+    if san_tail is not None:
+        from datatunerx_tpu.analysis.sanitizers.cli import main as san_main
+
+        return san_main(san_tail)
     p = argparse.ArgumentParser(prog="dtx")
     p.add_argument("--server", default=os.environ.get("DTX_SERVER",
                                                       "http://127.0.0.1:8080"))
@@ -533,6 +545,13 @@ def main(argv=None):
              "(loadgen/); args pass through",
         add_help=False)
     rp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "san",
+        help="runtime sanitizer run (lock-order / thread-leak / compile "
+             "budgets) over pytest; args pass through",
+        add_help=False)
+    sp.set_defaults(fn=cmd_san)
 
     ip = sub.add_parser(
         "install",

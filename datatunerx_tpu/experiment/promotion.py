@@ -254,6 +254,15 @@ class PromotionController:
         with self._lock:
             return self._tick_locked()
 
+    def abort(self, reason: str = "aborted") -> str:
+        """Force-terminate a live promotion (rollback to fleet weights) —
+        gateway shutdown mid-promotion calls this so the background run()
+        loop goes terminal instead of ticking against a closed gateway."""
+        with self._lock:
+            if self.state not in TERMINAL:
+                self._rollback(reason, self._stage_stats())
+            return self.state
+
     def _tick_locked(self) -> str:
         if self.state in TERMINAL:
             return self.state

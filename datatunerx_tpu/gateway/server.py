@@ -248,6 +248,11 @@ class Gateway:
         self._tenant_seen: set = set()
         self._tenant_series_cap = 1024
         self._prefetches = 0
+        # live fire-and-forget workers (adapter prefetch, promotion run):
+        # pruned on spawn, joined by close() so no worker outlives the
+        # gateway and ticks against torn-down replicas in tests
+        self._worker_threads: list = []
+        self._promotion_thread = None
 
     # -------------------------------------------------------------- routing
     def _kwargs_from(self, req: dict) -> dict:
@@ -361,9 +366,14 @@ class Gateway:
                        adapter=adapter)
             with self._tenant_lock:
                 self._prefetches += 1
-            threading.Thread(
+            t = threading.Thread(
                 target=self._prefetch_worker, args=(target, adapter, ckpt),
-                name=f"dtx-prefetch-{adapter}", daemon=True).start()
+                name=f"dtx-prefetch-{adapter}", daemon=True)
+            with self._tenant_lock:
+                self._worker_threads = [
+                    w for w in self._worker_threads if w.is_alive()]
+                self._worker_threads.append(t)
+            t.start()
         except Exception:  # noqa: BLE001 — prefetch must never fail a request
             pass
 
@@ -1322,7 +1332,9 @@ class Gateway:
                                         metrics=metrics)
             self.promotion = promo
         if background:
-            threading.Thread(target=promo.run, daemon=True).start()
+            t = threading.Thread(target=promo.run, daemon=True)
+            self._promotion_thread = t
+            t.start()
         return promo
 
     def promotion_status(self) -> Optional[dict]:
@@ -1361,6 +1373,19 @@ class Gateway:
 
     def close(self):
         self.slo.stop()
+        # abort an in-flight promotion so its run loop goes terminal, then
+        # reap the background workers — a promotion ticking against a
+        # closed gateway was a real leak the thread sanitizer flagged
+        promo = self.promotion
+        if promo is not None:
+            promo.abort("gateway shutdown")
+        t = self._promotion_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+        with self._tenant_lock:
+            workers, self._worker_threads = self._worker_threads, []
+        for w in workers:
+            w.join(timeout=5)
         if self.fleet is not None:
             self.fleet.stop()
         if self.replica_set is not None:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -586,7 +587,60 @@ def apply_tighten(slos: List[SLO], specs: List[str]) -> List[SLO]:
 
 # ---------------------------------------------------------------------- CLI
 
+def _san_setup():
+    """Install the runtime sanitizers when DTX_SAN asks for them: the
+    chaos harness is exactly the kind of concurrency-heavy path whose
+    lock orders / thread lifetimes / recompiles the plane exists to
+    watch. Returns (classes, live-thread snapshot) — () when off."""
+    from datatunerx_tpu.analysis.sanitizers.runtime import install_from_env
+
+    classes = install_from_env()
+    return classes, set(threading.enumerate())
+
+
+def _san_epilogue(classes, before, rc: int) -> int:
+    """End-of-replay sanitizer sweep: lock-order cycles, module compile
+    budgets, and any repo-spawned thread still alive after the fleet
+    closed. New findings (vs the empty baseline) fail the run like an
+    SLO breach does."""
+    if not classes:
+        return rc
+    from datatunerx_tpu.analysis.sanitizers import report as _report
+    from datatunerx_tpu.analysis.sanitizers.runtime import COLLECTOR, finalize
+    from datatunerx_tpu.analysis.sanitizers.threads import THREAD_SANITIZER
+
+    finalize(COLLECTOR)
+    if "thread" in classes and THREAD_SANITIZER.installed:
+        THREAD_SANITIZER.audit(before, COLLECTOR, testid="dtx replay")
+    findings, suppressed = COLLECTOR.snapshot()
+    evaluation = _report.evaluate(
+        findings, suppressed,
+        baseline_path=os.environ.get("DTX_SAN_BASELINE") or None,
+        no_baseline=os.environ.get("DTX_SAN_NO_BASELINE") == "1")
+    counters = None
+    if "compile" in classes:
+        from datatunerx_tpu.analysis.sanitizers.compile import COMPILE_SANITIZER
+
+        counters = COMPILE_SANITIZER.counts()
+    print("[replay] " + _report.render_text(
+        evaluation, counters).replace("\n", "\n[replay] "))
+    report_path = os.environ.get("DTX_SAN_REPORT")
+    if report_path:
+        _report.write_raw(report_path, findings, suppressed,
+                          counters=counters, classes=classes)
+    if evaluation["failed"]:
+        print("[replay] sanitizer assertion FAILED: new dtxsan findings")
+        return 1
+    return rc
+
+
 def main(argv=None) -> int:
+    san_classes, san_before = _san_setup()
+    rc = _replay_main(argv)
+    return _san_epilogue(san_classes, san_before, rc)
+
+
+def _replay_main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="dtx replay",
         description="trace-driven load replay + chaos harness with an SLO "

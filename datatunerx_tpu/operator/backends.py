@@ -260,7 +260,10 @@ class LocalProcessBackend:
                         token.failed = True  # status() -> Failed, retryable
                 raise
 
-        threading.Thread(target=_spawn_group, daemon=True).start()
+        # The spawn worker is token-guarded (a delete or resubmission makes
+        # it a no-op), bounded by ready_timeout, and the process group it
+        # creates is reaped by delete().
+        threading.Thread(target=_spawn_group, daemon=True).start()  # dtxlint: disable=DTX012 — fire-and-forget by design, see above
 
     def status(self, name: str) -> str:
         with self._lock:

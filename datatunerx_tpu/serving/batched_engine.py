@@ -3473,6 +3473,9 @@ class BatchedEngine:
         self._shutdown.set()
         self._wake.set()
         self._thread.join(timeout=10)
+        if self.adapter_registry is not None:
+            # scheduler is down; reap any in-flight async loader threads
+            self.adapter_registry.close()
         # fail any migration commands the scheduler will never service so
         # their callers don't sit out the full wait timeout (the scheduler
         # thread is joined above — nothing else touches the retry list now)

@@ -1,0 +1,60 @@
+"""Append one dtxsan raw-report verdict line to the GitHub job summary.
+
+Usage: dtxsan_job_summary.py LABEL FILE — FILE is the raw report the
+pytest plugin writes (``DTX_SAN_REPORT=...`` / ``dtx san --report``).
+The row leads with the verdict, then the per-rule finding split and the
+compile counters, so the checks tab shows WHAT the sanitizers saw, not
+just red/green. Stdlib-only, like the rest of analysis/.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print("usage: dtxsan_job_summary.py LABEL FILE", file=sys.stderr)
+        return 2
+    label, path = sys.argv[1], sys.argv[2]
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        row = f"- **{label}**: no dtxsan report produced ({e})"
+        _emit(row)
+        return 1
+
+    findings = doc.get("findings", [])
+    by_rule = {}
+    for f in findings:
+        by_rule[f.get("rule", "?")] = by_rule.get(f.get("rule", "?"), 0) + 1
+    split = " ".join(f"{r}={n}" for r, n in sorted(by_rule.items())) \
+        or "none"
+    counters = doc.get("counters", {})
+    verdict = "**CLEAN**" if not findings else \
+        f"**{len(findings)} finding(s)**"
+    row = (f"- **{label}**: {verdict} — findings: {split} · "
+           f"suppressed={doc.get('suppressed', 0)} · "
+           f"classes={','.join(doc.get('classes', [])) or '?'} · "
+           f"compiles: {counters.get('lowerings', '?')} lowered / "
+           f"{counters.get('backend_compiles', '?')} backend")
+    for f in findings[:8]:
+        row += (f"\n  - `{f.get('rule')}` {f.get('path')}:{f.get('line')} "
+                f"— {f.get('message', '')[:160]}")
+    if len(findings) > 8:
+        row += f"\n  - … and {len(findings) - 8} more"
+    _emit(row)
+    return 0 if not findings else 1
+
+
+def _emit(row: str):
+    summary = os.environ.get("GITHUB_STEP_SUMMARY")
+    if summary:
+        with open(summary, "a", encoding="utf-8") as f:
+            f.write(row + "\n")
+    print(row)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,15 @@ else:
 
 import pytest  # noqa: E402
 
+# dtxsan (runtime sanitizer plane): opt-in via DTX_SAN=1 (or a class list,
+# e.g. DTX_SAN=lock,compile). The plugin installs the lock-order / thread-leak
+# / compile-budget instrumentation at configure time and reports via the
+# dtxlint-style baseline contract at session finish. Must be declared here
+# (top-level conftest) so pytest_configure runs before any test imports spawn
+# threads or take locks.
+if os.environ.get("DTX_SAN", "").strip().lower() not in ("", "0", "off"):
+    pytest_plugins = ("datatunerx_tpu.analysis.sanitizers.plugin",)
+
 
 @pytest.fixture(scope="session")
 def devices8():

@@ -119,6 +119,8 @@ class FakeKubeApiServer:
     def stop(self):
         self.server.shutdown()
         self.server.server_close()
+        if self.thread.is_alive():
+            self.thread.join(timeout=5)
 
     @property
     def url(self) -> str:

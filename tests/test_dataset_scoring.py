@@ -73,7 +73,9 @@ class _EchoServer:
 
         self.calls = []
         self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
-        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
 
     @property
     def url(self):
@@ -81,6 +83,8 @@ class _EchoServer:
 
     def stop(self):
         self.srv.shutdown()
+        self.srv.server_close()
+        self.thread.join(timeout=5)
 
 
 def test_split_and_column_extraction(eval_split):

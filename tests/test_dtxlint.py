@@ -511,6 +511,201 @@ def test_dtx010_flags_loop_backedge_without_rebind():
     assert rule_ids(src) == ["DTX010"]
 
 
+# ------------------------------------------------------------------ DTX011
+def test_dtx011_flags_lexical_lock_order_inversion():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._alloc_lock = threading.Lock()
+            self._stats_lock = threading.Lock()
+
+        def allocate(self):
+            with self._alloc_lock:
+                with self._stats_lock:
+                    return 1
+
+        def report(self):
+            with self._stats_lock:
+                with self._alloc_lock:
+                    return 2
+    """
+    ids = rule_ids(src)
+    assert ids.count("DTX011") == 1
+    f = [x for x in run(src).findings if x.rule == "DTX011"][0]
+    assert "lock-order inversion" in f.message
+    assert "opposite order" in f.message
+
+
+def test_dtx011_clean_on_consistent_global_order():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._alloc_lock = threading.Lock()
+            self._stats_lock = threading.Lock()
+
+        def allocate(self):
+            with self._alloc_lock:
+                with self._stats_lock:
+                    return 1
+
+        def audit(self):
+            with self._alloc_lock:
+                with self._stats_lock:
+                    return 2
+
+        def stats_only(self):
+            with self._stats_lock:
+                return 3
+    """
+    assert rule_ids(src) == []
+
+
+def test_dtx011_multi_item_with_uses_acquisition_order():
+    # `with a, b` then `with b, a` is the same ABBA spelled compactly
+    src = """
+    import threading
+
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+    def fwd():
+        with _a_lock, _b_lock:
+            pass
+
+    def rev():
+        with _b_lock, _a_lock:
+            pass
+    """
+    assert rule_ids(src).count("DTX011") == 1
+
+
+# ------------------------------------------------------------------ DTX012
+def test_dtx012_flags_daemon_thread_without_shutdown_evidence():
+    src = """
+    import threading
+
+    class Ticker:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while True:
+                pass
+    """
+    ids = rule_ids(src)
+    assert ids == ["DTX012"]
+    f = run(src).findings[0]
+    assert "no shutdown evidence" in f.message
+    assert "self._t" in f.message
+
+
+def test_dtx012_clean_with_stop_event_or_join():
+    src = """
+    import threading
+
+    class EventLoop:
+        def __init__(self):
+            self._stop = threading.Event()
+
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while not self._stop.is_set():
+                pass
+
+        def close(self):
+            self._stop.set()
+
+    class Joined:
+        def start(self):
+            self._t = threading.Thread(target=print, daemon=True)
+            self._t.start()
+
+        def close(self):
+            self._t.join(timeout=5)
+
+    class Scoped:
+        def run_once(self):
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+            t.join()
+    """
+    assert rule_ids(src) == []
+
+
+def test_dtx012_local_handle_escaping_to_attr_uses_class_evidence():
+    # the AdapterRegistry/Gateway shape: a local handle appended to (or
+    # aliased into) a self attribute that close() drains and joins
+    src = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._loaders = []
+
+        def kick(self):
+            t = threading.Thread(target=print, daemon=True)
+            self._loaders.append(t)
+            t.start()
+
+        def close(self):
+            workers = [w for w in self._loaders if w.is_alive()]
+            for w in workers:
+                w.join(timeout=5)
+
+    class Promoter:
+        def start(self):
+            t = threading.Thread(target=print, daemon=True)
+            self._promo = t
+            t.start()
+
+        def close(self):
+            t = self._promo
+            t.join(timeout=5)
+    """
+    assert rule_ids(src) == []
+
+
+def test_dtx012_timer_cancel_counts_and_unstarted_ignored():
+    src = """
+    import threading
+
+    class Debounce:
+        def arm(self):
+            self._timer = threading.Timer(1.0, print)
+            self._timer.daemon = True
+            self._timer.start()
+
+        def close(self):
+            self._timer.cancel()
+
+    class NeverStarted:
+        def build(self):
+            self._t = threading.Thread(target=print, daemon=True)
+    """
+    assert rule_ids(src) == []
+
+
+def test_dtx012_non_daemon_is_dtx007_territory():
+    # no daemon flag: DTX012 stays quiet (DTX007 owns non-daemon handles)
+    src = """
+    import threading
+
+    class Plain:
+        def start(self):
+            self._t = threading.Thread(target=print)
+            self._t.start()
+    """
+    assert "DTX012" not in rule_ids(src)
+
+
 # ------------------------------------------------------- hot-region markers
 def test_hot_region_markers_flag_sync_inside_region_only():
     src = """
@@ -648,13 +843,15 @@ def test_program_graph_ignores_thread_target_reference_edges(tmp_path):
             class Pool:
                 def __init__(self):
                     self._lock = threading.Lock()
+                    self._stop = threading.Event()
 
                 def _reap(self, name):
                     time.sleep(0.1)
 
                 def _start_reap(self, name):
-                    # daemon=True keeps this DTX007-clean; the rule under
-                    # test here is DTX009's reachability, not handle leaks
+                    # daemon=True keeps this DTX007-clean and the _stop
+                    # event keeps it DTX012-clean; the rule under test
+                    # here is DTX009's reachability, not handle leaks
                     threading.Thread(
                         target=self._reap, args=(name,), daemon=True
                     ).start()
@@ -662,9 +859,90 @@ def test_program_graph_ignores_thread_target_reference_edges(tmp_path):
                 def reconcile(self):
                     with self._lock:
                         self._start_reap("r0")
+
+                def close(self):
+                    self._stop.set()
         """,
     })
     assert _prog(pkg).findings == []
+
+
+def test_program_graph_flags_cross_module_lock_inversion(tmp_path):
+    # neither module inverts on its own — the cycle only exists across the
+    # call edges: alloc.reserve holds ALLOC and calls stats.record (takes
+    # STATS), while stats.flush holds STATS and calls alloc.touch (takes
+    # ALLOC). Per-module DTX011 is lexical-only; the program pass stitches
+    # the held-lock reachability.
+    pkg = _write_pkg(tmp_path, {
+        "alloc.py": """
+            import threading
+
+            from pkg.stats import record
+
+            ALLOC_LOCK = threading.Lock()
+
+            def reserve():
+                with ALLOC_LOCK:
+                    record()
+
+            def touch():
+                with ALLOC_LOCK:
+                    return 1
+        """,
+        "stats.py": """
+            import threading
+
+            STATS_LOCK = threading.Lock()
+
+            def record():
+                with STATS_LOCK:
+                    return 2
+
+            def flush():
+                from pkg.alloc import touch
+
+                with STATS_LOCK:
+                    touch()
+        """,
+    })
+    findings = [f for f in _prog(pkg).findings if f.rule == "DTX011"]
+    assert len(findings) == 1
+    assert "pkg.alloc.ALLOC_LOCK" in findings[0].message
+    assert "pkg.stats.STATS_LOCK" in findings[0].message
+
+
+def test_program_graph_cross_module_consistent_order_clean(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "alloc.py": """
+            import threading
+
+            from pkg.stats import record
+
+            ALLOC_LOCK = threading.Lock()
+
+            def reserve():
+                with ALLOC_LOCK:
+                    record()
+
+            def touch():
+                with ALLOC_LOCK:
+                    return 1
+        """,
+        "stats.py": """
+            import threading
+
+            STATS_LOCK = threading.Lock()
+
+            def record():
+                with STATS_LOCK:
+                    return 2
+
+            def flush():
+                with STATS_LOCK:
+                    return 3
+        """,
+    })
+    assert [f for f in _prog(pkg).findings if f.rule == "DTX011"] == []
 
 
 def test_program_graph_adjudicates_handle_dropped_by_callee(tmp_path):

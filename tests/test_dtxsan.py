@@ -1,0 +1,497 @@
+"""dtxsan (analysis/sanitizers): one deliberate-bug and one clean fixture
+per runtime sanitizer, plus the shared plumbing — inline suppression, the
+dtxlint-baseline contract, the JSON report shape, and idempotent re-scans.
+
+Every test restores the process-global singletons to their prior state so
+the suite behaves identically with and without DTX_SAN=1 (where the pytest
+plugin has already installed them for the whole session), and deliberate
+findings go into FRESH collectors so they never leak into the session
+report of a sanitizer-enabled CI run.
+"""
+
+import contextlib
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from datatunerx_tpu.analysis.baseline import save_baseline
+from datatunerx_tpu.analysis.sanitizers import report as san_report
+from datatunerx_tpu.analysis.sanitizers import runtime as san_runtime
+from datatunerx_tpu.analysis.sanitizers.compile import (
+    COMPILE_SANITIZER,
+    CompileBudgetExceeded,
+    compile_budget,
+)
+from datatunerx_tpu.analysis.sanitizers.lockorder import (
+    LOCK_SANITIZER,
+    LockOrderViolation,
+)
+from datatunerx_tpu.analysis.sanitizers.runtime import Collector
+from datatunerx_tpu.analysis.sanitizers.threads import (
+    THREAD_SANITIZER,
+    allow_thread,
+)
+
+REPO = san_runtime.REPO_ROOT
+
+
+@contextlib.contextmanager
+def _lock_san():
+    """Install the lock sanitizer with an empty graph; afterwards restore
+    the pre-test enabled state and drop the deliberate edges."""
+    was = LOCK_SANITIZER.enabled
+    LOCK_SANITIZER.install()
+    LOCK_SANITIZER.reset()
+    try:
+        yield LOCK_SANITIZER
+    finally:
+        LOCK_SANITIZER.reset()
+        if not was:
+            LOCK_SANITIZER.uninstall()
+
+
+@contextlib.contextmanager
+def _thread_san():
+    was = THREAD_SANITIZER.installed
+    THREAD_SANITIZER.install()
+    try:
+        yield THREAD_SANITIZER
+    finally:
+        if not was:
+            THREAD_SANITIZER.uninstall()
+
+
+# ------------------------------------------------------ SAN001 lock order
+def test_lockorder_abba_cycle_reports_both_stacks():
+    with _lock_san() as san:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def order_ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def order_ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=order_ba)
+        t2.start()
+        t2.join()
+        assert san.edge_count() == 2
+
+        col = Collector()
+        found = san.scan_into(col)
+        assert len(found) == 1
+        f = found[0]
+        assert f.rule == "SAN001"
+        assert "lock-order cycle" in f.message
+        assert "opposite order was observed" in f.message
+        # the finding anchors at an acquisition site in THIS file
+        assert f.path.endswith("test_dtxsan.py")
+        # evidence: BOTH edges, each with its acquisition stack
+        detail = col.findings[0].detail
+        assert detail.count("edge ") == 2
+        assert detail.count("acquisition stack:") == 2
+        assert "order_ab" in detail and "order_ba" in detail
+
+        # idempotent re-scan: the collector dedupes, nothing doubles
+        san.scan_into(col)
+        assert len(col.findings) == 1
+
+
+def test_lockorder_consistent_order_is_clean():
+    with _lock_san() as san:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def worker():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        for _ in range(2):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        col = Collector()
+        assert san.scan_into(col) == []
+
+
+def test_lockorder_self_deadlock_raises_instead_of_hanging(monkeypatch):
+    col = Collector()
+    monkeypatch.setattr(san_runtime, "COLLECTOR", col)
+    with _lock_san():
+        lock = threading.Lock()
+        lock.acquire()
+        try:
+            with pytest.raises(LockOrderViolation, match="self-deadlock"):
+                lock.acquire()
+        finally:
+            lock.release()
+    assert len(col.findings) == 1
+    assert "non-reentrant Lock" in col.findings[0].finding.message
+
+
+def test_lockorder_rlock_reentry_is_clean():
+    with _lock_san() as san:
+        rl = threading.RLock()
+        with rl:
+            with rl:
+                pass
+        col = Collector()
+        assert san.scan_into(col) == []
+
+
+def test_lockorder_declared_order_justifies_and_flags():
+    with _lock_san() as san:
+        low = threading.Lock()   # dtxsan: order(pool:1)
+        high = threading.Lock()  # dtxsan: order(pool:2)
+        with low:
+            with high:  # 1 -> 2: the sanctioned direction
+                pass
+        col = Collector()
+        assert san.scan_into(col) == []
+
+        with high:
+            with low:  # 2 -> 1: violates the declared ranks
+                pass
+        found = san.scan_into(col)
+        assert len(found) == 1
+        assert "declared lock order violated" in found[0].message
+        assert "group pool" in found[0].message
+
+
+# ------------------------------------------------------ SAN002 thread leak
+def test_thread_leak_detected_with_spawn_site():
+    with _thread_san() as san:
+        before = set(threading.enumerate())
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="leaky-probe-1",
+                             daemon=True)
+        t.start()
+        try:
+            col = Collector()
+            found = san.audit(before, col, testid="test_thread_leak",
+                              grace=0.05)
+            assert len(found) == 1
+            f = found[0]
+            assert f.rule == "SAN002"
+            assert "'leaky-probe'" in f.message  # counter suffix stripped
+            assert f.path.endswith("test_dtxsan.py")
+            detail = col.findings[0].detail
+            assert "first leaked past: test_thread_leak" in detail
+            assert "spawn stack:" in detail
+            assert "test_dtxsan.py" in detail
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+def test_thread_joined_before_teardown_is_clean():
+    with _thread_san() as san:
+        before = set(threading.enumerate())
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        col = Collector()
+        assert san.audit(before, col, grace=0.5) == []
+
+
+def test_allow_thread_escape_hatch():
+    with _thread_san() as san:
+        before = set(threading.enumerate())
+        stop = threading.Event()
+        t = allow_thread(threading.Thread(target=stop.wait, daemon=True))
+        t.start()
+        try:
+            col = Collector()
+            assert san.audit(before, col, grace=0.05) == []
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+def test_plugin_fails_leaking_test(tmp_path):
+    """End-to-end: a test that leaks a thread FAILS under DTX_SAN=thread
+    via the plugin's teardown audit, naming the spawn site."""
+    (tmp_path / "test_leak.py").write_text(textwrap.dedent("""
+        import threading
+
+        def test_leaves_a_worker():
+            stop = threading.Event()
+            threading.Thread(target=stop.wait, name="orphan",
+                             daemon=True).start()
+            assert True
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "test_leak.py", "-q",
+         "-p", "datatunerx_tpu.analysis.sanitizers.plugin",
+         "-p", "no:cacheprovider"],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "DTX_SAN": "thread",
+             "DTX_SAN_FOREIGN": "1", "DTX_SAN_THREAD_GRACE": "0.1",
+             "PYTHONPATH": REPO},
+    )
+    assert proc.returncode != 0
+    assert "dtxsan thread-leak" in proc.stdout
+    assert "'orphan'" in proc.stdout
+
+
+# --------------------------------------------------- SAN003 compile budget
+def _fresh_compile_san():
+    was = COMPILE_SANITIZER.enabled
+    COMPILE_SANITIZER.install()
+    return was
+
+
+def test_compile_budget_clean_then_breach():
+    import jax
+    import jax.numpy as jnp
+
+    was = _fresh_compile_san()
+    try:
+        x = jnp.arange(8.0)  # inputs built OUTSIDE any budget window
+
+        @jax.jit
+        def f(v):
+            return v * 2.0 + 1.0
+
+        f(x).block_until_ready()  # warm
+        with compile_budget(0, label="warmed"):
+            f(x).block_until_ready()  # cache hit: zero fresh lowerings
+
+        @jax.jit
+        def g(v):
+            return v * 3.0 - 1.0
+
+        col = Collector()
+        with pytest.raises(CompileBudgetExceeded, match="compile budget"):
+            with compile_budget(0, label="fresh-program", collector=col):
+                g(x).block_until_ready()
+        assert len(col.findings) == 1
+        f0 = col.findings[0].finding
+        assert f0.rule == "SAN003"
+        assert "fresh-program" in f0.message
+        assert "test_dtxsan.py" in f0.message  # compile site attribution
+    finally:
+        COMPILE_SANITIZER.enabled = was
+
+
+def test_compile_budget_no_raise_mode_records_only():
+    import jax
+    import jax.numpy as jnp
+
+    was = _fresh_compile_san()
+    try:
+        x = jnp.arange(4.0)
+
+        @jax.jit
+        def h(v):
+            return v - 0.5
+
+        col = Collector()
+        with compile_budget(0, raise_on_exceed=False, collector=col) as w:
+            h(x).block_until_ready()
+        assert w.seen >= 1
+        assert len(col.findings) == 1
+    finally:
+        COMPILE_SANITIZER.enabled = was
+
+
+def test_module_budget_breach_names_top_sites():
+    import jax
+    import jax.numpy as jnp
+
+    was = _fresh_compile_san()
+    try:
+        COMPILE_SANITIZER.register_module_budget("tests/test_dtxsan.py", 0)
+        x = jnp.arange(3.0)
+
+        @jax.jit
+        def m(v):
+            return v + 7.0
+
+        m(x).block_until_ready()
+        col = Collector()
+        found = COMPILE_SANITIZER.scan_into(col)
+        mine = [f for f in found
+                if "tests/test_dtxsan.py" in f.message]
+        assert mine and "module compile budget exceeded" in mine[0].message
+        assert "top sites:" in mine[0].message
+    finally:
+        with COMPILE_SANITIZER._mu:
+            COMPILE_SANITIZER._module_budgets.pop("tests/test_dtxsan.py",
+                                                  None)
+        COMPILE_SANITIZER.enabled = was
+
+
+def test_memo_key_fragmentation_is_caught(tmp_path, monkeypatch):
+    """The acceptance criterion: revert the PR 14 memo-key invariant —
+    make the program memo key vary per engine (as it would if adapter
+    NAMES were part of it) — and the compile-budget sanitizer catches the
+    resulting recompile that the shared-programs design eliminates."""
+    import datatunerx_tpu.serving.batched_engine as be
+    from datatunerx_tpu.serving.batched_engine import BatchedEngine
+
+    was = _fresh_compile_san()
+    kw = dict(template="vanilla", max_seq_len=128, slots=1, decode_chunk=4,
+              kv_block_size=16)
+    eng1 = BatchedEngine("preset:debug", **kw)
+    try:
+        prompt = eng1.tokenizer.encode("memo key probe")
+        eng1.generate(prompt, max_new_tokens=4)  # warm the shared programs
+
+        # control: an identical engine HITS the memo — zero fresh compiles
+        eng2 = BatchedEngine("preset:debug", **kw)
+        try:
+            with compile_budget(0, label="memo-hit"):
+                eng2.generate(prompt, max_new_tokens=4)
+        finally:
+            eng2.close()
+
+        # seeded regression: per-engine key fragment (adapter names in the
+        # key) forces a memo miss; fresh _Programs -> fresh jit wrappers
+        # -> the SAME traffic now lowers programs again
+        real_key = be._program_memo_key
+        nonce = iter(range(10 ** 6))
+
+        def fragmented(cfg, max_seq_len, kv_quant):
+            k = real_key(cfg, max_seq_len, kv_quant)
+            return None if k is None else k + (f"adapters:{next(nonce)}",)
+
+        monkeypatch.setattr(be, "_program_memo_key", fragmented)
+        eng3 = BatchedEngine("preset:debug", **kw)
+        try:
+            col = Collector()
+            with pytest.raises(CompileBudgetExceeded):
+                with compile_budget(0, label="memo-fragmented",
+                                    collector=col):
+                    eng3.generate(prompt, max_new_tokens=4)
+            assert col.findings
+            assert "memo-fragmented" in col.findings[0].finding.message
+        finally:
+            eng3.close()
+    finally:
+        eng1.close()
+        COMPILE_SANITIZER.enabled = was
+
+
+# ------------------------------------------------- suppression / baseline
+def test_inline_suppression_on_anchor_line(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "x = 1\n"
+        "y = 2  # dtxsan: disable=SAN002 — session-scoped server thread\n"
+        "z = 3  # dtxsan: disable=all — kitchen sink\n")
+    col = Collector()
+    assert col.add("SAN002", (str(src), 2), "leak") is None
+    assert col.add("SAN001", (str(src), 3), "anything") is None
+    assert col.add("SAN001", (str(src), 2), "wrong rule") is not None
+    assert col.suppressed == 2
+    assert len(col.findings) == 1
+
+
+def test_collector_dedupes_identical_findings(tmp_path):
+    col = Collector()
+    site = (str(tmp_path / "m.py"), 7)
+    assert col.add("SAN002", site, "same fact") is not None
+    assert col.add("SAN002", site, "same fact") is None
+    assert len(col.findings) == 1
+
+
+def test_report_baseline_and_json_contract(tmp_path):
+    col = Collector()
+    col.add("SAN001", (str(tmp_path / "a.py"), 3), "cycle x", detail="s1")
+    col.add("SAN002", (str(tmp_path / "b.py"), 9), "leak y", detail="s2")
+    findings, suppressed = col.snapshot()
+
+    # raw round-trip keeps findings + evidence
+    raw = tmp_path / "raw.json"
+    san_report.write_raw(str(raw), findings, suppressed,
+                         counters={"lowerings": 5, "backend_compiles": 2},
+                         classes=("lock", "thread"))
+    loaded, sup, counters, classes = san_report.load_raw(str(raw))
+    assert [sf.finding.key() for sf in loaded] == \
+        [sf.finding.key() for sf in findings]
+    assert loaded[0].detail == "s1"
+    assert counters == {"lowerings": 5, "backend_compiles": 2}
+    assert classes == ["lock", "thread"]
+
+    # with no baseline everything is NEW -> failed
+    ev = san_report.evaluate(loaded, sup, no_baseline=True)
+    assert ev["failed"] and len(ev["new"]) == 2
+
+    # baselined findings carry, don't fail (mechanism only: policy keeps
+    # the checked-in baseline EMPTY)
+    bl = tmp_path / "baseline.json"
+    save_baseline(str(bl), [sf.finding for sf in loaded])
+    ev2 = san_report.evaluate(loaded, sup, baseline_path=str(bl))
+    assert not ev2["failed"]
+    assert ev2["baselined"] == 2 and ev2["new"] == []
+
+    # the dtx lint-shaped JSON doc
+    doc = san_report.build_doc(ev, counters=counters, classes=classes,
+                               pytest_exit=0)
+    assert set(doc) == {"version", "findings", "baselined", "suppressed",
+                        "failed", "classes", "counters", "pytest_exit"}
+    assert doc["version"] == san_report.JSON_SCHEMA_VERSION
+    assert doc["findings"][0]["rule"] == "SAN001"
+    assert doc["findings"][0]["detail"] == "s1"
+    # a green sanitizer pass still fails the doc when pytest itself failed
+    doc_red = san_report.build_doc(
+        san_report.evaluate([], 0, no_baseline=True),
+        pytest_exit=1)
+    assert doc_red["failed"]
+
+
+def test_cli_from_report(tmp_path, capsys):
+    from datatunerx_tpu.analysis.sanitizers.cli import main as san_main
+
+    raw = tmp_path / "r.json"
+    col = Collector()
+    col.add("SAN003", (str(tmp_path / "c.py"), 4), "budget blown")
+    findings, suppressed = col.snapshot()
+    san_report.write_raw(str(raw), findings, suppressed)
+    rc = san_main(["--from-report", str(raw), "--no-baseline",
+                   "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["failed"]
+    assert doc["findings"][0]["rule"] == "SAN003"
+
+    san_report.write_raw(str(raw), [], 0)
+    rc = san_main(["--from-report", str(raw), "--no-baseline"])
+    assert rc == 0
+    assert "0 findings" in capsys.readouterr().out
+
+    assert san_main(["--module-budget", "nonsense"]) == 2
+
+
+def test_render_text_includes_detail():
+    col = Collector()
+    col.add("SAN001", (REPO + "/x.py", 1), "msg", detail="line1\nline2")
+    findings, suppressed = col.snapshot()
+    ev = san_report.evaluate(findings, suppressed, no_baseline=True)
+    text = san_report.render_text(ev, counters={"lowerings": 1,
+                                                "backend_compiles": 0})
+    assert "msg" in text and "line1" in text
+    assert "dtxsan: 1 finding" in text
+    assert "1 lowered" in text
+
+
+def test_parse_classes():
+    pc = san_runtime.parse_classes
+    assert pc("1") == ("lock", "thread", "compile")
+    assert pc("all") == ("lock", "thread", "compile")
+    assert pc("lock,compile") == ("lock", "compile")
+    assert pc("thread, bogus") == ("thread",)
+    assert pc("") == () and pc("0") == () and pc("off") == ()

@@ -454,17 +454,23 @@ def test_adapter_load_unload_zero_recompiles(tmp_path):
                          eng._prefill_chunk_fn._cache_size())
         before = sizes()
         # runtime load of a NEW adapter (evicts an unpinned resident:
-        # pool=2 is full) and traffic on it — no new programs
+        # pool=2 is full) and traffic on it — no new programs. The
+        # compile_budget(0) window turns "no recompiles" from a jit-cache
+        # size comparison into a hard sanitizer error naming any compile
+        # site (checkpoint construction compiles, so it stays outside).
+        from datatunerx_tpu.analysis.sanitizers import compile_budget
+
         ck_c = make_adapter_checkpoint(str(tmp_path / "c"), MODEL, seed=9,
                                        rank=8)
-        eng.load_adapter("c", ck_c)
-        assert eng.generate(prompt, max_new_tokens=6, adapter="c")
-        eng.unload_adapter("c")
-        # the evicted adapter reloads on miss — still no new programs, and
-        # its output is unchanged (slot recycling is invisible)
-        for a in ("a", "b"):
-            assert eng.generate(prompt, max_new_tokens=6,
-                                adapter=a) == base_out[a]
+        with compile_budget(0, label="adapter load/unload"):
+            eng.load_adapter("c", ck_c)
+            assert eng.generate(prompt, max_new_tokens=6, adapter="c")
+            eng.unload_adapter("c")
+            # the evicted adapter reloads on miss — still no new programs,
+            # and its output is unchanged (slot recycling is invisible)
+            for a in ("a", "b"):
+                assert eng.generate(prompt, max_new_tokens=6,
+                                    adapter=a) == base_out[a]
         assert sizes() == before, (before, sizes())
         assert eng.adapter_occupancy()["evictions"] >= 1
     finally:

@@ -191,17 +191,17 @@ def test_decode_step_wrapper_shape():
 
     B, KV, G, d, nbps, NB = 2, 2, 2, 8, 2, 4
     H = KV * G
-    key = jax.random.PRNGKey(3)
+    key, kq, kq2 = jax.random.split(jax.random.PRNGKey(3), 3)
     tables = jnp.asarray([[0, 1], [2, -1]], jnp.int32)
     kp, vp, ks, vs, pos, _, _ = _make_pool(key, B, NB, KV, d, (9, 4), tables)
-    q = jax.random.normal(key, (B, 1, H, d))
+    q = jax.random.normal(kq, (B, 1, H, d))
     cache = {"block_tables": tables}
     out = paged_attention_decode_step(
         q, kp, vp, None, None, cache, pos, jnp.asarray([[8], [3]], jnp.int32))
     assert out.shape == (B, 1, H, d)
     with pytest.raises(AssertionError):
         paged_attention_decode_step(
-            jax.random.normal(key, (B, 2, H, d)), kp, vp, None, None, cache,
+            jax.random.normal(kq2, (B, 2, H, d)), kp, vp, None, None, cache,
             pos, jnp.asarray([[8, 9], [3, 4]], jnp.int32))
 
 
