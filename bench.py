@@ -655,16 +655,20 @@ def child_serve_spec(preflight=None):
             "tpot_ms_p95": round(pct(tpots, 0.95) * 1e3, 2),
         }
 
-    def run_pair(alpha, tree=None, mode="auto"):
+    def run_pair(alpha, tree=None, mode="auto", epilogue="auto",
+                 learned=True):
         from datatunerx_tpu.obs.metrics import (
             Registry,
             spec_accept_len_histogram,
         )
 
         reg = Registry()
-        off = BatchedEngine("preset:bench-spec", **engine_kw)
+        off = BatchedEngine("preset:bench-spec",
+                            sampling_epilogue=epilogue, **engine_kw)
         on = BatchedEngine("preset:bench-spec", spec_draft=f"take:{take}",
                            spec_k=k, spec_mode=mode, spec_tree=tree,
+                           spec_tree_learned=learned,
+                           sampling_epilogue=epilogue,
                            registry=reg, **engine_kw)
         try:
             if alpha is not None:
@@ -710,6 +714,9 @@ def child_serve_spec(preflight=None):
                     round(on_stats["tpot_ms_p50"] / off_stats["tpot_ms_p50"],
                           3) if off_stats["tpot_ms_p50"] else None),
             }
+            out["sampling_epilogue"] = on.sampling_epilogue
+            out["epilogue_impl"] = on._epilogue_impl
+            out["fused_steps"] = on.sampling_stats["fused_steps"]
             if tree is not None:
                 out["tree_steps"] = info.get("tree_steps", 0)
                 out["tree"] = info.get("tree")
@@ -736,7 +743,12 @@ def child_serve_spec(preflight=None):
     tree_spec_s = os.environ.get("DTX_BENCH_SPEC_TREE", f"2x{k}")
     contested_alpha = float(os.environ.get("DTX_BENCH_SPEC_ALPHA", "0.12"))
     chain_c, _ = run_pair(alpha=contested_alpha, mode="on")
-    tree_c, _ = run_pair(alpha=contested_alpha, tree=tree_spec_s, mode="on")
+    # learned=False pins the fixed WxD rectangle controller — the
+    # chain-vs-tree statistic keeps its pre-learned-shapes meaning
+    tree_c, _ = run_pair(alpha=contested_alpha, tree=tree_spec_s, mode="on",
+                         learned=False)
+    # adversarial run keeps the LEARNED controller (default) — standing
+    # down must hold for the controller that actually ships
     tree_adv, _ = run_pair(alpha=None, tree=tree_spec_s)
     # never-slower carries over to trees: adversarial drafts stand down
     assert tree_adv["plain_steps"] >= tree_adv["spec_steps"], (
@@ -768,6 +780,51 @@ def child_serve_spec(preflight=None):
             if (tree_c["tpot_p50_ratio"] is not None
                 and chain_c["tpot_p50_ratio"] is not None) else None),
     }
+
+    # ---- learned-vs-fixed tree sub-run (PR 20): the SAME contested twin,
+    # learned per-depth widths (AdaptiveTree) vs the fixed WxD rectangle.
+    # The learned controller prunes dead branches (draft FLOPs the fixed
+    # rectangle burns for nothing), so tokens/s must not regress. 0.85
+    # slack: CPU smoke timing is noisy; TPU runs separate cleanly.
+    tree_l, _ = run_pair(alpha=contested_alpha, tree=tree_spec_s, mode="on",
+                         learned=True)
+    l_tps = tree_l["on"]["tokens_per_sec"]
+    f_tps = tree_c["on"]["tokens_per_sec"]
+    assert not f_tps or l_tps >= 0.85 * f_tps, (
+        "learned tree shapes regressed tokens/s vs the fixed rectangle: "
+        f"learned={l_tps} fixed={f_tps}")
+    tree_block["learned"] = tree_l
+    tree_block["fixed"] = tree_c
+    tree_block["learned_tps_ratio"] = (round(l_tps / f_tps, 3)
+                                       if f_tps else None)
+    tree_block["learned_ge_fixed"] = bool(not f_tps or l_tps >= f_tps)
+    learned_widths = (tree_l.get("tree") or {}).get("widths")
+
+    # ---- fused-epilogue sub-run (PR 20): the aligned twin again, spec-on
+    # engine forced through the fused sampling epilogue vs explicitly off.
+    # run_pair's pre-clock parity gate doubles as the engine-level
+    # fused-vs-legacy token-exactness proof; the greedy fused path skips
+    # the legacy sampler's full-vocab sort, so TPOT must not regress
+    # (1.2 noise guard on CPU smoke; the ≤1.0 verdict is reported).
+    ep_on, _ = run_pair(alpha=1e-3, epilogue="on")
+    ep_off, _ = run_pair(alpha=1e-3, epilogue="off")
+    assert ep_on["fused_steps"] > 0, (
+        "epilogue-on run never took the fused path: "
+        f"{ep_on['epilogue_impl']}")
+    assert ep_off["fused_steps"] == 0, "epilogue-off run took the fused path"
+    ep_ratio = (round(ep_on["on"]["tpot_ms_p50"] /
+                      ep_off["on"]["tpot_ms_p50"], 3)
+                if ep_off["on"]["tpot_ms_p50"] else None)
+    assert ep_ratio is None or ep_ratio <= 1.2, (
+        "fused sampling epilogue regressed TPOT p50 vs the legacy sampler: "
+        f"ratio={ep_ratio}")
+    epilogue_block = {
+        "impl": ep_on["epilogue_impl"],
+        "on": ep_on["on"], "off": ep_off["on"],
+        "fused_steps": ep_on["fused_steps"],
+        "tpot_p50_ratio": ep_ratio,
+        "tpot_le_off": ep_ratio is not None and ep_ratio <= 1.0,
+    }
     tag = (f"bench-spec,L{layers},take{take},k{k},tree{tree_spec_s},"
            f"slots{slots},bs{block}")
     line = {
@@ -784,9 +841,14 @@ def child_serve_spec(preflight=None):
         "spec_mode": "auto",
         "spec_draft": f"take:{take}",
         "spec_tree": tree_spec_s,
+        # PR 20 provenance: which sampler path produced these numbers and
+        # the tree shape the learned controller settled on
+        "sampling_epilogue": epilogue_block["impl"],
+        "tree_shape": (",".join(str(w) for w in learned_widths)
+                       if learned_widths else tree_spec_s),
         "spec": {"k": k, "target_layers": layers, "draft_layers": take,
                  "aligned": aligned, "adversarial": adversarial,
-                 "tree": tree_block},
+                 "tree": tree_block, "epilogue": epilogue_block},
     }
     if preflight is not None:
         line["preflight"] = preflight

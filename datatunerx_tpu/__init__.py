@@ -11,4 +11,4 @@ kernels; the Go/KubeRay control plane becomes a Python reconciler framework with
 pluggable cluster backends.
 """
 
-__version__ = "0.22.0"
+__version__ = "0.23.0"

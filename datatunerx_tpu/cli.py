@@ -235,6 +235,7 @@ def cmd_serve(args):
             "--spec_k", str(args.spec_k),
             "--spec_mode", args.spec_mode,
             "--spec_tree", args.spec_tree,
+            "--sampling_epilogue", args.sampling_epilogue,
             "--prefill_token_budget", str(args.prefill_token_budget),
             "--replicas", str(max(args.replicas, 1)),
             "--policy", args.policy,
@@ -272,6 +273,7 @@ def cmd_serve(args):
         "--spec_k", str(args.spec_k),
         "--spec_mode", args.spec_mode,
         "--spec_tree", args.spec_tree,
+        "--sampling_epilogue", args.sampling_epilogue,
         "--prefill_token_budget", str(args.prefill_token_budget),
         "--tenants_config", args.tenants_config,
         "--host_adapter_cache_mb", str(args.host_adapter_cache_mb),
@@ -478,6 +480,11 @@ def main(argv=None):
                          "batched verify over W branches, accept the "
                          "longest surviving path; needs "
                          "--spec_draft_config; empty = chain drafts")
+    vp.add_argument("--sampling_epilogue", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused on-chip sampling epilogue: auto = on for "
+                         "TPU backends, on = force anywhere (exact XLA "
+                         "oracle off-TPU), off = legacy host sampler")
     vp.add_argument("--prefill_token_budget", type=int, default=0,
                     help="prefill tokens per scheduler tick between decode "
                          "chunks (0 = unbounded)")

@@ -2072,6 +2072,8 @@ def main(argv=None):
     p.add_argument("--spec_mode", default="auto",
                    choices=["auto", "on", "off"])
     p.add_argument("--spec_tree", default="")
+    p.add_argument("--sampling_epilogue", default="auto",
+                   choices=["auto", "on", "off"])
     p.add_argument("--paged_kernel", default="auto",
                    choices=["auto", "on", "off"])
     p.add_argument("--prefill_chunk", type=int, default=256)
@@ -2145,6 +2147,7 @@ def main(argv=None):
                        "--spec_k", str(args.spec_k),
                        "--spec_mode", args.spec_mode,
                        "--spec_tree", args.spec_tree,
+                       "--sampling_epilogue", args.sampling_epilogue,
                        "--prefill_chunk", str(args.prefill_chunk),
                        "--prefill_token_budget",
                        str(args.prefill_token_budget)]

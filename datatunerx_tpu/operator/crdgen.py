@@ -109,6 +109,11 @@ SPECS = {
             # tree-draft verification (serving --spec_tree): 'WxD' flattens
             # a W-wide, D-deep token tree into one batched verify forward
             "specTree": STR,
+            # fused on-chip sampling epilogue (serving --sampling_epilogue):
+            # decode programs sample in the traced computation instead of
+            # materializing full-vocab logits for the host sampler
+            "samplingEpilogue": {"type": "string",
+                                 "enum": ["", "auto", "on", "off"]},
             # disaggregated fleet plane (gateway/server.py): role is a
             # single role for one server or a comma cycle the gateway
             # assigns across spawned replicas; prompts >= the threshold

@@ -249,6 +249,7 @@ def generate_serving_spec(job: FinetuneJob, checkpoint: dict) -> dict:
         "spec_k": serve_cfg.get("specK"),
         "spec_mode": serve_cfg.get("specMode") or "",
         "spec_tree": serve_cfg.get("specTree") or "",
+        "sampling_epilogue": serve_cfg.get("samplingEpilogue") or "",
         # disaggregated fleet plane (gateway/server.py --role /
         # --prefill_threshold / --fleet_*): replica roles, the shared
         # prefix tier, prefill→decode handoff, peer KV spill

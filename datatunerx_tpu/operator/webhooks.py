@@ -146,6 +146,9 @@ def _validate_serve_config(cfg: dict):
     if cfg.get("specMode") not in (None, ""):
         _require(str(cfg["specMode"]) in ("auto", "on", "off"),
                  "serveConfig.specMode must be auto, on, or off")
+    if cfg.get("samplingEpilogue") not in (None, ""):
+        _require(str(cfg["samplingEpilogue"]) in ("auto", "on", "off"),
+                 "serveConfig.samplingEpilogue must be auto, on, or off")
     if cfg.get("specTree") not in (None, ""):
         # validated here (not just at engine start) so a bad tree spec is
         # refused at admission instead of crash-looping replicas. Format

@@ -63,6 +63,10 @@ from datatunerx_tpu.ops.paged_attention import (
     paged_extract_row,
     paged_insert_row,
 )
+from datatunerx_tpu.ops.pallas_sampling import (
+    default_impl as sampling_default_impl,
+    sample_rows,
+)
 from datatunerx_tpu.serving.engine import _sample_jit
 from datatunerx_tpu.utils.decoding import DECODE_BUCKET
 from datatunerx_tpu.utils.model_loader import load_model_and_tokenizer
@@ -370,16 +374,19 @@ _PROGRAM_MEMO: "collections.OrderedDict" = collections.OrderedDict()
 _PROGRAM_MEMO_MAX = 8
 
 
-def _program_memo_key(cfg, max_seq_len: int, kv_quant):
+def _program_memo_key(cfg, max_seq_len: int, kv_quant,
+                      epilogue: str = "off"):
     """Hashable identity of the engine's traced programs, or None when it
     can't be established (exotic values → compile fresh). The dataclass repr
     covers every model-config field deterministically. Adapters are NOT part
     of the key: LoRA weights (a stacked tree or the dynamic pool) enter the
     programs as ARGUMENTS, so jax's own executable cache keys on their
     shapes — any adapter set with the same geometry shares one compiled
-    program, and loading/unloading a pool adapter recompiles nothing."""
+    program, and loading/unloading a pool adapter recompiles nothing.
+    ``epilogue`` (the RESOLVED sampling-epilogue impl: "off" | "kernel" |
+    "xla") changes what the decode program traces, so it keys too."""
     try:
-        return (repr(cfg), int(max_seq_len), kv_quant)
+        return (repr(cfg), int(max_seq_len), kv_quant, epilogue)
     except Exception:  # noqa: BLE001 — memoization is best-effort
         return None
 
@@ -399,10 +406,14 @@ class _Programs:
     leaf shape — mutating pool contents in place (same shapes) hits the
     same executable."""
 
-    def __init__(self, cfg, max_seq_len: int, kv_quant):
+    def __init__(self, cfg, max_seq_len: int, kv_quant,
+                 epilogue: str = "off"):
         self.cfg = cfg
         self.max_seq_len = max_seq_len
         self.kv_quant = kv_quant
+        # resolved fused-sampling-epilogue impl ("off" | "kernel" | "xla");
+        # "off" keeps the legacy argsort sampler — byte-identical programs
+        self.epilogue = epilogue
         self.prefill = jax.jit(self._prefill_impl,
                                static_argnames=("prompt_len",))
         self.extend = jax.jit(self._extend_impl,
@@ -415,7 +426,8 @@ class _Programs:
         self.extract = jax.jit(paged_extract_row,
                                static_argnames=("width",))
         self.copy_block = jax.jit(paged_copy_block)
-        self.decode = jax.jit(self._decode_impl, static_argnames=("K",))
+        self.decode = jax.jit(self._decode_impl,
+                              static_argnames=("K", "mode"))
 
     def _prefill_impl(self, params, lora, tokens, mask, positions,
                       adapter_idx, *, prompt_len: int):
@@ -545,12 +557,20 @@ class _Programs:
 
     def _decode_impl(self, params, lora, cache, logits, pos, remaining,
                      active, rng, temps, top_ps, stops, adapter_idx, *,
-                     K: int):
+                     K: int, mode: str = "off"):
+        """``mode`` is the engine's static per-batch sampling mode when the
+        fused epilogue is on ("greedy" | "simple" | "topp"), or the
+        ``"off"`` sentinel — ONE compiled variant running the legacy
+        argsort sampler, byte-identical to the pre-epilogue program."""
         def step(carry, _):
             logits, cache, pos, remaining, active, rng = carry
-            split = jax.vmap(jax.random.split)(rng)
-            rng, sub = split[:, 0], split[:, 1]
-            nxt = jax.vmap(_sample_jit)(logits, temps, top_ps, sub)
+            if mode == "off" or self.epilogue == "off":
+                split = jax.vmap(jax.random.split)(rng)
+                rng, sub = split[:, 0], split[:, 1]
+                nxt = jax.vmap(_sample_jit)(logits, temps, top_ps, sub)
+            else:
+                nxt, rng = sample_rows(logits, temps, top_ps, rng,
+                                       mode=mode, impl=self.epilogue)
             is_stop = jnp.any(nxt[:, None] == stops, axis=1)
             emit = active & ~is_stop & (remaining > 0)
             emitted = jnp.where(emit, nxt, -1)
@@ -603,6 +623,8 @@ class BatchedEngine:
         spec_k: int = 4,  # proposals per verify step (adaptive ceiling)
         spec_mode: str = "auto",  # auto (adaptive) | on (pinned) | off
         spec_tree: Optional[str] = None,  # "WxD" tree drafts (None = chain)
+        spec_tree_learned: bool = True,  # learned per-depth widths + early exit
+        sampling_epilogue: str = "auto",  # fused on-chip sampling: auto|on|off
         prefill_chunk: int = 256,  # chunked-prefill program length (paged)
         prefill_token_budget: int = 0,  # prefill tokens per tick (0 = all)
         registry: Optional[Registry] = None,  # shared /metrics registry
@@ -740,6 +762,32 @@ class BatchedEngine:
             import dataclasses
 
             self.cfg = dataclasses.replace(self.cfg, paged_kernel=True)
+        # Fused on-chip sampling epilogue (ops/pallas_sampling.py): the
+        # jitted decode/spec programs sample inside the traced computation
+        # (greedy / temperature / exact-top-p as STATIC per-batch modes)
+        # instead of handing each step's [S, vocab] logits to the legacy
+        # argsort sampler. "auto" engages it on a real TPU backend only —
+        # mirroring paged_kernel — "on" forces it anywhere (non-TPU runs
+        # use the XLA tile-walk oracle: same math, same tokens), "off"
+        # pins the legacy sampler with traced programs byte-identical to a
+        # pre-epilogue build. The resolved impl keys the program memo.
+        emode = (sampling_epilogue if isinstance(sampling_epilogue, str)
+                 else ("on" if sampling_epilogue else "off"))
+        emode = (emode or "auto").strip().lower()
+        if emode not in ("auto", "on", "off"):
+            raise ValueError(
+                "sampling_epilogue must be auto|on|off, "
+                f"got {sampling_epilogue!r}")
+        self.sampling_epilogue = "on" if (
+            emode == "on"
+            or (emode == "auto" and jax.default_backend() == "tpu")
+        ) else "off"
+        self._epilogue_impl = (sampling_default_impl()
+                               if self.sampling_epilogue == "on" else "off")
+        # fused-path observability (dtx_serving_sampling_*): decode ticks
+        # that ran a fused-epilogue program vs the legacy sampler; written
+        # by the scheduler thread only, like spec_stats
+        self.sampling_stats = {"fused_steps": 0, "legacy_steps": 0}
         self._allocator: Optional[BlockAllocator] = None
         if self.paged:
             if self.max_seq_len % self.block_size:
@@ -809,6 +857,8 @@ class BatchedEngine:
                     f"spec_tree {self.spec_tree} writes "
                     f"{self.spec_tree.step_tokens} tokens per step — does "
                     f"not fit max_seq_len {self.max_seq_len}")
+        self.spec_tree_learned = bool(spec_tree_learned) and \
+            self.spec_tree is not None
         # one verify step writes up to step-token-count tokens past a row's
         # cursor (chain: pending + k proposals; tree: pending + W*D nodes);
         # paged admission reserves that overshoot so every verify write
@@ -834,10 +884,17 @@ class BatchedEngine:
                 "dcache": init_cache(dcfg, slots, self.max_seq_len,
                                      dtype=jnp.bfloat16, per_slot=True),
                 "programs": spec_mod.spec_programs(
-                    self.cfg, dcfg, self.max_seq_len, self.kv_quant),
+                    self.cfg, dcfg, self.max_seq_len, self.kv_quant,
+                    epilogue=self._epilogue_impl),
             }
-            self.spec_ctrl = spec_mod.AdaptiveK(self.spec_k, mode=smode,
-                                                tree=self.spec_tree)
+            # learned tree shapes (AdaptiveTree): per-depth width selection
+            # from acceptance EMAs + draft-side early exit on a decisive
+            # root margin. spec_tree_learned=False pins the fixed WxD
+            # rectangle controller — the bench's learned-vs-fixed twin.
+            ctrl_cls = (spec_mod.AdaptiveTree if self.spec_tree_learned
+                        else spec_mod.AdaptiveK)
+            self.spec_ctrl = ctrl_cls(self.spec_k, mode=smode,
+                                      tree=self.spec_tree)
             self._spec_overshoot = self._spec_step_tokens
             self._spec_pending = jnp.zeros((slots,), jnp.int32)
             self._spec_form = [False] * slots   # slot is in pending form
@@ -934,10 +991,12 @@ class BatchedEngine:
         # is a program ARGUMENT, so engines with any adapter mapping share
         # programs, and the dynamic pool serves load/unload with ZERO
         # recompiles (the geometry fixes every leaf shape up front).
-        key = _program_memo_key(self.cfg, self.max_seq_len, self.kv_quant)
+        key = _program_memo_key(self.cfg, self.max_seq_len, self.kv_quant,
+                                self._epilogue_impl)
         progs = None if key is None else _PROGRAM_MEMO.get(key)
         if progs is None:
-            progs = _Programs(self.cfg, self.max_seq_len, self.kv_quant)
+            progs = _Programs(self.cfg, self.max_seq_len, self.kv_quant,
+                              self._epilogue_impl)
             if key is not None:
                 _PROGRAM_MEMO[key] = progs
                 while len(_PROGRAM_MEMO) > _PROGRAM_MEMO_MAX:
@@ -2248,7 +2307,7 @@ class BatchedEngine:
             if "k_scale" in self._cache:
                 row["k_scale"] = self._cache["k_scale"][:, slot:slot + 1]
                 row["v_scale"] = self._cache["v_scale"][:, slot:slot + 1]
-        return mig.build_payload(
+        payload = mig.build_payload(
             self.cfg, self.kv_quant,
             request={"trace_id": req.trace_id,
                      "adapter": req.adapter_name,
@@ -2259,6 +2318,14 @@ class BatchedEngine:
                      "seed": req.seed, "stop_ids": list(req.stop_ids)},
             row=row, cursor=cursor, pos=pos, remaining=remaining,
             rng=rng, logits=logits, wire=wire, b64=b64)
+        # learned spec-controller state rides the payload as plain JSON
+        # (encode/normalize pass unknown keys through untouched): the
+        # destination's re-prime rebuilds the draft KV, but without this
+        # the controller restarts cold — acceptance EMAs and learned tree
+        # widths would relearn from scratch after every migration
+        if self.spec is not None:
+            payload["spec"] = self.spec_ctrl.export_slot_state(slot)
+        return payload
 
     def _export_prefill_slot(self, slot: int, st: dict,
                              wire: Optional[str]) -> dict:
@@ -2392,6 +2459,11 @@ class BatchedEngine:
                     payload["prompt_ids"], self.tokenizer.eos_token_id,
                     self.max_seq_len, payload["max_new_tokens"])
                 req.spec_prime_ids = p_ids[p_plen - p_n:]
+                # warm the controller from the source's learned state
+                # (acceptance EMAs, learned per-depth widths): re-prime
+                # rebuilds the draft KV but must not reset what the source
+                # already learned about this session's acceptance
+                self.spec_ctrl.import_slot_state(slot, payload.get("spec"))
             if self.paged:
                 (self._cache, self._logits, self._pos, self._remaining,
                  self._active, self._temps, self._top_ps, self._stops,
@@ -2491,6 +2563,7 @@ class BatchedEngine:
                     payload["prompt_ids"], self.tokenizer.eos_token_id,
                     self.max_seq_len, payload["max_new_tokens"])
                 req.spec_prime_ids = p_ids[p_plen - p_n:]
+                self.spec_ctrl.import_slot_state(slot, payload.get("spec"))
             # the row's unwritten tail is POS_SENTINEL-padded to full
             # width, so the scatter doubles as the recycled-block scrub
             self._cache = paged_insert_row(
@@ -3067,6 +3140,28 @@ class BatchedEngine:
         self._spec_form[slot] = False
         self._trace("spec_settle", slot)
 
+    def _batch_sample_mode(self) -> str:
+        """Static per-batch sampling mode (bounded compiled variants):
+        all-greedy batches verify/sample by argmax alone — no
+        distributions, no full-vocab sort; top_p-free sampled batches use
+        plain softmax; only genuinely filtering batches pay the exact
+        sorted top-p path. Derived from host-side request params — no
+        device sync."""
+        live = [r for r in self._slot_req if r is not None]
+        if all(r.temperature <= 0.0 for r in live):
+            return "greedy"
+        if any(r.top_p < 1.0 and r.temperature > 0.0 for r in live):
+            return "topp"
+        return "simple"
+
+    def _epilogue_mode(self) -> str:
+        """Sampling mode the fused epilogue runs this tick, or the "off"
+        sentinel — the SINGLE compiled variant running the legacy argsort
+        sampler, so --sampling_epilogue off traces byte-identical
+        programs to a pre-epilogue build."""
+        return ("off" if self.sampling_epilogue != "on"
+                else self._batch_sample_mode())
+
     def _spec_decode_tick(self):
         """One speculative scheduler tick, replacing the plain decode chunk:
         (1) freshly-ready slots get their draft row primed and transition to
@@ -3094,7 +3189,8 @@ class BatchedEngine:
              self._active, self._rng) = progs.enter(
                 self._logits, self._spec_pending, self._remaining,
                 self._active, self._rng, self._temps, self._top_ps,
-                self._stops, jnp.asarray(fresh_mask))
+                self._stops, jnp.asarray(fresh_mask),
+                mode=self._epilogue_mode())
             for slot in fresh:
                 self._spec_form[slot] = True
             # first-token emissions stream ahead of this tick's chunk
@@ -3113,30 +3209,25 @@ class BatchedEngine:
 
         if spec_rows.any() and self.spec_ctrl.use_spec():
             plan = self.spec_ctrl.current_plan()
-            # static batch mode (bounded compiled variants): all-greedy
-            # batches verify by argmax alone — no distributions, no
-            # full-vocab sort; top_p-free sampled batches use plain
-            # softmax; only genuinely filtering batches pay the exact
-            # sorted top-p path
-            live = [r for r in self._slot_req if r is not None]
-            if all(r.temperature <= 0.0 for r in live):
-                mode = "greedy"
-            elif any(r.top_p < 1.0 and r.temperature > 0.0 for r in live):
-                mode = "topp"
-            else:
-                mode = "simple"
+            # the verify math itself needs the true batch mode even when
+            # the fused epilogue is off (acceptance is mode-dependent);
+            # only the DRAW inside the program routes through the
+            # epilogue, gated by SpecPrograms.epilogue
+            mode = self._batch_sample_mode()
+            margin = None
             if plan[0] == "tree":
-                _, width, k = plan  # per-row depth plays the chain k role
+                widths = plan[1]  # learned (or rectangular) per-depth widths
+                k = len(widths)  # accepted path depth plays the chain k role
                 with jax.profiler.TraceAnnotation("dtx_engine_spec_tree"):
                     (emitted, acc, self._cache, sp["dcache"],
                      self._spec_pending, self._pos, self._remaining,
-                     self._active, self._rng) = progs.tree_step(
+                     self._active, self._rng, margin) = progs.tree_step(
                         self.params, sp["dparams"], self._lora_arg(),
                         self._cache, sp["dcache"], self._spec_pending,
                         self._pos, self._remaining, self._active,
                         self._rng, self._temps, self._top_ps, self._stops,
                         self._adapter_idx, jnp.asarray(spec_rows),
-                        width=width, depth=k, mode=mode)
+                        widths=widths, mode=mode)
                 self.spec_stats["tree_steps"] += 1
             else:
                 k = plan[1]
@@ -3177,17 +3268,40 @@ class BatchedEngine:
                 alpha = self.spec_ctrl.alpha
                 self._spec_adapter_ema[name] = (
                     rate if ema is None else ema + alpha * (rate - ema))
+            if plan[0] == "tree" and self.spec_tree_learned and obs:
+                # learned-shape inputs, from data already on host: the
+                # fraction of drafting rows whose accepted path reached
+                # depth ≥ j+1, and the fraction whose root top-2 logit
+                # margin was decisive (draft-side early-exit signal)
+                widths = plan[1]
+                depth_fracs = [
+                    sum(1 for _, a, _ in obs if a >= j + 1) / len(obs)
+                    for j in range(len(widths))]
+                margin_np = np.asarray(margin)  # dtxlint: disable=DTX001 — designed sync point: the tick already host-read obs at this boundary
+                dm = [float(margin_np[s]) for s, _, _ in obs]  # dtxlint: disable=DTX001 — margin_np is host (np.asarray above)
+                decisive_frac = sum(
+                    1 for m in dm
+                    if m >= self.spec_ctrl.DECISIVE_MARGIN) / len(dm)
+                self.spec_ctrl.observe_tree(depth_fracs, decisive_frac)
+            if self.sampling_epilogue == "on":
+                self.sampling_stats["fused_steps"] += 1
+            else:
+                self.sampling_stats["legacy_steps"] += 1
             self._trace("spec", k, len(obs))
         else:
+            emode = self._epilogue_mode()
             with jax.profiler.TraceAnnotation("dtx_engine_decode"):
                 (emitted, self._cache, self._spec_pending, self._pos,
                  self._remaining, self._active, self._rng) = progs.decode(
                     self.params, self._lora_arg(), self._cache,
                     self._spec_pending, self._pos, self._remaining,
                     self._active, self._rng, self._temps, self._top_ps,
-                    self._stops, self._adapter_idx, K=self.chunk)
+                    self._stops, self._adapter_idx, K=self.chunk,
+                    mode=emode)
             out_rows.append(np.asarray(emitted))  # [K, S]  # dtxlint: disable=DTX001
             self.spec_stats["plain_steps"] += 1
+            self.sampling_stats["fused_steps" if emode != "off"
+                                else "legacy_steps"] += 1
             self.spec_ctrl.note_plain_step()
             self._trace("decode", self.chunk)
 
@@ -3217,15 +3331,25 @@ class BatchedEngine:
         }
         if self.spec_tree is not None:
             plan = snap.get("plan") or []
+            widths = (list(plan[1]) if len(plan) == 2 and plan[0] == "tree"
+                      else [self.spec_tree.width] * self.spec_tree.depth)
             info["tree"] = {
                 "spec": str(self.spec_tree),
                 "width": self.spec_tree.width,
                 "depth": self.spec_tree.depth,
-                "plan_width": (plan[1] if len(plan) == 3 else
-                               self.spec_tree.width),
+                "learned": self.spec_tree_learned,
+                # per-depth plan widths (dtx_serving_spec_tree_width{depth})
+                "widths": widths,
+                "plan_width": widths[0] if widths else self.spec_tree.width,
                 "slot_path_len": {s: round(v, 4) for s, v in
                                   dict(self._spec_tree_slot_path).items()},
             }
+            for key in ("depth_ema", "decisive_ema"):
+                if key in snap:
+                    info["tree"][key] = snap[key]
+        info["sampling_epilogue"] = self.sampling_epilogue
+        info["epilogue_impl"] = self._epilogue_impl
+        info.update(self.sampling_stats)
         info.update(self.spec_stats)
         return info
 
@@ -3251,6 +3375,7 @@ class BatchedEngine:
                 if self.spec is not None:
                     emitted_np, active_np = self._spec_decode_tick()
                 else:
+                    emode = self._epilogue_mode()
                     with jax.profiler.TraceAnnotation("dtx_engine_decode"):
                         (emitted, self._logits, self._cache, self._pos,
                          self._remaining, self._active, self._rng) = \
@@ -3260,7 +3385,10 @@ class BatchedEngine:
                                 self._remaining, self._active, self._rng,
                                 self._temps, self._top_ps, self._stops,
                                 self._adapter_idx, K=self.chunk,
+                                mode=emode,
                             )
+                    self.sampling_stats["fused_steps" if emode != "off"
+                                        else "legacy_steps"] += 1
                     self._trace("decode", self.chunk)
                     # the decode loop's ONE designed sync point: K tokens per
                     # chunk cross to host here so req.push can stream them

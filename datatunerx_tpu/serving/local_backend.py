@@ -95,7 +95,7 @@ class LocalServingBackend:
                         "prefill_token_budget", "adapter_pool",
                         "adapter_rank_max", "paged_kernel",
                         "spec_draft_config", "spec_k", "spec_mode",
-                        "spec_tree",
+                        "spec_tree", "sampling_epilogue",
                         # multi-tenant QoS plane: both servers accept these
                         # (the gateway forwards them to spawned replicas)
                         "tenants_config", "host_adapter_cache_mb"):

@@ -280,17 +280,29 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
                                 "Verify steps that ran the tree-draft "
                                 "program (vs chain draft/verify).")
     sp_tree_width = reg.gauge("dtx_serving_spec_tree_width",
-                              "Current tree branch width (adaptive, <= "
-                              "the --spec_tree W; 0 = chain drafts).")
+                              "Current tree branch width per draft depth "
+                              "(learned/adaptive, <= the --spec_tree W; "
+                              "label depth is 1-based).")
     sp_tree_depth = reg.gauge("dtx_serving_spec_tree_depth",
                               "Configured tree draft depth D (0 = chain "
                               "drafts).")
     sp_tree_path = reg.gauge("dtx_serving_spec_tree_slot_path_len",
                              "Accepted root-to-leaf path length EMA per "
                              "live cache slot.")
+    # fused sampling epilogue (ops/pallas_sampling.py): resolved mode +
+    # decode ticks by sampler path — the epilogue-on/off bench twin reads
+    # these to prove which path actually ran
+    sp_epilogue = reg.gauge("dtx_serving_sampling_epilogue",
+                            "Fused sampling epilogue state: 0 = off "
+                            "(legacy host sampler), 1 = on via the XLA "
+                            "oracle, 2 = on via the Pallas kernel.")
+    sp_fused = reg.counter("dtx_serving_sampling_fused_steps_total",
+                           "Decode/spec ticks by sampler path (fused = "
+                           "on-chip epilogue, legacy = host argsort).")
     for m in (sp_enabled, sp_active, sp_k, sp_rate, sp_rate_adapter,
               sp_rate_slot, sp_prop, sp_acc, sp_steps, sp_tree_steps,
-              sp_tree_width, sp_tree_depth, sp_tree_path):
+              sp_tree_width, sp_tree_depth, sp_tree_path, sp_epilogue,
+              sp_fused):
         m.clear()
     spec_fn = getattr(eng, "spec_info", None)
     spec_doc = spec_fn() if callable(spec_fn) else None
@@ -316,12 +328,23 @@ def _metrics_text_locked(with_exemplars: bool = True) -> str:
         sp_tree_steps.set(spec_doc.get("tree_steps", 0))
         tree_doc = spec_doc.get("tree")
         if tree_doc:
-            sp_tree_width.set(tree_doc.get("plan_width", 0))
+            widths = (tree_doc.get("widths") or
+                      [tree_doc.get("plan_width", 0)])
+            for j, w in enumerate(widths):
+                sp_tree_width.set(w, {"depth": str(j + 1)})
             sp_tree_depth.set(tree_doc.get("depth", 0))
             for slot, v in sorted(
                     (tree_doc.get("slot_path_len") or {}).items()
                     )[:_SLOT_SERIES_CAP]:
                 sp_tree_path.set(v, {"slot": str(slot)})
+    # the fused epilogue runs in plain decode too, spec or not — restate
+    # from the engine, not the spec document
+    impl = getattr(eng, "_epilogue_impl", "off")
+    sp_epilogue.set({"off": 0, "xla": 1, "kernel": 2}.get(impl, 0))
+    samp_stats = getattr(eng, "sampling_stats", None)
+    if isinstance(samp_stats, dict):
+        sp_fused.set(samp_stats.get("fused_steps", 0), {"path": "fused"})
+        sp_fused.set(samp_stats.get("legacy_steps", 0), {"path": "legacy"})
     # KV migration fabric: session export/import outcomes (restated from
     # the engine's scheduler-thread counters, cleared first like the rest)
     s_exp = reg.counter("dtx_serving_session_export_total",
@@ -1001,7 +1024,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                       prefill_chunk=256,
                       prefill_token_budget=0, paged_kernel="auto",
                       spec_draft=None, spec_k=4, spec_mode="auto",
-                      spec_tree=None,
+                      spec_tree=None, sampling_epilogue="auto",
                       trace_ring=256, trace_log_path=None,
                       tenants_config=None, host_adapter_cache_mb=0.0):
     def _load():
@@ -1022,6 +1045,10 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                               ("--paged_kernel", paged_kernel == "on"),
                               ("--spec_draft_config", spec_draft),
                               ("--spec_tree", spec_tree),
+                              # only "on" demands the batched engine; the
+                              # single-slot path has no fused epilogue
+                              ("--sampling_epilogue",
+                               sampling_epilogue == "on"),
                               ("--tenants_config", tenants_config),
                               ("--host_adapter_cache_mb",
                                host_adapter_cache_mb)):
@@ -1047,6 +1074,7 @@ def load_engine_async(model_path, checkpoint_path, template, max_seq_len,
                     spec_draft=spec_draft or None,
                     spec_k=spec_k, spec_mode=spec_mode or "auto",
                     spec_tree=spec_tree or None,
+                    sampling_epilogue=sampling_epilogue or "auto",
                     prefill_chunk=prefill_chunk,
                     prefill_token_budget=prefill_token_budget,
                     # the server's registry: engine TTFT/TPOT/prefill-chunk
@@ -1172,6 +1200,16 @@ def main(argv=None):
                         "accepts the longest surviving root-to-leaf path. "
                         "Requires --spec_draft_config. Empty (default) = "
                         "chain drafts, byte-identical to before")
+    p.add_argument("--sampling_epilogue", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="fused on-chip sampling epilogue "
+                        "(ops/pallas_sampling.py): decode/spec programs "
+                        "sample inside the traced computation instead of "
+                        "materializing [slots, vocab] logits for the host "
+                        "sampler. auto = on for TPU backends, off "
+                        "elsewhere; on = force anywhere (non-TPU runs use "
+                        "the exact XLA oracle); off = legacy sampler, "
+                        "programs byte-identical to before")
     p.add_argument("--prefill_chunk", type=int, default=256,
                    help="chunked-prefill program length in tokens (paged "
                         "engine); long prompts prefill in chunks "
@@ -1245,6 +1283,7 @@ def main(argv=None):
                       spec_draft=args.spec_draft_config,
                       spec_k=args.spec_k, spec_mode=args.spec_mode,
                       spec_tree=args.spec_tree,
+                      sampling_epilogue=args.sampling_epilogue,
                       trace_ring=args.trace_ring,
                       trace_log_path=args.trace_log,
                       tenants_config=args.tenants_config,

@@ -40,9 +40,11 @@ import numpy as np
 
 from datatunerx_tpu.models.llama import forward, init_cache
 from datatunerx_tpu.ops.attention import compact_window
+from datatunerx_tpu.ops.pallas_sampling import fused_sample, sample_rows
 from datatunerx_tpu.serving.engine import _sample_jit
 
 SPEC_MODES = ("auto", "on", "off")
+SAMPLING_EPILOGUES = ("auto", "on", "off")
 
 
 # ------------------------------------------------------------- tree topology
@@ -87,37 +89,80 @@ def parse_spec_tree(spec: str) -> TreeSpec:
 
 
 def _tree_col(j: int, b: int, width: int) -> int:
-    """Verify-window column of tree node (depth ``j`` >= 1, branch ``b``)."""
+    """Verify-window column of tree node (depth ``j`` >= 1, branch ``b``)
+    in the RECTANGLE layout (every depth ``width`` wide)."""
     return 1 + (j - 1) * width + b
 
 
-def tree_verify_mask(width: int, depth: int) -> np.ndarray:
+def _widths_tuple(width, depth=None) -> tuple:
+    """Canonical per-depth widths: ``(W, D)`` ints mean the fixed rectangle
+    ``(W,) * D``; an explicit sequence is the learned ragged shape. Widths
+    must be monotone NON-INCREASING — that makes every branch chain
+    prefix-live (branch b exists at depth j ⇒ it exists at every shallower
+    depth), which is what keeps ragged ancestry masks, clamped gathers and
+    the chain acceptance rule correct."""
+    if depth is not None:
+        ws = (int(width),) * int(depth)
+    else:
+        ws = tuple(int(w) for w in width)
+    if not ws or any(w < 1 for w in ws):
+        raise ValueError(f"tree widths must all be >= 1, got {ws}")
+    if any(b > a for a, b in zip(ws, ws[1:])):
+        raise ValueError(
+            f"tree widths must be non-increasing (branch chains must be "
+            f"prefix-live), got {ws}")
+    return ws
+
+
+def _width_offsets(ws: tuple) -> list:
+    """Flattened-window column of each depth's first node: depth j
+    (1-indexed) occupies columns ``offs[j-1] .. offs[j-1]+ws[j-1]-1``;
+    column 0 is the pending root."""
+    offs, c = [], 1
+    for w in ws:
+        offs.append(c)
+        c += w
+    return offs
+
+
+def tree_verify_mask(width, depth=None) -> np.ndarray:
     """Static [T, T] branch ancestry mask for the verify forward: query
     column c may attend window column c' iff c' is on c's root-to-self
     path. Combined with the causal check inside ``attention_allow`` (which
     still excludes unwritten sentinel lanes), this is exactly the oracle
-    bias a sequential per-branch verify would build."""
-    T = 1 + width * depth
+    bias a sequential per-branch verify would build.
+
+    Accepts ``(W, D)`` ints (the fixed rectangle) or one per-depth widths
+    tuple (the learned ragged shape, ``T = 1 + sum(widths)``)."""
+    ws = _widths_tuple(width, depth)
+    offs = _width_offsets(ws)
+    T = 1 + sum(ws)
     mask = np.zeros((T, T), dtype=bool)
     mask[0, 0] = True
-    for j in range(1, depth + 1):
-        for b in range(width):
-            c = _tree_col(j, b, width)
+    for j, w in enumerate(ws, start=1):
+        for b in range(w):
+            c = offs[j - 1] + b
             mask[c, 0] = True
             for i in range(1, j + 1):
-                mask[c, _tree_col(i, b, width)] = True
+                mask[c, offs[i - 1] + b] = True
     return mask
 
 
-def tree_draft_mask(width: int, j: int) -> np.ndarray:
-    """Static [W, 1 + j*W] window mask for the draft's depth-``j`` forward:
-    branch b's query attends the pending root, its own ancestors, and its
-    own write lane — never a sibling chain."""
-    mask = np.zeros((width, 1 + j * width), dtype=bool)
-    for b in range(width):
+def tree_draft_mask(width, j: int) -> np.ndarray:
+    """Static window mask for the draft's depth-``j`` forward: branch b's
+    query attends the pending root, its own ancestors, and its own write
+    lane — never a sibling chain. ``width`` is an int (rectangle: shape
+    ``[W, 1 + j*W]``) or the per-depth widths tuple (ragged: shape
+    ``[ws[j-1], 1 + sum(ws[:j])]``)."""
+    ws = _widths_tuple(width, j) if isinstance(width, int) else \
+        _widths_tuple(width)
+    offs = _width_offsets(ws)
+    w = ws[j - 1]
+    mask = np.zeros((w, 1 + sum(ws[:j])), dtype=bool)
+    for b in range(w):
         mask[b, 0] = True
         for i in range(1, j + 1):
-            mask[b, _tree_col(i, b, width)] = True
+            mask[b, offs[i - 1] + b] = True
     return mask
 
 
@@ -208,7 +253,8 @@ def accept_tokens(p_probs: jnp.ndarray, q_probs: jnp.ndarray,
 
 def accept_tree_tokens(p_cols: jnp.ndarray, q_tree: jnp.ndarray,
                        d_toks: jnp.ndarray, temperature, rng, spec_on,
-                       *, width: int, depth: int):
+                       *, width: int = 0, depth: int = 0,
+                       widths: Optional[tuple] = None):
     """One row's tree acceptance (traceable; vmapped by the tree-verify
     program, unit-tested directly).
 
@@ -238,8 +284,22 @@ def accept_tree_tokens(p_cols: jnp.ndarray, q_tree: jnp.ndarray,
     ``spec_on=False`` rows reject every sibling WITHOUT consuming residual
     mass (the update is gated), so the final "residual" is the plain
     target distribution ``p_0`` — the row takes an ordinary single-token
-    step inside the same program, exactly like ``accept_tokens``."""
-    W, D = width, depth
+    step inside the same program, exactly like ``accept_tokens``.
+
+    ``widths`` (learned ragged shapes, ISSUE 20): a monotone non-increasing
+    per-depth widths tuple. ``p_cols`` is then the ragged flattened window
+    ``[1 + sum(widths), V]`` (node (j, b) at ``_width_offsets(widths)[j-1]
+    + b``) while ``q_tree``/``d_toks`` STAY the ``[D, W, V]`` / ``[D, W]``
+    rectangle with ``W = widths[0]`` — the caller zero-pads dead ``q_tree``
+    lanes and sets dead ``d_toks`` lanes to -1. Dead lanes then lose every
+    test for free: a -1 token never equals a target argmax, and a zero
+    ``q_at`` fails the ratio guard — so a branch's chain stops at its live
+    depth, and the residual row at exactly the live depth degenerates to
+    ``norm(clip(p - 0, 0)) = p``, which IS the bonus distribution."""
+    ws = _widths_tuple(widths) if widths is not None else \
+        _widths_tuple(width, depth)
+    W, D = ws[0], len(ws)
+    offs = _width_offsets(ws)
     rng, u_key, x_key = jax.random.split(rng, 3)
     us = jax.random.uniform(u_key, (W + D - 1,)) if W + D - 1 else \
         jnp.zeros((0,))
@@ -264,7 +324,14 @@ def accept_tree_tokens(p_cols: jnp.ndarray, q_tree: jnp.ndarray,
     # ---- chain rule down the accepted branch (depths 2..D)
     bsafe = jnp.maximum(b_star, 0)
     toks_b = d_toks[:, bsafe]                                   # [D]
-    cols_b = 1 + jnp.arange(D, dtype=jnp.int32) * W + bsafe     # [D]
+    # clamped per-depth column gather: a branch past its live depth reads
+    # the depth's LAST live column — the value is never consulted (its
+    # zero q_at already failed the chain), the clamp only keeps the
+    # gather in-bounds for ragged widths
+    col_tab = jnp.asarray(
+        np.array([[offs[j] + min(b, ws[j] - 1) for b in range(W)]
+                  for j in range(D)], np.int32))                # [D, W]
+    cols_b = col_tab[:, bsafe]                                  # [D]
     p_b = p_cols[cols_b]                                        # [D, V]
     q_b = q_tree[:, bsafe]                                      # [D, V]
     if D > 1:
@@ -294,12 +361,16 @@ def accept_tree_tokens(p_cols: jnp.ndarray, q_tree: jnp.ndarray,
     pred = np.zeros((D, W), np.int64)  # parent column of node (j+1, b)
     for j in range(1, D):
         for b in range(W):
-            pred[j, b] = _tree_col(j, b, W)
-    ok_g = (d_toks == tgt[pred]) & spec_on
+            pred[j, b] = offs[j - 1] + min(b, ws[j - 1] - 1)
+    live = jnp.asarray(
+        np.array([[b < ws[j] for b in range(W)] for j in range(D)]))
+    ok_g = (d_toks == tgt[pred]) & live & spec_on
     a_per_b = jnp.sum(jnp.cumprod(ok_g.astype(jnp.int32), axis=0), axis=0)
     b_greedy = jnp.argmax(a_per_b).astype(jnp.int32)  # first max wins
     a_greedy = a_per_b[b_greedy]
-    leaf = jnp.where(a_greedy == 0, 0, 1 + (a_greedy - 1) * W + b_greedy)
+    offs_arr = jnp.asarray(np.array(offs, np.int32))
+    leaf = jnp.where(a_greedy == 0, 0,
+                     offs_arr[jnp.maximum(a_greedy - 1, 0)] + b_greedy)
     extra_greedy = tgt[leaf]
 
     a = jnp.where(greedy, a_greedy, a_sampled)
@@ -447,11 +518,13 @@ class AdaptiveK:
 
     def current_plan(self) -> tuple:
         """The step shape this tick runs: ``("chain", k)`` or ``("tree",
-        width, depth)``. The tree controller degrades along WIDTH as global
-        acceptance collapses (full W while it holds, half on mediocre, a
-        width-1 chain-of-depth-D near the floor) — same thresholds, same
+        widths)`` where ``widths`` is the per-depth width tuple. The fixed
+        tree controller degrades along WIDTH as global acceptance collapses
+        (full W while it holds, half on mediocre, a width-1
+        chain-of-depth-D near the floor) — same thresholds, same
         bounded-program-set property as ``current_k``. No tree configured
-        = degenerate chain = byte-identical PR 14 behavior."""
+        = degenerate chain = byte-identical PR 14 behavior. ``AdaptiveTree``
+        overrides the tree branch with LEARNED per-depth widths."""
         with self._lock:
             return self.current_plan_locked()
 
@@ -465,14 +538,16 @@ class AdaptiveK:
             w = max(1, self.tree.width // 2)
         else:
             w = 1
-        return ("tree", w, self.tree.depth)
+        return ("tree", (w,) * self.tree.depth)
 
     # ---- observability
     def snapshot(self) -> dict:
         with self._lock:
+            plan = self.current_plan_locked()
             return {
                 "k": self.current_k_locked(),
-                "plan": list(self.current_plan_locked()),
+                "plan": [list(p) if isinstance(p, tuple) else p
+                         for p in plan],
                 "global_ema": self.global_ema,
                 "slots": {s: round(e, 4)
                           for s, (e, _) in self._slot_ema.items()},
@@ -489,6 +564,142 @@ class AdaptiveK:
             return max(1, self.k_max // 2)
         return 1
 
+    # ---- migration (dtx-kv-session payload "spec" sub-document)
+    def export_slot_state(self, slot: int) -> dict:
+        """JSON-safe controller state riding the session payload: the
+        slot's own acceptance EMA plus the learned global signals, so an
+        importer does not restart the controller cold (ISSUE 20)."""
+        with self._lock:
+            ema = self._slot_ema.get(slot)
+            plan = self.current_plan_locked()
+            return {
+                "slot_ema": list(ema) if ema is not None else None,
+                "slot_off": bool(self._slot_off.get(slot, False)),
+                "global_ema": self.global_ema,
+                "plan": [list(p) if isinstance(p, tuple) else p
+                         for p in plan],
+            }
+
+    def import_slot_state(self, slot: int, state) -> None:
+        """Warm this controller from an imported session's exported state.
+        The slot EMA/off flag are restored verbatim (they ARE that
+        session's history); the global EMA is adopted only when this
+        controller has none — one migrating tenant must not overwrite a
+        live fleet member's own evidence."""
+        if not isinstance(state, dict):
+            return
+        with self._lock:
+            ema = state.get("slot_ema")
+            if isinstance(ema, (list, tuple)) and len(ema) == 2:
+                self._slot_ema[slot] = (float(ema[0]), int(ema[1]))
+            if state.get("slot_off"):
+                self._slot_off[slot] = True
+            g = state.get("global_ema")
+            if g is not None and self.global_ema is None:
+                self.global_ema = float(g)
+
+
+class AdaptiveTree(AdaptiveK):
+    """Learned tree shapes (ISSUE 20): the fixed ``WxD`` rectangle becomes
+    a per-depth width VECTOR recomputed from acceptance evidence at tick
+    granularity.
+
+    - per-depth survival EMAs (fraction of drafting rows whose accepted
+      prefix reached depth j) pick each depth's width from the bounded
+      bucket set ``{1, ceil(W/2), W}`` with the same 0.6/0.3 thresholds as
+      ``current_k`` — a bounded width set means a bounded compiled-program
+      set, so adaptation never fragments the tree-step memo (the SAN003
+      compile-budget gate asserts this);
+    - widths are forced monotone non-increasing (each depth capped by the
+      one above), which keeps every branch chain prefix-live — the
+      invariant the ragged masks and clamped gathers rely on;
+    - a DECISIVE-margin EMA tracks how often the draft root's top-1 logit
+      margin is decisive; when it is nearly always decisive the depth-1
+      width is capped at 1 — the draft-side early exit: sibling roots are
+      pure draft FLOPs when the top token wins anyway.
+    """
+
+    DECISIVE_MARGIN = 4.0   # root top-2 logit gap that settles the branch
+    DECISIVE_EMA = 0.9      # "nearly always": cap depth-1 width at 1
+
+    def __init__(self, k_max: int, mode: str = "auto",
+                 tree: Optional[TreeSpec] = None, **kw):
+        if tree is None:
+            raise ValueError("AdaptiveTree requires a TreeSpec")
+        super().__init__(k_max, mode=mode, tree=tree, **kw)
+        self._depth_ema: List[Optional[float]] = [None] * tree.depth
+        self._decisive_ema: Optional[float] = None
+
+    # ---- scheduler-side
+    def observe_tree(self, depth_fracs, decisive_frac) -> None:
+        """Per-tick tree evidence: ``depth_fracs[j]`` = fraction of
+        drafting rows whose accepted prefix reached depth ``j+1``;
+        ``decisive_frac`` = fraction whose draft root margin cleared
+        ``DECISIVE_MARGIN``."""
+        with self._lock:
+            for j, f in enumerate(depth_fracs[:len(self._depth_ema)]):
+                e = self._depth_ema[j]
+                self._depth_ema[j] = float(f) if e is None else \
+                    e + self.alpha * (float(f) - e)
+            d = self._decisive_ema
+            self._decisive_ema = float(decisive_frac) if d is None else \
+                d + self.alpha * (float(decisive_frac) - d)
+
+    def _bucket(self, ema: Optional[float]) -> int:
+        W = self.tree.width
+        if ema is None or ema >= 0.6:
+            return W
+        if ema >= 0.3:
+            return max(1, -(-W // 2))
+        return 1
+
+    def current_plan_locked(self) -> tuple:
+        g = self.global_ema
+        if g is not None and g < 0.3:
+            # near-floor global acceptance: width-1 chain-of-depth-D, the
+            # same last resort the fixed controller takes
+            return ("tree", (1,) * self.tree.depth)
+        ws, cap = [], self.tree.width
+        for j in range(self.tree.depth):
+            w = min(self._bucket(self._depth_ema[j]), cap)
+            if j == 0 and self._decisive_ema is not None \
+                    and self._decisive_ema >= self.DECISIVE_EMA:
+                w = 1  # draft-side early exit
+            ws.append(w)
+            cap = w
+        return ("tree", tuple(ws))
+
+    # ---- observability / migration
+    def snapshot(self) -> dict:
+        doc = super().snapshot()
+        with self._lock:
+            doc["depth_ema"] = [None if e is None else round(e, 4)
+                                for e in self._depth_ema]
+            doc["decisive_ema"] = None if self._decisive_ema is None \
+                else round(self._decisive_ema, 4)
+        return doc
+
+    def export_slot_state(self, slot: int) -> dict:
+        state = super().export_slot_state(slot)
+        with self._lock:
+            state["depth_ema"] = list(self._depth_ema)
+            state["decisive_ema"] = self._decisive_ema
+        return state
+
+    def import_slot_state(self, slot: int, state) -> None:
+        super().import_slot_state(slot, state)
+        if not isinstance(state, dict):
+            return
+        with self._lock:
+            de = state.get("depth_ema")
+            if isinstance(de, (list, tuple)):
+                for j, e in enumerate(de[:len(self._depth_ema)]):
+                    if e is not None and self._depth_ema[j] is None:
+                        self._depth_ema[j] = float(e)
+            d = state.get("decisive_ema")
+            if d is not None and self._decisive_ema is None:
+                self._decisive_ema = float(d)
+
 
 # ------------------------------------------------------------ device programs
 # Bounded process-wide memo, the engine _Programs pattern: twin engines
@@ -499,14 +710,16 @@ _SPEC_MEMO: "collections.OrderedDict" = collections.OrderedDict()
 _SPEC_MEMO_MAX = 8
 
 
-def spec_programs(tcfg, dcfg, max_seq_len: int, kv_quant) -> "SpecPrograms":
+def spec_programs(tcfg, dcfg, max_seq_len: int, kv_quant,
+                  epilogue: str = "off") -> "SpecPrograms":
     try:
-        key = (repr(tcfg), repr(dcfg), int(max_seq_len), kv_quant)
+        key = (repr(tcfg), repr(dcfg), int(max_seq_len), kv_quant, epilogue)
     except Exception:  # noqa: BLE001 — memoization is best-effort
         key = None
     progs = None if key is None else _SPEC_MEMO.get(key)
     if progs is None:
-        progs = SpecPrograms(tcfg, dcfg, max_seq_len, kv_quant)
+        progs = SpecPrograms(tcfg, dcfg, max_seq_len, kv_quant,
+                             epilogue=epilogue)
         if key is not None:
             _SPEC_MEMO[key] = progs
             while len(_SPEC_MEMO) > _SPEC_MEMO_MAX:
@@ -535,31 +748,58 @@ class SpecPrograms:
     physical; dense rows rely on the scatter's drop-OOB mode exactly like
     the existing decode program."""
 
-    def __init__(self, tcfg, dcfg, max_seq_len: int, kv_quant):
+    def __init__(self, tcfg, dcfg, max_seq_len: int, kv_quant,
+                 epilogue: str = "off"):
         self.tcfg = tcfg
         self.dcfg = dcfg
         self.max_seq_len = max_seq_len
         self.kv_quant = kv_quant
-        self.enter = jax.jit(self._enter_impl)
+        # "off" = the legacy argsort sampler everywhere (byte-identical
+        # pre-epilogue programs); "kernel" / "xla" = the fused sampling
+        # epilogue with that implementation (ops/pallas_sampling.py)
+        self.epilogue = epilogue
+        self.enter = jax.jit(self._enter_impl, static_argnames=("mode",))
         self.prime = jax.jit(self._prime_impl)
         self.step = jax.jit(self._step_impl, static_argnames=("k", "mode"))
         self.tree_step = jax.jit(
             self._tree_step_impl,
-            static_argnames=("width", "depth", "mode"))
+            static_argnames=("widths", "mode"))
         self.decode = jax.jit(self._decode_pending_impl,
-                              static_argnames=("K",))
+                              static_argnames=("K", "mode"))
         self.settle = jax.jit(self._settle_impl)
+
+    # ---- one batched token draw, epilogue-aware
+    def _draw(self, logits, temps, top_ps, rng, mode: str):
+        """The legacy split + ``_sample_jit`` pair when the epilogue is off
+        (``mode == "off"``) — byte-identical pre-epilogue programs — else
+        the fused epilogue with the same key-split order, so the per-slot
+        PRNG stream evolves identically either way."""
+        if mode == "off" or self.epilogue == "off":
+            split = jax.vmap(jax.random.split)(rng)
+            rng2, sub = split[:, 0], split[:, 1]
+            return jax.vmap(_sample_jit)(logits, temps, top_ps, sub), rng2
+        return sample_rows(logits, temps, top_ps, rng, mode=mode,
+                           impl=self.epilogue)
+
+    def _draw_keys(self, logits, temps, top_ps, keys, mode: str):
+        """One draw from PRE-SPLIT per-row keys (the tree step's W iid
+        sibling draws)."""
+        if mode == "off" or self.epilogue == "off":
+            return jax.vmap(_sample_jit)(logits, temps, top_ps, keys)
+        return fused_sample(logits, temps, top_ps, keys, mode=mode,
+                            impl=self.epilogue)
 
     # ---- logits-form → pending-form transition (first emitted token)
     def _enter_impl(self, logits, pending, remaining, active, rng,
-                    temps, top_ps, stops, fresh):
+                    temps, top_ps, stops, fresh, *, mode: str = "off"):
         """Sample one token from each fresh row's held logits (the same
         split-then-sample the plain decode step would do), emit it, and make
         it the row's pending token. Cache and cursor untouched — the token's
-        KV is written by the row's first verify/pending forward."""
-        split = jax.vmap(jax.random.split)(rng)
-        rng2, sub = split[:, 0], split[:, 1]
-        nxt = jax.vmap(_sample_jit)(logits, temps, top_ps, sub)
+        KV is written by the row's first verify/pending forward. ``mode``
+        is the engine's static batch sampling mode when the fused epilogue
+        is on, or the ``"off"`` sentinel (one compiled variant, the legacy
+        sampler) when it is not."""
+        nxt, rng2 = self._draw(logits, temps, top_ps, rng, mode)
         is_stop = jnp.any(nxt[:, None] == stops, axis=1)
         emit = fresh & active & ~is_stop & (remaining > 0)
         emitted = jnp.where(emit, nxt, -1)
@@ -635,9 +875,7 @@ class SpecPrograms:
                 nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 q = jnp.zeros((S, 1), jnp.float32)  # placeholder, unused
             else:
-                split = jax.vmap(jax.random.split)(r)
-                r, sub = split[:, 0], split[:, 1]
-                nxt = jax.vmap(_sample_jit)(last, temps, top_ps, sub)
+                nxt, r = self._draw(last, temps, top_ps, r, mode)
                 q = jax.vmap(
                     lambda lg, t, tp: sampling_probs(
                         lg, t, tp, exact_topp=(mode == "topp"))
@@ -720,18 +958,26 @@ class SpecPrograms:
         return (emitted, a, tcache, dcache, pending, pos, new_remaining,
                 new_active, rng)
 
-    # ---- the tree super-step: draft W chains of depth D, verify once
+    # ---- the tree super-step: draft a widths-shaped tree, verify once
     def _tree_step_impl(self, tparams, dparams, lora, tcache, dcache,
                        pending, pos, remaining, active, rng, temps, top_ps,
-                       stops, adapter_idx, spec_on, *, width: int,
-                       depth: int, mode: str = "topp"):
-        """The ``_step_impl`` shape with a TREE of drafts per slot: W
-        parallel chains of depth D sharing the pending root, flattened into
-        ``1 + W*D`` verify columns under the branch ancestry mask, ONE
-        target forward, longest-surviving-path acceptance
-        (``accept_tree_tokens``). Draft cost equals chain ``k = D`` — one
-        pending forward plus D width-W forwards vs D+1 single-token
-        forwards — so any acceptance-length lift is free at the draft.
+                       stops, adapter_idx, spec_on, *, widths: tuple,
+                       mode: str = "topp"):
+        """The ``_step_impl`` shape with a TREE of drafts per slot:
+        ``widths[j-1]`` parallel branches at depth j sharing the pending
+        root, flattened into ``1 + sum(widths)`` verify columns under the
+        branch ancestry mask, ONE target forward, longest-surviving-path
+        acceptance (``accept_tree_tokens``). ``widths`` is the monotone
+        non-increasing per-depth width tuple — the fixed ``WxD`` rectangle
+        is ``(W,) * D``, and ``AdaptiveTree`` shrinks individual depths
+        from acceptance evidence. Each depth's draft forward runs only its
+        OWN ``widths[j-1]`` live lanes (the learned-shape FLOP saving);
+        dead rectangle lanes exist only in the acceptance inputs, as -1
+        tokens with zero draft mass, and lose every test by construction.
+
+        Also returns the draft root's top-2 logit margin per row — the
+        decisiveness signal ``AdaptiveTree`` turns into the draft-side
+        early exit.
 
         Tree windows BREAK the chain's stale-lane safety argument (a
         rejected sibling shares its rope position with an accepted one, so
@@ -740,8 +986,10 @@ class SpecPrograms:
         lanes and every other window lane's position is scrubbed to the
         sentinel (``compact_window``), restoring the chain invariant the
         settle / export / migration paths assume."""
-        W, D = width, depth
-        T = 1 + W * D
+        ws = _widths_tuple(widths)
+        W, D = ws[0], len(ws)
+        offs = _width_offsets(ws)
+        T = 1 + sum(ws)
         S = pending.shape[0]
         participate = active
         drow = participate & spec_on
@@ -749,7 +997,7 @@ class SpecPrograms:
         t_len0 = tcache["len"]
         exact = mode == "topp"
 
-        # ---- draft: the pending root, then D width-W tree forwards (the
+        # ---- draft: the pending root, then D ragged tree forwards (the
         # last one exists only to write the leaves' KV — samples discarded)
         dlogits, dcache = forward(
             dparams, pending[:, None], self.dcfg, positions=pos[:, None],
@@ -757,6 +1005,8 @@ class SpecPrograms:
             cache=dcache, compute_dtype=jnp.bfloat16,
         )
         l0 = dlogits[:, -1]
+        top2, _ = jax.lax.top_k(l0, 2)
+        margin = top2[:, 0] - top2[:, 1]  # root decisiveness, host EMA'd
         if mode == "greedy":
             # distinct top-W roots: at most one can match the target
             # argmax, and the verify walks every branch anyway
@@ -767,7 +1017,8 @@ class SpecPrograms:
             split = jax.vmap(lambda r: jax.random.split(r, W + 1))(rng)
             rng = split[:, 0]
             cur = jnp.stack(
-                [jax.vmap(_sample_jit)(l0, temps, top_ps, split[:, 1 + b])
+                [self._draw_keys(l0, temps, top_ps, split[:, 1 + b],
+                                 "off" if self.epilogue == "off" else mode)
                  for b in range(W)], axis=1)                    # iid from q0
             q0 = jax.vmap(
                 lambda lg, t, tp: sampling_probs(lg, t, tp,
@@ -775,48 +1026,58 @@ class SpecPrograms:
             )(l0, temps, top_ps)
         d_depth, q_depth = [cur], [q0]
         for j in range(1, D + 1):
-            wmask = jnp.asarray(tree_draft_mask(W, j))
+            wj = ws[j - 1]
+            wmask = jnp.asarray(tree_draft_mask(ws, j))
             dlogits, dcache = forward(
-                dparams, cur, self.dcfg,
-                positions=jnp.broadcast_to((pos + j)[:, None], (S, W)),
+                dparams, cur[:, :wj], self.dcfg,
+                positions=jnp.broadcast_to((pos + j)[:, None], (S, wj)),
                 attention_mask=jnp.broadcast_to(
-                    drow[:, None], (S, W)).astype(jnp.int32),
+                    drow[:, None], (S, wj)).astype(jnp.int32),
                 cache=dcache, compute_dtype=jnp.bfloat16,
                 window_mask=jnp.broadcast_to(
-                    wmask[None], (S, W, 1 + j * W)),
+                    wmask[None], (S, wj, 1 + sum(ws[:j]))),
                 window_start=d_len0,
             )
             if j == D:
                 break
+            wn = ws[j]  # next depth's width (<= wj: prefix-live chains)
             if mode == "greedy":
-                cur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                nxt = jnp.argmax(dlogits[:, :wn], axis=-1).astype(jnp.int32)
                 qj = jnp.zeros((S, W, 1), jnp.float32)
             else:
-                split = jax.vmap(lambda r: jax.random.split(r, W + 1))(rng)
+                split = jax.vmap(lambda r: jax.random.split(r, wn + 1))(rng)
                 rng = split[:, 0]
-                cur = jnp.stack(
-                    [jax.vmap(_sample_jit)(dlogits[:, b], temps, top_ps,
-                                           split[:, 1 + b])
-                     for b in range(W)], axis=1)
-                qj = jax.vmap(
+                nxt = jnp.stack(
+                    [self._draw_keys(
+                        dlogits[:, b], temps, top_ps, split[:, 1 + b],
+                        "off" if self.epilogue == "off" else mode)
+                     for b in range(wn)], axis=1)
+                qn = jax.vmap(
                     lambda row, t, tp: jax.vmap(
                         lambda lg: sampling_probs(lg, t, tp,
                                                   exact_topp=exact))(row)
-                )(dlogits, temps, top_ps)                       # [S, W, V]
+                )(dlogits[:, :wn], temps, top_ps)              # [S, wn, V]
+                # dead rectangle lanes carry ZERO draft mass — the
+                # acceptance rule's q_at > 0 guard retires them for free
+                qj = jnp.pad(qn, ((0, 0), (0, W - wn), (0, 0)))
+            # dead-lane tokens are -1: never equal to any target argmax
+            cur = jnp.pad(nxt, ((0, 0), (0, W - wn)), constant_values=-1)
             d_depth.append(cur)
             q_depth.append(qj)
         d_toks = jnp.stack(d_depth, axis=1)                     # [S, D, W]
 
-        # ---- verify: ONE target forward over the flattened tree
+        # ---- verify: ONE target forward over the ragged flattened tree
         vtoks = jnp.concatenate(
-            [pending[:, None], d_toks.reshape(S, D * W)], axis=1)
+            [pending[:, None]]
+            + [d_toks[:, j, :ws[j]] for j in range(D)], axis=1)  # [S, T]
         depth_of = np.concatenate(
-            [[0]] + [[j] * W for j in range(1, D + 1)]).astype(np.int32)
+            [[0]] + [[j] * ws[j - 1]
+                     for j in range(1, D + 1)]).astype(np.int32)
         vpos = pos[:, None] + jnp.asarray(depth_of)[None, :]
         vmask = jnp.concatenate(
             [participate[:, None],
-             jnp.broadcast_to(drow[:, None], (S, D * W))], axis=1)
-        wmask_v = jnp.asarray(tree_verify_mask(W, D))
+             jnp.broadcast_to(drow[:, None], (S, T - 1))], axis=1)
+        wmask_v = jnp.asarray(tree_verify_mask(ws))
         vlogits, tcache = forward(
             tparams, vtoks, self.tcfg, positions=vpos,
             attention_mask=vmask.astype(jnp.int32), cache=tcache, lora=lora,
@@ -830,13 +1091,18 @@ class SpecPrograms:
             pred = np.zeros((D, W), np.int64)  # parent column per node
             for j in range(1, D):
                 for b in range(W):
-                    pred[j, b] = _tree_col(j, b, W)
-            ok = (d_toks == tgt[:, pred]) & drow[:, None, None]
+                    pred[j, b] = offs[j - 1] + min(b, ws[j - 1] - 1)
+            live = jnp.asarray(
+                np.array([[b < ws[j] for b in range(W)]
+                          for j in range(D)]))
+            ok = (d_toks == tgt[:, pred]) & live[None] & drow[:, None, None]
             a_per_b = jnp.sum(
                 jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)  # [S, W]
             b_sel = jnp.argmax(a_per_b, axis=1).astype(jnp.int32)
             a = jnp.take_along_axis(a_per_b, b_sel[:, None], axis=1)[:, 0]
-            leaf = jnp.where(a == 0, 0, 1 + (a - 1) * W + b_sel)
+            offs_arr = jnp.asarray(np.array(offs, np.int32))
+            leaf = jnp.where(
+                a == 0, 0, offs_arr[jnp.maximum(a - 1, 0)] + b_sel)
             extra = jnp.take_along_axis(tgt, leaf[:, None], axis=1)[:, 0]
         else:
             p_cols = jax.vmap(
@@ -850,7 +1116,7 @@ class SpecPrograms:
                 + q_depth[1:], axis=1)                         # [S, D, W, V]
             a, b_sel, extra, rng = jax.vmap(
                 lambda p, q, d, t, r, s: accept_tree_tokens(
-                    p, q, d, t, r, s, width=W, depth=D)
+                    p, q, d, t, r, s, widths=ws)
             )(p_cols, q_tree, d_toks, temps, rng, drow)
         a = jnp.where(participate, a, 0)
         b_sel = jnp.where(drow, b_sel, 0)
@@ -876,9 +1142,13 @@ class SpecPrograms:
 
         # ---- compact the window: accepted path → contiguous cursor lanes,
         # everything else scrubbed to the sentinel (both caches share the
-        # window column layout)
-        src_cols = 1 + jnp.arange(D, dtype=jnp.int32)[None, :] * W \
-            + b_sel[:, None]
+        # window column layout). The per-depth clamp keeps the gather
+        # in-bounds for ragged widths — clamped entries sit at depths
+        # beyond the accepted length, where compact_window never reads.
+        col_tab = jnp.asarray(
+            np.array([[offs[j] + min(b, ws[j] - 1) for j in range(D)]
+                      for b in range(W)], np.int32))            # [W, D]
+        src_cols = col_tab[b_sel]                               # [S, D]
         tcache = compact_window(tcache, participate, t_len0, src_cols, a,
                                 pos, T)
         dcache = compact_window(dcache, drow, d_len0, src_cols, a, pos, T)
@@ -889,17 +1159,18 @@ class SpecPrograms:
         dcache = dict(dcache)
         dcache["len"] = d_len0 + jnp.where(drow, adv, 0)
         return (emitted, a, tcache, dcache, pending, pos, new_remaining,
-                new_active, rng)
+                new_active, rng, margin)
 
     # ---- plain decode in pending form (the never-slower fallback)
     def _decode_pending_impl(self, tparams, lora, tcache, pending, pos,
                              remaining, active, rng, temps, top_ps, stops,
-                             adapter_idx, *, K: int):
+                             adapter_idx, *, K: int, mode: str = "off"):
         """K-token chunked decode over pending-form slots: forward the
         pending token, sample its successor from the resulting logits, make
         that the new pending. Per-token cost identical to the non-spec
         ``_decode_impl`` (one forward + one sample), so the adaptive
-        controller's fallback never costs more than spec-off decode."""
+        controller's fallback never costs more than spec-off decode.
+        ``mode`` as in ``_enter_impl``."""
         def step(carry, _):
             pending, tcache, pos, remaining, active, rng = carry
             prev_len = tcache["len"]
@@ -914,9 +1185,7 @@ class SpecPrograms:
             tcache = dict(tcache)
             tcache["len"] = prev_len + active.astype(jnp.int32)
             pos = pos + active.astype(jnp.int32)
-            split = jax.vmap(jax.random.split)(rng)
-            rng, sub = split[:, 0], split[:, 1]
-            nxt = jax.vmap(_sample_jit)(logits[:, -1], temps, top_ps, sub)
+            nxt, rng = self._draw(logits[:, -1], temps, top_ps, rng, mode)
             is_stop = jnp.any(nxt[:, None] == stops, axis=1)
             emit = active & ~is_stop & (remaining > 0)
             emitted = jnp.where(emit, nxt, -1)

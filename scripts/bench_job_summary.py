@@ -131,6 +131,31 @@ def main() -> int:
                         + ("**disabled tree spec**"
                            if tadv.get("controller_disabled")
                            else "STILL ACTIVE"))
+                # learned-vs-fixed tree shapes: the learned controller
+                # prunes dead branches, so tokens/s must not regress
+                tl = tr.get("learned")
+                if isinstance(tl, dict):
+                    tf = tr.get("fixed") or {}
+                    widths = (tl.get("tree") or {}).get("widths")
+                    row += ("\n  - spec tree learned: "
+                            f"{(tl.get('on') or {}).get('tokens_per_sec')} "
+                            f"tok/s vs "
+                            f"{(tf.get('on') or {}).get('tokens_per_sec')} "
+                            f"fixed "
+                            f"(ratio {tr.get('learned_tps_ratio')}, "
+                            f"learned>=fixed: {tr.get('learned_ge_fixed')})"
+                            f" · widths={widths}")
+            # fused sampling epilogue: on-vs-off TPOT on the aligned twin
+            # (the run's parity gate already proved token-exactness)
+            ep = sp.get("epilogue")
+            if isinstance(ep, dict):
+                row += ("\n  - sampling epilogue "
+                        f"[{ep.get('impl')}]: tpot p50 "
+                        f"{(ep.get('on') or {}).get('tpot_ms_p50')}ms on "
+                        f"vs {(ep.get('off') or {}).get('tpot_ms_p50')}ms "
+                        f"off (ratio {ep.get('tpot_p50_ratio')}, "
+                        f"on<=off: {ep.get('tpot_le_off')}) · "
+                        f"fused_steps={ep.get('fused_steps')}")
         # KV-overcommit capacity twin: peak concurrent sessions at one
         # block budget is the headline; blocks-per-session and preemption
         # round-trips show HOW the extra sessions fit

@@ -108,6 +108,11 @@ class _StatsEngine:
     adapter_ids = {"": 0, "tenant-a": 1, "tenant-b": -1}
     resident_adapters = {"tenant-a": 1}
     adapter_requests = {"": 3, "tenant-a": 2, "tenant-b": 1}
+    # fused sampling epilogue: the mode gauge + per-path tick counter
+    # (dtx_serving_sampling_*) read straight off the engine, so the lint
+    # document carries both attributes
+    _epilogue_impl = "xla"
+    sampling_stats = {"fused_steps": 7, "legacy_steps": 2}
 
     # multi-tenant QoS plane: tenant_usage() turns the dtx_serving_tenant_*
     # families on, and the registry stub's host_tier_stats() builds every
@@ -151,8 +156,13 @@ class _StatsEngine:
                 "active": True, "disabled_events": 1,
                 "proposed": 40, "accepted": 25, "row_steps": 10,
                 "spec_steps": 10, "plain_steps": 3, "tree_steps": 6,
+                "sampling_epilogue": "on", "epilogue_impl": "xla",
+                "fused_steps": 7, "legacy_steps": 2,
                 "tree": {"spec": "4x3", "width": 4, "depth": 3,
-                         "plan_width": 2, "slot_path_len": {0: 1.8}}}
+                         "learned": True, "widths": [3, 2, 1],
+                         "plan_width": 3, "slot_path_len": {0: 1.8},
+                         "depth_ema": [0.7, 0.4, 0.2],
+                         "decisive_ema": 0.1}}
 
     def chat(self, messages, **kw):
         return "ok"

@@ -365,8 +365,8 @@ def test_memo_key_fragmentation_is_caught(tmp_path, monkeypatch):
         real_key = be._program_memo_key
         nonce = iter(range(10 ** 6))
 
-        def fragmented(cfg, max_seq_len, kv_quant):
-            k = real_key(cfg, max_seq_len, kv_quant)
+        def fragmented(cfg, max_seq_len, kv_quant, epilogue="off"):
+            k = real_key(cfg, max_seq_len, kv_quant, epilogue)
             return None if k is None else k + (f"adapters:{next(nonce)}",)
 
         monkeypatch.setattr(be, "_program_memo_key", fragmented)

@@ -211,7 +211,16 @@ def test_export_import_spec_tree_active_session(paged_pair):
         # settle collapsed it; the wire format is tree-agnostic
         assert src.spec_info()["tree_steps"] > 0
         assert any(ev[0] == "spec_settle" for ev in src.sched_trace)
-        assert "tree" not in json.dumps(payload)
+        # the KV/logits/rng wire stays tree-agnostic; the learned
+        # spec-controller document rides alongside as plain JSON (slot
+        # acceptance EMA + learned widths warm the importer's controller)
+        wire_doc = {k: v for k, v in payload.items() if k != "spec"}
+        assert "tree" not in json.dumps(wire_doc)
+        assert payload["spec"]["plan"][0] == "tree"
+        # the learned per-depth evidence rides along (importer controllers
+        # adopt it instead of restarting the width search cold)
+        assert "depth_ema" in payload["spec"]
+        json.dumps(payload["spec"])  # JSON-safe end to end
 
         n_prime0 = sum(1 for ev in dst.sched_trace if ev[0] == "spec_prime")
         steps0 = dst.spec_info()["tree_steps"]
